@@ -1,0 +1,6 @@
+from distributed_lion_tpu.ops.codec import (
+    pack_signs,
+    unpack_signs,
+    packed_size,
+    wire_bytes_per_param,
+)

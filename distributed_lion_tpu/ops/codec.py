@@ -1,0 +1,107 @@
+"""1-bit sign codec: pack boolean sign votes into a true uint8 wire format.
+
+Capability parity with the reference's codec helpers
+(/root/reference/distributed_lion.py:14-31 ``flatten_and_pad`` /
+``restore_flattened_tensor`` and :75-77 / :84-88 inline bit pack/unpack), with
+two deliberate differences:
+
+1. **Real uint8 on the wire.** The reference's ``(bool.byte() << arange(8)).sum(-1)``
+   silently promotes to int64, shipping 8 bytes per 8 params (SURVEY §2.3, wire
+   format bug). Here the packed dtype is uint8 — 1 bit/param as the algorithm
+   intends — an 8x wire-volume reduction.
+2. **Static shapes.** JAX/XLA requires compile-time shapes, so padding is
+   computed from the static leaf size; everything jit-compiles to vector ops.
+
+All functions are pure and shape-polymorphic at trace time (no data-dependent
+control flow), so they fuse into the surrounding optimizer update under jit.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def packed_size(n: int) -> int:
+    """Number of uint8 bytes needed to pack ``n`` sign bits (ceil(n/8))."""
+    return (n + 7) // 8
+
+
+def pack_signs(positive: jnp.ndarray) -> jnp.ndarray:
+    """Pack a boolean array (True = +1 vote) into uint8, 8 votes per byte.
+
+    Mirrors the reference's flatten→pad-to-multiple-of-8→bit-shift-pack
+    (/root/reference/distributed_lion.py:71-77) but with an actual uint8
+    result. Padding bits are zeros; they are dropped again by
+    :func:`unpack_signs`, so they never bias a vote (the reference trims
+    padding before voting too, distributed_lion.py:88).
+
+    Args:
+        positive: bool array of any shape.
+
+    Returns:
+        uint8 array of shape ``(packed_size(positive.size),)``.
+    """
+    flat = positive.reshape(-1).astype(jnp.uint8)
+    n = flat.shape[0]
+    pad = (-n) % 8
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.uint8)])
+    lanes = flat.reshape(-1, 8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    return jnp.sum(lanes << shifts, axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray, shape: tuple[int, ...]) -> jnp.ndarray:
+    """Inverse of :func:`pack_signs`: uint8 bytes → bool array of ``shape``.
+
+    Mirrors the reference's ``(x >> arange(8)) % 2 == 1`` unpack + trim +
+    reshape (/root/reference/distributed_lion.py:84-88, 27-31).
+    """
+    n = int(np.prod(shape)) if shape else 1
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts) & 1
+    return bits.reshape(-1)[:n].reshape(shape).astype(jnp.bool_)
+
+
+def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
+    """Accounting for bytes moved per optimizer step, per worker.
+
+    The reference ships int64-packed tensors via all_gather: every worker
+    receives ``world * ceil(n/8) * 8`` bytes per step
+    (/root/reference/distributed_lion.py:80-81; dtype verified in SURVEY §2.3).
+    BASELINE.md's comm budget asks for ≤ 1/32 of a bf16 gradient all-reduce
+    (2 bytes/param).
+
+    Args:
+        num_params: total parameters voted on.
+        world_size: number of data-parallel voters.
+        wire: 'sign_psum' (int8 on-fabric all-reduce) or 'packed_allgather'
+            (1-bit uint8 all-gather).
+
+    Returns:
+        dict with bytes received per worker per step for this build, the
+        reference, and a bf16 gradient all-reduce, plus bits/param.
+    """
+    if wire == "sign_psum":
+        # Ring all-reduce of the ballot tensor: received payload per worker ≈
+        # N bytes at the accumulator width (reduction happens on-fabric,
+        # receive volume independent of W). int8 is exact only while partial
+        # sums fit (W ≤ 127); larger worlds promote to int32, matching
+        # collectives.majority_vote_psum.
+        acc_bytes = 1 if world_size <= 127 else 4
+        ours = num_params * acc_bytes
+    elif wire == "packed_allgather":
+        ours = world_size * packed_size(num_params)
+    else:
+        raise ValueError(f"unknown wire format: {wire!r}")
+    reference = world_size * packed_size(num_params) * 8  # int64 lanes
+    bf16_allreduce = 2 * num_params
+    return {
+        "wire": wire,
+        "bytes_per_step": ours,
+        "bits_per_param": 8.0 * ours / max(num_params, 1),
+        "reference_bytes_per_step": reference,
+        "bf16_allreduce_bytes_per_step": bf16_allreduce,
+        "vs_bf16_allreduce": ours / max(bf16_allreduce, 1),
+    }

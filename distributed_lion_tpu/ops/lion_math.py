@@ -1,0 +1,92 @@
+"""Pure per-leaf Lion math shared by the local and distributed optimizers.
+
+Semantics match the reference update functions:
+- local Lion:            /root/reference/distributed_lion.py:47-59
+- deterministic 1-bit:   /root/reference/distributed_lion.py:61-96 (sign step)
+- stochastic 1-bit:      /root/reference/distributed_lion.py:98-136 (bernoulli
+                         binarization with range bound r = (1 + 1/beta1) *
+                         max_grad_norm, distributed_lion.py:106-108)
+
+Everything here is elementwise and jit-fusible; no collectives (those live in
+``optim.distributed_lion`` / ``parallel.collectives``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def interp(grad: jnp.ndarray, exp_avg: jnp.ndarray, b1: float) -> jnp.ndarray:
+    """The raw Lion update direction ``b1*m + (1-b1)*g`` (ref :54, :68, :107)."""
+    return exp_avg * b1 + grad * (1.0 - b1)
+
+
+def momentum_update(grad: jnp.ndarray, exp_avg: jnp.ndarray, b2: float) -> jnp.ndarray:
+    """``m ← b2*m + (1-b2)*g`` with the *local* gradient (ref :59, :96, :136).
+
+    Under distributed vote-Lion the momenta deliberately diverge across
+    workers — only sign votes are exchanged (SURVEY §2.3 step 7).
+    """
+    return exp_avg * b2 + grad * (1.0 - b2)
+
+
+def decay_params(params: jnp.ndarray, lr, wd: float) -> jnp.ndarray:
+    """Decoupled weight decay ``p ← p * (1 - lr*wd)`` (ref :50, :64, :101).
+
+    Applied multiplicatively *before* the sign update, matching the
+    reference's op ordering so trajectories are comparable bit-for-bit.
+    The factor is cast to the param dtype so a float32 LR schedule can
+    never silently promote bf16 params.
+    """
+    factor = jnp.asarray(1.0 - lr * wd, params.dtype)
+    return params * factor
+
+
+def sign_vote_bool(grad: jnp.ndarray, exp_avg: jnp.ndarray, b1: float) -> jnp.ndarray:
+    """Deterministic binarization: vote True where the update is > 0.
+
+    The reference computes ``sign(interp) > 0`` (ref :68, :71); zero maps to a
+    False (−1) vote, consistent with the tie→−1 rule downstream.
+    """
+    return interp(grad, exp_avg, b1) > 0
+
+
+def stochastic_vote_bool(
+    key: jax.Array,
+    grad: jnp.ndarray,
+    exp_avg: jnp.ndarray,
+    b1: float,
+    max_grad_norm: float,
+) -> jnp.ndarray:
+    """Stochastic binarization: vote True with prob ``(u + r) / 2r``.
+
+    Unbiased-in-expectation 1-bit quantizer (ref :106-108): with
+    ``r = (1 + 1/b1) * max_grad_norm`` and clipped gradients, ``|u| ≤ r`` so
+    the probability is in [0, 1]. We clip defensively (the reference would
+    raise inside ``torch.bernoulli``; clipping keeps the quantizer total and
+    jit-safe — outside the bound it saturates to a deterministic vote).
+    """
+    r = (1.0 + 1.0 / b1) * max_grad_norm
+    u = interp(grad, exp_avg, b1)
+    p_up = jnp.clip((u.astype(jnp.float32) + r) / (2.0 * r), 0.0, 1.0)
+    return jax.random.bernoulli(key, p_up)
+
+
+def apply_signed_update(params: jnp.ndarray, vote_pos: jnp.ndarray, lr) -> jnp.ndarray:
+    """``p ← p - lr * (vote ? +1 : -1)`` (ref :91-92: ``vote*2 - 1``)."""
+    s = jnp.where(vote_pos, 1.0, -1.0).astype(params.dtype)
+    return params - jnp.asarray(lr, params.dtype) * s
+
+
+def local_lion_leaf(params, grad, exp_avg, lr, wd, b1, b2):
+    """One full local-Lion step on one leaf (ref update_fn, :47-59).
+
+    Note the local path uses true ``sign`` (0 → no movement) rather than the
+    ±1 vote encoding; this matches the reference exactly.
+    """
+    p = decay_params(params, lr, wd)
+    u = jnp.sign(interp(grad, exp_avg, b1))
+    p = p - jnp.asarray(lr, p.dtype) * u.astype(p.dtype)
+    m = momentum_update(grad, exp_avg, b2)
+    return p, m

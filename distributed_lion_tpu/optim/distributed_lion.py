@@ -1,0 +1,196 @@
+"""Distributed Lion: 1-bit majority-vote Lion over a JAX device mesh.
+
+Capability parity with the reference's ``update_fn_distributed`` /
+``update_fn_distributed_stoc`` (/root/reference/distributed_lion.py:61-136)
+and its construction-time mode dispatch (:159-166), redesigned TPU-first:
+
+- **One fused collective per step, not one per tensor.** The reference loops
+  over ~148 parameter tensors calling a blocking NCCL ``all_gather`` each
+  (SURVEY §3.1 hot loop). Here every leaf's votes are concatenated into a
+  single 1-D ballot vector and voted with ONE ``psum`` (or one packed
+  ``all_gather``) per optimizer step.
+- **Reduction on the interconnect.** The default wire (``sign_psum``) sums ±1
+  int8 ballots with ``lax.psum``: receive volume is independent of world
+  size, vs the reference's O(W·N) gather-then-``torch.mode``-in-Python.
+- **The intended dispatch, not the reference's broken one.** The reference's
+  stochastic path is unreachable (lambda returns the function object;
+  ``self.max_grad_norm`` never assigned — SURVEY §2.1). Here
+  ``max_grad_norm=None`` → deterministic sign votes, set → stochastic
+  binarization, and ``axis_name=None`` → plain local Lion (the reference's
+  uninitialized-process-group fallback, :165-166).
+- **Per-worker momentum is first-class state.** ``step`` must run inside
+  ``jax.shard_map`` with params replicated; momentum is stored globally with
+  a leading ``[world]`` axis sharded over the data axis, so Orbax checkpoints
+  capture EVERY worker's momentum (the reference silently saves only rank
+  0's — SURVEY §5, checkpoint gap).
+
+Tie rule: ties elect −1, matching ``torch.mode``'s smaller-value behavior on
+even worlds (SURVEY §2.3 step 6), so trajectories are comparable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_lion_tpu.ops import lion_math
+from distributed_lion_tpu.optim.lion import (
+    FunctionalOptimizer,
+    LionState,
+    Schedule,
+    _validate,
+    lion,
+    resolve_lr,
+)
+from distributed_lion_tpu.parallel import collectives
+
+
+def _flatten_votes(vote_tree):
+    """Concatenate a pytree of bool vote arrays into one 1-D ballot vector."""
+    leaves = jax.tree.leaves(vote_tree)
+    return jnp.concatenate([l.reshape(-1) for l in leaves])
+
+
+def _split_votes(flat, like_tree):
+    """Inverse of :func:`_flatten_votes` against a template pytree."""
+    leaves, treedef = jax.tree.flatten(like_tree)
+    out, off = [], 0
+    for l in leaves:
+        n = l.size
+        out.append(flat[off : off + n].reshape(l.shape))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def distributed_lion(
+    learning_rate: Schedule = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    weight_decay: float = 0.0,
+    *,
+    axis_name: Optional[str] = "data",
+    max_grad_norm: Optional[float] = None,
+    wire: str = "sign_psum",
+    mom_dtype: Optional[jnp.dtype] = None,
+) -> FunctionalOptimizer:
+    """Build the majority-vote Lion optimizer.
+
+    Args:
+        learning_rate: scalar or schedule ``step -> lr``.
+        b1, b2, weight_decay: Lion hyperparameters (ref defaults :144-147).
+        axis_name: mesh axis to vote across. ``None`` → local Lion fallback.
+        max_grad_norm: ``None`` → deterministic sign votes (ref :61-96);
+            set → stochastic binarization with range bound
+            ``r = (1 + 1/b1) * max_grad_norm`` (ref :106-108). Requires an
+            ``rng`` key at ``init``.
+        wire: 'sign_psum' (int8 on-fabric reduce; ICI default) or
+            'packed_allgather' (1-bit uint8 wire; DCN-friendly).
+        mom_dtype: momentum dtype override (default: param dtype, ref :185).
+
+    Returns:
+        A :class:`FunctionalOptimizer` whose ``step`` MUST be traced inside
+        ``jax.shard_map`` with ``axis_name`` bound (unless ``axis_name`` is
+        None). Params in/out are replicated; ``state.exp_avg`` is this
+        worker's momentum shard (see :func:`init_global_state`).
+    """
+    if wire not in ("sign_psum", "packed_allgather"):
+        raise ValueError(f"unknown wire format: {wire!r}")
+    if axis_name is None:
+        # The reference's uninitialized-process-group fallback is plain local
+        # Lion (distributed_lion.py:165-166). Refuse to silently drop an
+        # explicit stochastic request rather than mimic the reference's
+        # broken max_grad_norm branch (SURVEY §2.1).
+        if max_grad_norm is not None:
+            raise ValueError(
+                "max_grad_norm (stochastic binarization) requires a vote axis; "
+                "pass axis_name or use lion() for the local optimizer"
+            )
+        return lion(learning_rate, b1, b2, weight_decay, mom_dtype)
+
+    _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
+    stochastic = max_grad_norm is not None
+
+    def init(params, rng: Optional[jax.Array] = None) -> LionState:
+        if stochastic and rng is None:
+            raise ValueError("stochastic Distributed Lion requires an rng key at init")
+        exp_avg = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mom_dtype or p.dtype), params
+        )
+        return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng)
+
+    def step(params, grads, state: LionState):
+        lr = resolve_lr(learning_rate, state.count)
+        grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, state.exp_avg)
+
+        # 1) weight decay, multiplicatively, before the update (ref :64).
+        decayed = jax.tree.map(lambda p: lion_math.decay_params(p, lr, weight_decay), params)
+
+        # 2) binarize: this worker's bool ballots (ref :68-71 / :105-108).
+        if stochastic:
+            widx = lax.axis_index(axis_name)
+            base = jax.random.fold_in(state.rng, state.count)
+            worker_key = jax.random.fold_in(base, widx)
+            leaves = jax.tree.leaves(state.exp_avg)
+            keys = jax.random.split(worker_key, len(leaves))
+            keytree = jax.tree.unflatten(jax.tree.structure(state.exp_avg), list(keys))
+            votes = jax.tree.map(
+                lambda k, g, m: lion_math.stochastic_vote_bool(k, g, m, b1, max_grad_norm),
+                keytree, grads, state.exp_avg,
+            )
+        else:
+            votes = jax.tree.map(
+                lambda g, m: lion_math.sign_vote_bool(g, m, b1), grads, state.exp_avg
+            )
+
+        # 3) ONE collective for the whole pytree (vs per-tensor all_gather,
+        #    ref :81): flatten → vote → split.
+        flat = _flatten_votes(votes)
+        elected = collectives.majority_vote(flat, axis_name, wire)
+        elected_tree = _split_votes(elected, votes)
+
+        # 4) apply the elected ±1 update (ref :91-92). The psum output is
+        #    identical on every worker, so replicated params stay replicated.
+        new_params = jax.tree.map(
+            lambda p, v: lion_math.apply_signed_update(p, v, lr), decayed, elected_tree
+        )
+
+        # 5) momentum with the LOCAL gradient — divergent by design (ref :96).
+        new_m = jax.tree.map(
+            lambda g, m: lion_math.momentum_update(g, m, b2), grads, state.exp_avg
+        )
+        return new_params, LionState(state.count + 1, new_m, state.rng)
+
+    return FunctionalOptimizer(init=init, step=step)
+
+
+# ---------------------------------------------------------------------------
+# Global-state helpers: stacked per-worker momentum with a leading [world]
+# axis, sharded P('data'), so divergent state coexists with replicated params
+# under shard_map and checkpoints capture all workers (SURVEY §7 hard part 1/3).
+# ---------------------------------------------------------------------------
+
+def init_global_state(opt: FunctionalOptimizer, params, world: int,
+                      rng: Optional[jax.Array] = None) -> LionState:
+    """Initialize optimizer state with exp_avg stacked to ``[world, ...]``.
+
+    The result should be device_put with the leading axis sharded over the
+    data mesh axis (``parallel.mesh.data_sharded``).
+    """
+    st_shapes = jax.eval_shape(lambda p: opt.init(p, rng), params)
+    exp_avg = jax.tree.map(
+        lambda m: jnp.zeros((world,) + m.shape, m.dtype), st_shapes.exp_avg
+    )
+    return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng)
+
+
+def squeeze_worker_state(state: LionState) -> LionState:
+    """Inside shard_map: drop this worker's leading [1] momentum axis."""
+    return LionState(state.count, jax.tree.map(lambda m: m[0], state.exp_avg), state.rng)
+
+
+def expand_worker_state(state: LionState) -> LionState:
+    """Inside shard_map: restore the leading [1] axis before returning."""
+    return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg), state.rng)

@@ -1,0 +1,69 @@
+"""shard_map wiring for Distributed Lion: replicated params, per-worker
+momentum, one vote collective — the sharding layout SURVEY §7 flags as the
+build's hard part #1.
+
+This module provides the standalone optimizer-step wrapper (used by tests and
+by users who bring their own training loop). The full training step (fwd/bwd
+fused with the vote in one shard_map) lives in ``train.loop``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_lion_tpu.optim.distributed_lion import (
+    expand_worker_state,
+    squeeze_worker_state,
+)
+from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
+from distributed_lion_tpu.parallel.mesh import DATA_AXIS
+
+
+def state_specs() -> LionState:
+    """PartitionSpec pytree-prefix for a stacked-momentum LionState."""
+    return LionState(count=P(), exp_avg=P(DATA_AXIS), rng=P())
+
+
+def make_sharded_step(opt: FunctionalOptimizer, mesh):
+    """Build a jitted step over ``mesh``:
+
+    ``(params, stacked_grads, state) -> (new_params, new_state)``
+
+    - ``params``: replicated pytree.
+    - ``stacked_grads``: pytree with leading ``[world]`` axis, sharded over
+      the data axis — each worker consumes its own slice, standing in for
+      the per-device gradients a real train step computes in place (the
+      reference's no_sync contract: gradients are never averaged,
+      async_trainer.py:15).
+    - ``state``: from ``init_global_state``, exp_avg sharded over data.
+    """
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), state_specs()),
+        out_specs=(P(), state_specs()),
+        check_vma=False,
+    )
+    def _step(params, stacked_grads, state):
+        grads = jax.tree.map(lambda g: g[0], stacked_grads)
+        st = squeeze_worker_state(state)
+        new_params, new_st = opt.step(params, grads, st)
+        return new_params, expand_worker_state(new_st)
+
+    return jax.jit(_step)
+
+
+def shard_state(state: LionState, mesh) -> LionState:
+    """device_put a stacked state with exp_avg over the data axis."""
+    return LionState(
+        count=jax.device_put(state.count, NamedSharding(mesh, P())),
+        exp_avg=jax.tree.map(
+            lambda m: jax.device_put(m, NamedSharding(mesh, P(DATA_AXIS))),
+            state.exp_avg,
+        ),
+        rng=None if state.rng is None else jax.device_put(state.rng, NamedSharding(mesh, P())),
+    )

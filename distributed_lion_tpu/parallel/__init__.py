@@ -1,0 +1,10 @@
+from distributed_lion_tpu.parallel.mesh import (
+    make_mesh,
+    data_axis_size,
+    replicated,
+    data_sharded,
+)
+from distributed_lion_tpu.parallel.collectives import (
+    majority_vote_psum,
+    majority_vote_packed_allgather,
+)

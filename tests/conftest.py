@@ -1,0 +1,18 @@
+"""Test harness: 8 virtual CPU devices so the full mesh / shard_map / vote
+path runs without TPU hardware (SURVEY §4: distributed tests without a
+cluster). Must set env BEFORE jax is imported anywhere."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers the TPU plugin and overrides
+# JAX_PLATFORMS from the environment; the config knob still wins if set
+# before first backend use.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

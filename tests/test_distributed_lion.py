@@ -1,0 +1,178 @@
+"""Distributed vote-Lion property tests on 8 virtual devices (SURVEY §4):
+(a) W=1 ≡ local Lion; (b) replica consistency; (c) permutation invariance;
+(d) wire paths agree; (e) tie→−1; (f) stochastic path; (g) drop-out vote."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_lion_tpu.optim import distributed_lion, init_global_state, lion
+from distributed_lion_tpu.optim.sharded import make_sharded_step, shard_state
+from distributed_lion_tpu.parallel import collectives, make_mesh
+from distributed_lion_tpu.parallel.mesh import DATA_AXIS
+
+
+def _params():
+    rng = np.random.default_rng(7)
+    return {
+        "w": jnp.asarray(rng.normal(size=(4, 6)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(5,)).astype(np.float32)),
+    }
+
+
+def _stacked_grads(world, seed=3):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(size=(world, 4, 6)).astype(np.float32)),
+        "b": jnp.asarray(rng.normal(size=(world, 5)).astype(np.float32)),
+    }
+
+
+def _run_steps(mesh, opt, params, stacked_grads, state, n=1):
+    step = make_sharded_step(opt, mesh)
+    for _ in range(n):
+        params, state = step(params, stacked_grads, state)
+    return params, state
+
+
+@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather"])
+def test_world1_matches_local(wire):
+    mesh = make_mesh(data=1, devices=jax.devices()[:1])
+    params = _params()
+    grads = _stacked_grads(1)
+    opt = distributed_lion(learning_rate=0.01, weight_decay=0.1, wire=wire)
+    state = shard_state(init_global_state(opt, params, world=1), mesh)
+    new_p, _ = _run_steps(mesh, opt, params, grads, state)
+
+    # Local Lion on the same (single-worker) gradients. With W=1 the vote of
+    # one worker IS its sign (grads here are nonzero, so sign∈{±1} and the
+    # >0 encoding agrees with true sign).
+    lopt = lion(learning_rate=0.01, weight_decay=0.1)
+    local_g = jax.tree.map(lambda g: g[0], grads)
+    exp_p, _ = lopt.step(params, local_g, lopt.init(params))
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]), np.asarray(exp_p[k]), rtol=1e-6)
+
+
+@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather"])
+def test_replica_consistency_and_vote_semantics(wire):
+    """All workers apply the identical elected update; the election matches a
+    numpy majority vote of the per-worker signs."""
+    mesh = make_mesh(data=8)
+    params = _params()
+    grads = _stacked_grads(8)
+    lr = 0.01
+    opt = distributed_lion(learning_rate=lr, weight_decay=0.0, wire=wire)
+    state = shard_state(init_global_state(opt, params, world=8), mesh)
+    new_p, new_state = _run_steps(mesh, opt, params, grads, state)
+
+    for k in params:
+        votes = np.asarray(grads[k]) > 0          # m=0 → u=(1-b1)*g → vote g>0
+        count = votes.sum(axis=0)
+        elected = np.where(count * 2 > 8, 1.0, -1.0)   # tie→−1
+        exp = np.asarray(params[k]) - lr * elected
+        np.testing.assert_allclose(np.asarray(new_p[k]), exp, rtol=1e-6)
+        # momentum is per-worker, from LOCAL grads
+        exp_m = 0.01 * np.asarray(grads[k])
+        np.testing.assert_allclose(np.asarray(new_state.exp_avg[k]), exp_m, rtol=1e-6)
+
+
+def test_wire_paths_agree():
+    mesh = make_mesh(data=8)
+    params = _params()
+    grads = _stacked_grads(8, seed=11)
+    outs = []
+    for wire in ("sign_psum", "packed_allgather"):
+        opt = distributed_lion(learning_rate=0.05, wire=wire)
+        state = shard_state(init_global_state(opt, params, world=8), mesh)
+        new_p, _ = _run_steps(mesh, opt, params, grads, state, n=3)
+        outs.append(new_p)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(outs[0][k]), np.asarray(outs[1][k]))
+
+
+def test_permutation_invariance():
+    mesh = make_mesh(data=8)
+    params = _params()
+    grads = _stacked_grads(8, seed=5)
+    perm = np.random.default_rng(0).permutation(8)
+    permuted = jax.tree.map(lambda g: g[perm], grads)
+    opt = distributed_lion(learning_rate=0.01)
+    p1, _ = _run_steps(mesh, opt, params, grads,
+                       shard_state(init_global_state(opt, params, 8), mesh))
+    p2, _ = _run_steps(mesh, opt, params, permuted,
+                       shard_state(init_global_state(opt, params, 8), mesh))
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+
+
+def test_tie_elects_minus_one():
+    """Even world, 50/50 split → vote False → update −1 → p increases by lr
+    (torch.mode smaller-value tie rule, SURVEY §2.3 step 6)."""
+    mesh = make_mesh(data=8)
+    params = {"w": jnp.zeros((4,))}
+    half = np.ones((8, 4), np.float32)
+    half[:4] *= -1.0  # 4 workers vote −, 4 vote +
+    grads = {"w": jnp.asarray(half)}
+    opt = distributed_lion(learning_rate=0.5, weight_decay=0.0)
+    state = shard_state(init_global_state(opt, params, 8), mesh)
+    new_p, _ = _run_steps(mesh, opt, params, grads, state)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 0.5)  # p - lr*(−1)
+
+
+def test_stochastic_binarization_unbiased_and_divergent():
+    """Stochastic votes: per-worker draws differ, and the mean elected
+    direction tracks the gradient sign for strong signals."""
+    mesh = make_mesh(data=8)
+    n = 4096
+    params = {"w": jnp.zeros((n,))}
+    # strong positive signal on all workers → P(vote +) well above 1/2
+    grads = {"w": jnp.full((8, n), -0.8, jnp.float32)}
+    opt = distributed_lion(learning_rate=1.0, max_grad_norm=1.0)
+    state = shard_state(
+        init_global_state(opt, params, 8, rng=jax.random.key(0)), mesh
+    )
+    new_p, _ = _run_steps(mesh, opt, params, grads, state)
+    # u = 0.1*(-0.8) = −0.08, r = (1+1/0.9)*1 ≈ 2.111, P(+) ≈ 0.481 →
+    # per-worker votes are near-coin-flips but the MAJORITY of 8 still
+    # leans −; just assert both outcomes occur (stochasticity) and that the
+    # update is ±lr exactly.
+    vals = np.unique(np.asarray(new_p["w"]))
+    assert set(vals).issubset({-1.0, 1.0})
+    assert len(vals) == 2, "stochastic path produced deterministic output"
+
+
+def test_stochastic_requires_rng():
+    opt = distributed_lion(max_grad_norm=1.0)
+    with pytest.raises(ValueError):
+        opt.init({"w": jnp.zeros((2,))})
+
+
+def test_axis_none_falls_back_to_local():
+    # Parity with the reference's uninitialized-dist fallback (:165-166).
+    opt = distributed_lion(learning_rate=0.1, axis_name=None)
+    p = {"w": jnp.zeros((2,))}
+    p1, _ = opt.step(p, {"w": jnp.ones((2,))}, opt.init(p))
+    np.testing.assert_allclose(np.asarray(p1["w"]), -0.1, rtol=1e-6)
+
+
+def test_dropout_robust_masked_vote():
+    """Masked vote: dead workers abstain and the survivors' majority wins
+    (the algorithm-level drop-out robustness the reference only claims)."""
+    mesh = make_mesh(data=8)
+
+    def f(votes, alive):
+        return collectives.masked_majority_vote_psum(votes[0], alive[0], DATA_AXIS)
+
+    votes = np.zeros((8, 4), bool)
+    votes[:3] = True  # 3 True, 5 False → False wins alive; kill 4 False voters
+    alive = np.ones((8, 1), bool)
+    alive[3:7] = False
+    out = jax.shard_map(
+        f, mesh=mesh, in_specs=(P(DATA_AXIS), P(DATA_AXIS)), out_specs=P(),
+        check_vma=False,
+    )(jnp.asarray(votes), jnp.asarray(alive))
+    # survivors: workers 0,1,2 (True) and 7 (False) → 3 vs 1 → True elected
+    assert np.asarray(out).all()

@@ -1,0 +1,83 @@
+"""Local Lion unit tests: hand-computed algebra parity with the reference's
+update_fn (distributed_lion.py:47-59) and ctor validation (:149-150)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.optim import lion
+
+
+def _hand_step(p, g, m, lr, wd, b1, b2):
+    p = p * (1 - lr * wd)
+    u = np.sign(b1 * m + (1 - b1) * g)
+    p = p - lr * u
+    m = b2 * m + (1 - b2) * g
+    return p, m
+
+
+def test_single_step_matches_hand_algebra():
+    rng = np.random.default_rng(1)
+    p0 = rng.normal(size=(5, 3)).astype(np.float32)
+    g0 = rng.normal(size=(5, 3)).astype(np.float32)
+    m0 = rng.normal(size=(5, 3)).astype(np.float32)
+
+    opt = lion(learning_rate=0.01, b1=0.9, b2=0.99, weight_decay=0.1)
+    state = opt.init({"w": jnp.asarray(p0)})
+    state = state._replace(exp_avg={"w": jnp.asarray(m0)})
+    new_p, new_state = jax.jit(opt.step)({"w": jnp.asarray(p0)}, {"w": jnp.asarray(g0)}, state)
+
+    exp_p, exp_m = _hand_step(p0, g0, m0, 0.01, 0.1, 0.9, 0.99)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), exp_p, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_state.exp_avg["w"]), exp_m, rtol=1e-6)
+    assert int(new_state.count) == 1
+
+
+def test_state_is_momentum_only_and_lazy_zero():
+    # Parity: the only state is exp_avg initialized to zeros (ref :185-186).
+    opt = lion()
+    params = {"a": jnp.ones((3,)), "b": {"c": jnp.ones((2, 2), jnp.bfloat16)}}
+    st = opt.init(params)
+    assert jax.tree.all(jax.tree.map(lambda m: (m == 0).all(), st.exp_avg))
+    assert st.exp_avg["b"]["c"].dtype == jnp.bfloat16  # momentum in param dtype
+
+
+def test_two_steps_momentum_carries():
+    opt = lion(learning_rate=0.1, weight_decay=0.0)
+    p = {"w": jnp.zeros((4,))}
+    g = {"w": jnp.ones((4,))}
+    p1, st = opt.step(p, g, opt.init(p))
+    # step 1: m=0 → u=sign(0.1*g)=1 → p1 = -0.1
+    np.testing.assert_allclose(np.asarray(p1["w"]), -0.1, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(st.exp_avg["w"]), 0.01, rtol=1e-6)
+    p2, st2 = opt.step(p1, g, st)
+    np.testing.assert_allclose(np.asarray(p2["w"]), -0.2, rtol=1e-6)
+    assert int(st2.count) == 2
+
+
+def test_validation_matches_reference():
+    with pytest.raises(ValueError):
+        lion(learning_rate=0.0)
+    with pytest.raises(ValueError):
+        lion(b1=1.5)
+    with pytest.raises(ValueError):
+        lion(b2=-0.1)
+
+
+def test_bf16_params_stay_bf16_under_f32_schedule():
+    # Regression: a float32 LR schedule must not promote bf16 params.
+    sched = lambda count: jnp.asarray(1e-3, jnp.float32) * jnp.ones((), jnp.float32)
+    opt = lion(learning_rate=sched, weight_decay=0.1)
+    p = {"w": jnp.ones((4,), jnp.bfloat16)}
+    p1, st = opt.step(p, {"w": jnp.ones((4,), jnp.bfloat16)}, opt.init(p))
+    assert p1["w"].dtype == jnp.bfloat16
+    assert st.exp_avg["w"].dtype == jnp.bfloat16
+
+
+def test_schedule_callable():
+    sched = lambda count: 0.1 * (count + 1)
+    opt = lion(learning_rate=sched)
+    p = {"w": jnp.zeros((2,))}
+    p1, st = opt.step(p, {"w": jnp.ones((2,))}, opt.init(p))
+    np.testing.assert_allclose(np.asarray(p1["w"]), -0.1, rtol=1e-6)
