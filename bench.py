@@ -1,0 +1,91 @@
+"""Benchmark: GPT-2 124M vote-Lion training throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); its stated target is "GPT-2
+124M on v5e-8 competitive with 8xA100 wall-clock". We anchor vs_baseline to
+100_000 tokens/s per device — a stand-in for per-A100 GPT-2-small training
+throughput under the reference's stack (HF Trainer + DDP + its Python-loop
+optimizer, which README.md:2 admits is slow) — so vs_baseline > 1 means one
+TPU chip under this framework out-trains one A100 under the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_TOKENS_PER_SEC_PER_DEVICE = 100_000.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.data.sources import synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh()
+    model_cfg = GPT2Config.gpt2_124m()
+    batch_per_dev, accum = 8, 1
+    cfg = TrainConfig(
+        lion=True,
+        async_grad=True,
+        learning_rate=1e-4,
+        weight_decay=0.1,
+        warmup_steps=10,
+        max_steps=10_000,
+        per_device_train_batch_size=batch_per_dev,
+        gradient_accumulation_steps=accum,
+        block_size=model_cfg.n_ctx,
+        logging_steps=10_000,
+        output_dir=None,
+    )
+    trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
+    global_bs = trainer.global_train_batch()
+    tokens_per_step = global_bs * cfg.block_size
+
+    blocks = synthetic_lm_dataset(global_bs * 4, cfg.block_size, model_cfg.vocab_size, seed=0)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch = jax.device_put(
+        blocks[:global_bs].astype(np.int32), NamedSharding(mesh, P("data"))
+    )
+    base_key = jax.random.key(0)
+
+    # warmup/compile
+    trainer.params, trainer.state, m = trainer._train_step(
+        trainer.params, trainer.state, batch, base_key
+    )
+    jax.block_until_ready(m["loss"])
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.params, trainer.state, m = trainer._train_step(
+            trainer.params, trainer.state, batch, base_key
+        )
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = tokens_per_step * steps / dt
+    per_chip = tokens_per_sec / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "tokens/sec/chip, GPT-2 124M vote-Lion train step "
+                f"(bs={batch_per_dev}x{cfg.block_size}, {n_dev} device(s))",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Causal-LM pretraining entry point — the reference's ``run_clm.py``
+workload (GPT-2 on openwebtext, /root/reference/run_clm.py, README.md:18-38)
+rebuilt TPU-native.
+
+Canonical launch (maps the reference's ``torchrun --nproc_per_node 4
+run_clm.py --lion --async_grad ...``, README.md:19-38):
+
+    python -m distributed_lion_tpu.cli.run_clm \
+        --lion --async_grad --model_name gpt2_124m \
+        --dataset synthetic --per_device_train_batch_size 20 \
+        --gradient_accumulation_steps 8 --learning_rate 1e-4 \
+        --weight_decay 0.1 --warmup_steps 2000 --max_steps 100000 \
+        --block_size 1024 --output_dir ./out
+
+There is no torchrun: the device mesh comes from ``jax.devices()`` (all
+local chips → the ``data`` axis) or multi-host ``jax.distributed``. Data
+sources (zero-egress substitutes for HF-hub streaming): ``synthetic``,
+``text:<glob>`` (local files via the byte/HF-cache tokenizer), or
+``bin:<path>`` (pre-tokenized uint16 memmap, e.g. an openwebtext dump).
+Set env ``DLION_PLATFORM=cpu8`` to force an 8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ModelArguments:
+    """run_clm.py ModelArguments (:89-166) — the subset that configures a
+    from-scratch model rather than an HF hub download."""
+
+    model_name: str = "gpt2_124m"  # gpt2_124m | tiny
+    vocab_size: Optional[int] = None  # default: tokenizer/model default
+    n_ctx: Optional[int] = None
+    dropout: float = 0.0
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass
+class DataArguments:
+    """run_clm.py DataTrainingArguments (:169-244), zero-egress edition."""
+
+    dataset: str = "synthetic"  # synthetic | text:<glob> | bin:<path>
+    tokenizer_name: Optional[str] = None
+    validation_split_percentage: int = 5  # run_clm.py:181-184
+    max_train_samples: Optional[int] = None  # debug truncation (:186-203)
+    max_eval_samples: Optional[int] = None
+    synthetic_blocks: int = 4096
+
+
+def build_mesh():
+    import jax
+
+    from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
+
+    if os.environ.get("DLION_PLATFORM") == "cpu8":
+        jax.config.update("jax_platforms", "cpu")
+        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    multihost_initialize()
+    return make_mesh()
+
+
+def load_blocks(data_args: DataArguments, block_size: int, vocab_size: int):
+    import numpy as np
+
+    from distributed_lion_tpu.data.sources import (
+        TokenDataset,
+        synthetic_lm_dataset,
+        tokens_from_text_files,
+    )
+
+    if data_args.dataset == "synthetic":
+        blocks = synthetic_lm_dataset(data_args.synthetic_blocks, block_size, vocab_size)
+    elif data_args.dataset.startswith("text:"):
+        paths = sorted(glob.glob(data_args.dataset[len("text:"):]))
+        if not paths:
+            raise FileNotFoundError(f"no files match {data_args.dataset!r}")
+        blocks = tokens_from_text_files(paths, block_size, data_args.tokenizer_name)
+    elif data_args.dataset.startswith("bin:"):
+        blocks = TokenDataset.from_bin(data_args.dataset[len("bin:"):], block_size).blocks
+    else:
+        raise ValueError(f"unknown dataset spec {data_args.dataset!r}")
+
+    # token ids must fit the model's embedding table — XLA gather would
+    # silently clamp out-of-range ids into wrong-but-running training.
+    if len(blocks):
+        sample = np.asarray(blocks[: max(1, 4_000_000 // blocks.shape[1])])
+        mx = int(sample.max())
+        if mx >= vocab_size:
+            raise ValueError(
+                f"dataset contains token id {mx} >= model vocab_size {vocab_size}; "
+                "set --vocab_size (or use a matching tokenizer)"
+            )
+
+    # validation split + debug truncation (run_clm.py:181-203, 355-381)
+    n_val = max(1, len(blocks) * data_args.validation_split_percentage // 100)
+    train, val = blocks[n_val:], blocks[:n_val]
+    if data_args.max_train_samples:
+        train = train[: data_args.max_train_samples]
+    if data_args.max_eval_samples:
+        val = val[: data_args.max_eval_samples]
+    return np.asarray(train), np.asarray(val)
+
+
+def main(argv=None):
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    model_args, data_args, train_cfg = parse_dataclasses(
+        (ModelArguments, DataArguments, _train_config_cls()), argv
+    )
+
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.data.sources import batch_iterator
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.train.loop import Trainer
+
+    mesh = build_mesh()
+    dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+    common = dict(
+        dropout=model_args.dropout,
+        param_dtype=dtypes[model_args.param_dtype],
+        compute_dtype=dtypes[model_args.compute_dtype],
+    )
+    if model_args.model_name == "tiny":
+        model_cfg = GPT2Config.tiny(**common)
+    else:
+        model_cfg = GPT2Config.gpt2_124m(**common)
+    if model_args.vocab_size:
+        model_cfg = dataclasses.replace(model_cfg, vocab_size=model_args.vocab_size)
+    elif data_args.dataset.startswith("text:"):
+        # size the embedding to the tokenizer when the user didn't pin it
+        from distributed_lion_tpu.data.tokenizer import load_tokenizer
+
+        tok_vocab = load_tokenizer(data_args.tokenizer_name).vocab_size
+        if tok_vocab > model_cfg.vocab_size:
+            print(f"[run_clm] growing vocab_size {model_cfg.vocab_size} -> tokenizer {tok_vocab}")
+            model_cfg = dataclasses.replace(model_cfg, vocab_size=tok_vocab)
+    if model_args.n_ctx:
+        model_cfg = dataclasses.replace(model_cfg, n_ctx=model_args.n_ctx)
+    if train_cfg.block_size > model_cfg.n_ctx:
+        # run_clm.py:491-506 caps block_size at the model context length.
+        print(f"[run_clm] capping block_size {train_cfg.block_size} -> n_ctx {model_cfg.n_ctx}")
+        train_cfg.block_size = model_cfg.n_ctx
+
+    train_blocks, eval_blocks = load_blocks(data_args, train_cfg.block_size, model_cfg.vocab_size)
+    trainer = Trainer.for_gpt2(train_cfg, mesh, model_cfg)
+    it = batch_iterator(train_blocks, trainer.global_train_batch(), seed=train_cfg.seed)
+    try:
+        trainer.train(it, eval_blocks=eval_blocks)
+        if eval_blocks is not None and len(eval_blocks):
+            trainer.evaluate(eval_blocks)
+        if trainer.checkpointer:
+            trainer.save()
+    finally:
+        trainer.close()
+
+
+def _train_config_cls():
+    from distributed_lion_tpu.train.loop import TrainConfig
+
+    return TrainConfig
+
+
+if __name__ == "__main__":
+    main()

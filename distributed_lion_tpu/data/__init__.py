@@ -1,0 +1,7 @@
+from distributed_lion_tpu.data.tokenizer import ByteTokenizer, load_tokenizer
+from distributed_lion_tpu.data.packing import group_texts, pack_token_stream
+from distributed_lion_tpu.data.sources import (
+    synthetic_lm_dataset,
+    tokens_from_text_files,
+    TokenDataset,
+)

@@ -1,0 +1,53 @@
+"""Fixed-block packing: the reference's ``group_texts`` pipeline.
+
+Semantic parity with /root/reference/run_clm.py:509-522: concatenate all
+tokenized documents, drop the remainder below a multiple of ``block_size``,
+and cut into contiguous blocks (labels == inputs; the shift happens in the
+loss). Fixed blocks ⇒ static shapes ⇒ one XLA compilation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+
+def group_texts(examples: Sequence[Sequence[int]], block_size: int) -> np.ndarray:
+    """Concatenate token lists and split into fixed blocks.
+
+    Mirrors run_clm.py:509-522 including the drop-remainder behavior
+    ("We drop the small remainder", run_clm.py:513).
+
+    Returns:
+        int32 array [n_blocks, block_size].
+    """
+    concat: List[int] = []
+    for ex in examples:
+        concat.extend(ex)
+    total = (len(concat) // block_size) * block_size
+    if total == 0:
+        return np.zeros((0, block_size), np.int32)
+    return np.asarray(concat[:total], np.int32).reshape(-1, block_size)
+
+
+def pack_token_stream(
+    token_iter: Iterable[Sequence[int]],
+    block_size: int,
+    buffer_blocks: int = 1024,
+) -> Iterator[np.ndarray]:
+    """Streaming variant: yields [block_size] blocks from an unbounded
+    document iterator with bounded memory (the reference's streaming path,
+    run_clm.py:337-352 + ConstantLengthDataset's infinite packing loop,
+    sft_llama2.py:122-137)."""
+    buf: List[int] = []
+    for ex in token_iter:
+        buf.extend(ex)
+        while len(buf) >= block_size * buffer_blocks:
+            chunk = np.asarray(buf[: block_size * buffer_blocks], np.int32)
+            del buf[: block_size * buffer_blocks]
+            yield from chunk.reshape(-1, block_size)
+    while len(buf) >= block_size:
+        chunk = np.asarray(buf[:block_size], np.int32)
+        del buf[:block_size]
+        yield chunk

@@ -1,0 +1,96 @@
+"""Token sources and the batch iterator feeding the train loop.
+
+The reference trains on HF-hub datasets (openwebtext, run_clm.py:316-381;
+stack-exchange-paired, sft_llama2.py:99-138). Zero-egress equivalents:
+
+- :func:`synthetic_lm_dataset` — a learnable synthetic language (Markov-ish
+  integer sequences) for tests/benchmarks;
+- :func:`tokens_from_text_files` — local text → ByteTokenizer/HF-cache →
+  ``group_texts`` blocks;
+- :class:`TokenDataset` — pre-tokenized ``.npy``/``.bin`` (uint16/uint32
+  memmap) block datasets, the standard offline-pretraining format.
+
+All produce [n, block] int32 arrays consumed by :func:`batch_iterator`,
+which handles epoch shuffling, per-worker sharding (each data-parallel rank
+sees a distinct shard — the reference gets this from HF Trainer's
+DistributedSampler), and drop-last batching.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distributed_lion_tpu.data.packing import group_texts
+from distributed_lion_tpu.data.tokenizer import load_tokenizer
+
+
+def synthetic_lm_dataset(
+    n_blocks: int, block_size: int, vocab_size: int, seed: int = 0
+) -> np.ndarray:
+    """Sequences with short-range structure (next ≈ prev + small noise mod V)
+    so a real LM's loss falls measurably below uniform."""
+    rng = np.random.default_rng(seed)
+    start = rng.integers(0, vocab_size, size=(n_blocks, 1))
+    steps = rng.integers(-2, 3, size=(n_blocks, block_size - 1))
+    toks = np.concatenate([start, steps], axis=1).cumsum(axis=1) % vocab_size
+    return toks.astype(np.int32)
+
+
+def tokens_from_text_files(
+    paths: Sequence[str | pathlib.Path],
+    block_size: int,
+    tokenizer_name: str | None = None,
+) -> np.ndarray:
+    tok = load_tokenizer(tokenizer_name)
+    docs = []
+    for p in paths:
+        text = pathlib.Path(p).read_text(encoding="utf-8", errors="replace")
+        docs.append(tok.encode(text, add_eos=True))
+    return group_texts(docs, block_size)
+
+
+@dataclass
+class TokenDataset:
+    """Memory-mapped pre-tokenized dataset cut into fixed blocks."""
+
+    blocks: np.ndarray  # [n, block_size] int32 (or memmap view)
+
+    @staticmethod
+    def from_bin(path: str | pathlib.Path, block_size: int, dtype=np.uint16) -> "TokenDataset":
+        flat = np.memmap(path, dtype=dtype, mode="r")
+        n = len(flat) // block_size
+        return TokenDataset(flat[: n * block_size].reshape(n, block_size))
+
+    @staticmethod
+    def from_npy(path: str | pathlib.Path) -> "TokenDataset":
+        return TokenDataset(np.load(path, mmap_mode="r"))
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+
+def batch_iterator(
+    blocks: np.ndarray,
+    global_batch: int,
+    *,
+    seed: int = 0,
+    epochs: int | None = None,
+    shuffle: bool = True,
+) -> Iterator[np.ndarray]:
+    """Yield [global_batch, block] int32 batches, reshuffled each epoch,
+    drop-last. ``epochs=None`` cycles forever (step-based training)."""
+    n = len(blocks)
+    if n < global_batch:
+        raise ValueError(f"dataset has {n} blocks < global batch {global_batch}")
+    rng = np.random.default_rng(seed)
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        for i in range(0, n - global_batch + 1, global_batch):
+            idx = order[i : i + global_batch]
+            yield np.ascontiguousarray(blocks[idx]).astype(np.int32)
+        epoch += 1

@@ -1,0 +1,75 @@
+"""Tokenizers.
+
+The reference borrows GPT-2's BPE / Llama's SP tokenizer from HF hub
+(/root/reference/run_clm.py:398-423). This environment is zero-egress, so:
+
+- :func:`load_tokenizer` uses a locally cached HF tokenizer when one exists
+  (``transformers`` is baked in; hub download is attempted only if a cache
+  is present);
+- :class:`ByteTokenizer` is the dependency-free fallback: 256 byte ids +
+  BOS/EOS/PAD, enough for real training runs on local text and for all
+  tests/benchmarks. Token-id space is model-config-driven either way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List
+
+
+@dataclass(frozen=True)
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..255 are bytes, then specials."""
+
+    bos_id: int = 256
+    eos_id: int = 257
+    pad_id: int = 258
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str, add_bos: bool = False, add_eos: bool = False) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_id]
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def load_tokenizer(name_or_path: str | None):
+    """Best-effort HF tokenizer from local cache; ByteTokenizer otherwise."""
+    if name_or_path:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(name_or_path, local_files_only=True)
+
+            class _HFAdapter:
+                vocab_size = int(tok.vocab_size)
+                eos_id = tok.eos_token_id if tok.eos_token_id is not None else 0
+                bos_id = tok.bos_token_id if tok.bos_token_id is not None else eos_id
+                pad_id = tok.pad_token_id if tok.pad_token_id is not None else eos_id
+
+                @staticmethod
+                def encode(text, add_bos=False, add_eos=False):
+                    ids = tok.encode(text, add_special_tokens=False)
+                    if add_bos:
+                        ids = [_HFAdapter.bos_id] + ids
+                    if add_eos:
+                        ids = ids + [_HFAdapter.eos_id]
+                    return ids
+
+                @staticmethod
+                def decode(ids):
+                    return tok.decode(list(ids))
+
+            return _HFAdapter()
+        except Exception:
+            pass
+    return ByteTokenizer()

@@ -1,0 +1,2 @@
+from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init, gpt2_apply
+from distributed_lion_tpu.models.loss import clm_loss_and_metrics

@@ -1,0 +1,183 @@
+"""GPT-2-class decoder transformer in pure JAX.
+
+The reference's pretraining workload is GPT-2 124M from HF hub
+(/root/reference/run_clm.py:425-444, README.md:21-23); here the model is our
+own implementation — pre-LN residual decoder with learned positional
+embeddings, GELU MLP, tied input/output embedding — designed for the MXU:
+
+- all matmuls batched and expressed as einsums XLA tiles onto the systolic
+  array; compute in bf16 with f32 accumulation (``preferred_element_type``);
+- static shapes everywhere (fixed block size, as the reference's fixed-block
+  ``group_texts`` packing guarantees, run_clm.py:509-522);
+- params as a plain nested dict pytree → optimizer/sharding/checkpoint code
+  stays generic.
+
+124M default config matches GPT-2 small: vocab 50257, 12 layers, 12 heads,
+d_model 768, context 1024.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    n_ctx: int = 1024
+    dropout: float = 0.0
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        """A test-sized config (for unit tests and the dryrun path)."""
+        base = dict(vocab_size=256, n_layer=2, n_head=4, d_model=64, n_ctx=128)
+        base.update(kw)
+        return GPT2Config(**base)
+
+    @staticmethod
+    def gpt2_124m(**kw) -> "GPT2Config":
+        return GPT2Config(**kw)
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape) * std).astype(dtype)
+
+
+def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
+    """Initialize parameters (GPT-2 init: N(0, 0.02), residual projections
+    scaled by 1/sqrt(2*n_layer) as in the original OpenAI scheme)."""
+    d, dt = cfg.d_model, cfg.param_dtype
+    std = 0.02
+    resid_std = std / math.sqrt(2 * cfg.n_layer)
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layer))
+
+    params: dict = {
+        "wte": _normal(next(keys), (cfg.vocab_size, d), std, dt),
+        "wpe": _normal(next(keys), (cfg.n_ctx, d), std, dt),
+        "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+        "blocks": [],
+    }
+    for _ in range(cfg.n_layer):
+        block = {
+            "ln_1": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+            "attn": {
+                "qkv": _normal(next(keys), (d, 3 * d), std, dt),
+                "qkv_b": jnp.zeros((3 * d,), dt),
+                "proj": _normal(next(keys), (d, d), resid_std, dt),
+                "proj_b": jnp.zeros((d,), dt),
+            },
+            "ln_2": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+            "mlp": {
+                "fc": _normal(next(keys), (d, 4 * d), std, dt),
+                "fc_b": jnp.zeros((4 * d,), dt),
+                "proj": _normal(next(keys), (4 * d, d), resid_std, dt),
+                "proj_b": jnp.zeros((d,), dt),
+            },
+        }
+        params["blocks"].append(block)
+    return params
+
+
+def _layer_norm(x, p, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate, key):
+    if rate == 0.0 or key is None:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+def _attention(x, p, cfg: GPT2Config, key):
+    """Causal multi-head attention; f32 softmax for stability."""
+    B, T, D = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    qkv = x @ p["qkv"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    probs = _dropout(probs, cfg.dropout, key)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, T, D)
+    return out @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+
+
+def _mlp(x, p):
+    h = x @ p["fc"].astype(x.dtype) + p["fc_b"].astype(x.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    return h @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+
+
+@partial(jax.checkpoint, static_argnums=(3,))
+def _block(x, p, key, cfg: GPT2Config):
+    """One pre-LN transformer block, rematerialized (jax.checkpoint) so
+    activations are recomputed in backward — HBM for FLOPs, the standard TPU
+    trade (task brief: use remat to trade FLOPs for memory)."""
+    k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
+    x = x + _dropout(_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1), cfg.dropout, k2)
+    x = x + _dropout(_mlp(_layer_norm(x, p["ln_2"]), p["mlp"]), cfg.dropout, k3)
+    return x
+
+
+def gpt2_apply(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: GPT2Config,
+    *,
+    dropout_key: Optional[jax.Array] = None,
+) -> jnp.ndarray:
+    """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
+
+    Output projection is tied to the input embedding (GPT-2 weight tying).
+    """
+    B, T = tokens.shape
+    if T > cfg.n_ctx:
+        raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+    x = params["wte"][tokens].astype(cfg.compute_dtype)
+    x = x + params["wpe"][:T].astype(cfg.compute_dtype)
+    keys = (
+        [None] * (cfg.n_layer + 1)
+        if dropout_key is None
+        else list(jax.random.split(dropout_key, cfg.n_layer + 1))
+    )
+    x = _dropout(x, cfg.dropout, keys[-1])
+    for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
+        x = _block(x, p, k, cfg)
+    x = _layer_norm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "btd,vd->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -1,0 +1,47 @@
+"""Causal-LM loss and eval metrics.
+
+Parity targets: HF's shift-by-one CLM cross entropy (the loss the reference's
+run_clm optimizes via AutoModelForCausalLM) and its eval metrics — argmax
+token accuracy computed on shifted labels (/root/reference/run_clm.py:562-577)
+and perplexity = exp(eval_loss) (:630-636, computed in train.eval).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def clm_loss_and_metrics(
+    logits: jnp.ndarray,
+    tokens: jnp.ndarray,
+    loss_mask: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Next-token cross entropy with shift-by-one labels.
+
+    Args:
+        logits: [B, T, V] float32.
+        tokens: [B, T] int32 — inputs; labels are ``tokens[:, 1:]``.
+        loss_mask: optional [B, T] bool/float; positions where the LABEL
+            (i.e. mask index 1..T-1) should count. Used by SFT completion-only
+            training and padding exclusion.
+
+    Returns:
+        (mean_loss, {"loss", "accuracy", "n_tokens"}) — accuracy is argmax
+        token accuracy on the shifted labels (run_clm.py:569-577 semantics).
+    """
+    shift_logits = logits[:, :-1]
+    shift_labels = tokens[:, 1:]
+    if loss_mask is None:
+        mask = jnp.ones(shift_labels.shape, jnp.float32)
+    else:
+        mask = loss_mask[:, 1:].astype(jnp.float32)
+
+    logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / n
+
+    pred = shift_logits.argmax(-1)
+    acc = ((pred == shift_labels) * mask).sum() / n
+    return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}

@@ -1,0 +1,46 @@
+"""Adapter: wrap any optax GradientTransformation as a FunctionalOptimizer.
+
+The reference's non-``--lion`` path is torch AdamW with hardcoded
+weight_decay=0.1 (/root/reference/run_clm.py:583-585); :func:`adamw` mirrors
+that default. Adapted optimizers have replicated state (no per-worker
+divergence), so under data parallelism the train loop psum-averages gradients
+first — the classic DDP contract the reference's AsyncTrainer suppresses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_lion_tpu.optim.lion import FunctionalOptimizer, Schedule
+
+
+class OptaxState(NamedTuple):
+    count: jnp.ndarray
+    inner: Any
+    rng: Optional[jax.Array]
+
+
+def from_optax(tx: optax.GradientTransformation) -> FunctionalOptimizer:
+    def init(params, rng=None):
+        return OptaxState(jnp.zeros((), jnp.int32), tx.init(params), rng)
+
+    def step(params, grads, state: OptaxState):
+        updates, inner = tx.update(grads, state.inner, params)
+        return optax.apply_updates(params, updates), OptaxState(state.count + 1, inner, state.rng)
+
+    return FunctionalOptimizer(init=init, step=step)
+
+
+def adamw(
+    learning_rate: Schedule = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.1,
+) -> FunctionalOptimizer:
+    """The reference's AdamW baseline (run_clm.py:583-585 — wd hardcoded 0.1)."""
+    return from_optax(optax.adamw(learning_rate, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay))

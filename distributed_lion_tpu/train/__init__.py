@@ -1,0 +1,5 @@
+from distributed_lion_tpu.train.schedule import (
+    cosine_schedule_with_warmup,
+    linear_schedule_with_warmup,
+    constant_schedule,
+)

@@ -1,0 +1,63 @@
+"""Metrics logging: stdout + JSONL + optional wandb.
+
+Replaces the reference's HF `trainer.log_metrics`/wandb reporting
+(/root/reference/run_clm.py:620-621, README.md:28). The reference calls
+``wandb.login`` with a hardcoded API credential (run_clm.py:58-59 — a leaked
+secret); here wandb activates ONLY when ``WANDB_API_KEY`` is present in the
+environment (env-var/netrc auth, never a literal key in code).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+from typing import Optional
+
+
+class MetricsLogger:
+    def __init__(self, output_dir: Optional[str] = None, run_name: str = "run",
+                 use_wandb: bool = False):
+        self.jsonl = None
+        if output_dir:
+            path = pathlib.Path(output_dir)
+            path.mkdir(parents=True, exist_ok=True)
+            self.jsonl = open(path / "metrics.jsonl", "a", buffering=1)
+        self.wandb = None
+        if use_wandb and os.environ.get("WANDB_API_KEY"):
+            try:
+                import wandb
+
+                wandb.init(project=os.environ.get("WANDB_PROJECT", "distributed-lion-tpu"),
+                           name=run_name)
+                self.wandb = wandb
+            except Exception as e:  # offline / not installed: degrade to local logs
+                print(f"[metrics] wandb unavailable ({e}); logging locally", file=sys.stderr)
+        self._t0 = time.time()
+
+    def log(self, step: int, metrics: dict, prefix: str = "train") -> None:
+        record = {"step": step, "elapsed_s": round(time.time() - self._t0, 3)}
+        sep = "/" if prefix else ""
+        record.update({f"{prefix}{sep}{k}": _scalar(v) for k, v in metrics.items()})
+        line = " ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                        for k, v in record.items())
+        print(line, flush=True)
+        if self.jsonl:
+            self.jsonl.write(json.dumps(record) + "\n")
+        if self.wandb:
+            self.wandb.log(record, step=step)
+
+    def close(self) -> None:
+        if self.jsonl:
+            self.jsonl.close()
+        if self.wandb:
+            self.wandb.finish()
+
+
+def _scalar(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v

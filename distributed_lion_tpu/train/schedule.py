@@ -1,0 +1,53 @@
+"""LR schedules.
+
+The reference pairs every optimizer with HF's
+``get_cosine_schedule_with_warmup`` (/root/reference/run_clm.py:582,
+sft_llama2.py:165, dpo_llama2.py:211; canonical config: 2k warmup of 100k
+steps, README.md:26-27). These are pure ``step -> multiplier·peak`` functions
+usable directly as the ``learning_rate`` of any optimizer here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def cosine_schedule_with_warmup(
+    peak_lr: float,
+    warmup_steps: int,
+    total_steps: int,
+    num_cycles: float = 0.5,
+    min_ratio: float = 0.0,
+):
+    """Bit-parity with transformers.get_cosine_schedule_with_warmup:
+    linear 0→peak over ``warmup_steps``, then cosine to ``min_ratio``·peak
+    over the remainder (num_cycles=0.5 → a single half-cosine to 0)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        progress = (step - warmup_steps) / jnp.maximum(1.0, total_steps - warmup_steps)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * num_cycles * 2.0 * progress))
+        mult = jnp.where(step < warmup_steps, warm, jnp.maximum(min_ratio, cos))
+        return peak_lr * mult
+
+    return schedule
+
+
+def linear_schedule_with_warmup(peak_lr: float, warmup_steps: int, total_steps: int):
+    """Parity with transformers.get_linear_schedule_with_warmup."""
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(1.0, warmup_steps)
+        decay = (total_steps - step) / jnp.maximum(1.0, total_steps - warmup_steps)
+        return peak_lr * jnp.where(step < warmup_steps, warm, jnp.maximum(0.0, decay))
+
+    return schedule
+
+
+def constant_schedule(peak_lr: float):
+    def schedule(step):
+        return jnp.full((), peak_lr, jnp.float32)
+
+    return schedule

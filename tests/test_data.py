@@ -1,0 +1,58 @@
+"""Data pipeline tests: group_texts parity (run_clm.py:509-522), streaming
+packing, batch iteration, tokenizer round-trip."""
+
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.data.packing import group_texts, pack_token_stream
+from distributed_lion_tpu.data.sources import batch_iterator, synthetic_lm_dataset
+from distributed_lion_tpu.data.tokenizer import ByteTokenizer
+
+
+def test_group_texts_drop_remainder():
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10]]  # 10 tokens, block 4 → 2 blocks
+    blocks = group_texts(docs, 4)
+    assert blocks.shape == (2, 4)
+    np.testing.assert_array_equal(blocks, [[1, 2, 3, 4], [5, 6, 7, 8]])  # 9,10 dropped
+
+
+def test_group_texts_empty_and_exact():
+    assert group_texts([[1]], 4).shape == (0, 4)
+    assert group_texts([[1, 2, 3, 4]], 4).shape == (1, 4)
+
+
+def test_pack_token_stream_matches_group_texts():
+    docs = [list(range(i, i + 7)) for i in range(0, 70, 7)]
+    streamed = np.stack(list(pack_token_stream(iter(docs), 8, buffer_blocks=2)))
+    np.testing.assert_array_equal(streamed, group_texts(docs, 8))
+
+
+def test_batch_iterator_shuffles_and_drops_last():
+    blocks = np.arange(70).reshape(10, 7).astype(np.int32)
+    it = batch_iterator(blocks, global_batch=4, seed=0, epochs=1)
+    batches = list(it)
+    assert len(batches) == 2  # 10 blocks / 4 → 2, last 2 dropped
+    first_epoch_rows = np.concatenate(batches)[:, 0] // 7
+    assert not np.array_equal(first_epoch_rows, np.arange(8)), "batches were not shuffled"
+    it2 = batch_iterator(blocks, global_batch=4, seed=0, epochs=2)
+    assert len(list(it2)) == 4
+
+
+def test_batch_iterator_rejects_small_dataset():
+    with pytest.raises(ValueError):
+        next(batch_iterator(np.zeros((2, 4), np.int32), global_batch=4))
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "Distributed Lion über TPU — 1-bit votes!"
+    ids = tok.encode(text, add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids) == text
+    assert tok.vocab_size == 259
+
+
+def test_synthetic_dataset_in_vocab():
+    blocks = synthetic_lm_dataset(8, 32, vocab_size=100)
+    assert blocks.shape == (8, 32)
+    assert blocks.min() >= 0 and blocks.max() < 100

@@ -1,0 +1,38 @@
+"""Schedule parity with transformers.get_cosine_schedule_with_warmup
+(the scheduler every reference entry point uses, run_clm.py:582)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_lion_tpu.train.schedule import (
+    constant_schedule,
+    cosine_schedule_with_warmup,
+    linear_schedule_with_warmup,
+)
+
+
+def _hf_cosine(step, warmup, total, num_cycles=0.5):
+    if step < warmup:
+        return step / max(1, warmup)
+    progress = (step - warmup) / max(1, total - warmup)
+    return max(0.0, 0.5 * (1.0 + math.cos(math.pi * num_cycles * 2.0 * progress)))
+
+
+def test_cosine_matches_hf_formula():
+    peak, warmup, total = 1e-4, 2000, 100_000  # canonical config README.md:25-27
+    sched = cosine_schedule_with_warmup(peak, warmup, total)
+    for step in [0, 1, 100, 1999, 2000, 2001, 50_000, 99_999, 100_000]:
+        np.testing.assert_allclose(
+            float(sched(jnp.asarray(step))), peak * _hf_cosine(step, warmup, total),
+            rtol=1e-5, atol=1e-9, err_msg=f"step={step}",
+        )
+
+
+def test_linear_and_constant():
+    lin = linear_schedule_with_warmup(1.0, 10, 110)
+    assert float(lin(5)) == 0.5
+    np.testing.assert_allclose(float(lin(60)), 0.5, rtol=1e-6)
+    assert float(lin(110)) == 0.0
+    assert float(constant_schedule(0.3)(12345)) == np.float32(0.3)

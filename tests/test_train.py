@@ -1,0 +1,141 @@
+"""End-to-end train-loop tests on 8 virtual devices (SURVEY §4 integration):
+loss goes down under vote-Lion; non-async AdamW path works; checkpoint
+save/resume is exact; CLI smoke."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.data.sources import batch_iterator, synthetic_lm_dataset
+from distributed_lion_tpu.models.gpt2 import GPT2Config
+from distributed_lion_tpu.parallel import make_mesh
+from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        lion=True,
+        async_grad=True,
+        learning_rate=3e-3,
+        weight_decay=0.0,
+        warmup_steps=5,
+        max_steps=40,
+        per_device_train_batch_size=2,
+        gradient_accumulation_steps=2,
+        per_device_eval_batch_size=2,
+        block_size=32,
+        logging_steps=10,
+        eval_steps=1000,
+        save_steps=1000,
+        eval_iters=2,
+        seed=0,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _run(cfg, steps=40, model_kw=None, mesh=None):
+    mesh = mesh or make_mesh(data=8)
+    model_cfg = GPT2Config.tiny(**(model_kw or {}))
+    trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
+    blocks = synthetic_lm_dataset(512, cfg.block_size, model_cfg.vocab_size)
+    it = batch_iterator(blocks, trainer.global_train_batch(), seed=0)
+    history = trainer.train(it, max_steps=steps)
+    trainer.close()
+    return trainer, history, blocks
+
+
+def test_loss_decreases_under_vote_lion():
+    cfg = _tiny_cfg()
+    trainer, history, _ = _run(cfg)
+    losses = [h["loss"] for h in history if "loss" in h]
+    assert losses[-1] < losses[0] - 0.3, f"loss did not fall: {losses}"
+
+
+def test_adamw_non_async_path():
+    cfg = _tiny_cfg(lion=False, async_grad=False, learning_rate=1e-3)
+    trainer, history, _ = _run(cfg, steps=20)
+    losses = [h["loss"] for h in history if "loss" in h]
+    assert losses[-1] < losses[0]
+
+
+def test_lion_non_async_path():
+    """--lion without --async_grad: DDP-style pmean'd grads feeding the vote
+    (unanimous since all workers agree) — regression for a stacked-momentum
+    shape bug in this branch."""
+    cfg = _tiny_cfg(async_grad=False)
+    trainer, history, _ = _run(cfg, steps=20)
+    losses = [h["loss"] for h in history if "loss" in h]
+    assert losses[-1] < losses[0]
+    # params must keep their original rank (no spurious leading axis)
+    assert trainer.params["wte"].ndim == 2
+
+
+def test_async_without_lion_refused():
+    with pytest.raises(ValueError):
+        _run(_tiny_cfg(lion=False, async_grad=True), steps=1)
+
+
+def test_eval_reports_perplexity():
+    cfg = _tiny_cfg()
+    trainer, _, blocks = _run(cfg, steps=10)
+    # re-open trainer state is closed; evaluate directly on a fresh trainer
+    mesh = make_mesh(data=8)
+    t2 = Trainer.for_gpt2(cfg, mesh, GPT2Config.tiny())
+    m = t2.evaluate(blocks[:64])
+    assert np.isfinite(m["eval/loss"])
+    np.testing.assert_allclose(m["eval/perplexity"], np.exp(m["eval/loss"]), rtol=1e-5)
+    t2.close()
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    """Train 10 steps, checkpoint, resume into a fresh trainer → parameters
+    and per-worker momentum match a continuous 20-step run exactly."""
+    mesh = make_mesh(data=8)
+    model_cfg = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(512, 32, model_cfg.vocab_size)
+
+    # continuous run: 20 steps
+    cfg_c = _tiny_cfg(max_steps=20)
+    t_cont = Trainer.for_gpt2(cfg_c, mesh, model_cfg)
+    it = batch_iterator(blocks, t_cont.global_train_batch(), seed=9)
+    t_cont.train(it, max_steps=20)
+
+    # checkpointed run: 10 steps, save, new trainer resumes, 10 more
+    cfg_a = _tiny_cfg(max_steps=20, output_dir=str(tmp_path / "run"), save_steps=10**9)
+    t1 = Trainer.for_gpt2(cfg_a, mesh, model_cfg)
+    it1 = batch_iterator(blocks, t1.global_train_batch(), seed=9)
+    t1.train(it1, max_steps=10)
+    t1.save()
+    t1.close()
+
+    t2 = Trainer.for_gpt2(cfg_a, mesh, model_cfg)
+    assert t2.step_count == 10, "did not resume from checkpoint"
+    # fresh iterator, same seed: the trainer fast-forwards past consumed batches
+    it2 = batch_iterator(blocks, t2.global_train_batch(), seed=9)
+    t2.train(it2, max_steps=10)
+
+    for a, b in zip(jax.tree.leaves(t_cont.params), jax.tree.leaves(t2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(t_cont.state.exp_avg), jax.tree.leaves(t2.state.exp_avg)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t2.close()
+    t_cont.close()
+
+
+def test_cli_smoke(tmp_path, capsys):
+    from distributed_lion_tpu.cli.run_clm import main
+
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--synthetic_blocks", "256",
+        "--lion", "--async_grad", "--max_steps", "5", "--warmup_steps", "1",
+        "--per_device_train_batch_size", "1", "--gradient_accumulation_steps", "1",
+        "--block_size", "32", "--logging_steps", "1", "--eval_steps", "1000",
+        "--save_steps", "1000", "--eval_iters", "1",
+        "--output_dir", str(tmp_path / "cli_out"),
+    ])
+    out = capsys.readouterr().out
+    assert "loss" in out
+    assert (tmp_path / "cli_out" / "metrics.jsonl").exists()
