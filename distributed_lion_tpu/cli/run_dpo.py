@@ -1,0 +1,158 @@
+"""DPO entry point — the INTENDED workload of the reference's broken
+``dpo_llama2.py`` (/root/reference/dpo_llama2.py; syntax error at :81 and
+undefined ``base_model`` at :210-213 make it unrunnable — SURVEY §2.10).
+
+Pieces mapped:
+- policy + frozen reference model, both from the SFT checkpoint (:133-152)
+  → ``--sft_checkpoint`` loads a merged .npz (from run_sft --merged_output);
+  both start identical, the ref stays frozen (optionally quantized);
+- β=0.1 pairwise loss (:25, :223) → train/dpo.make_dpo_loss_fn;
+- prompt/chosen/rejected prep + length filter (:84-125, :158-168)
+  → data/dpo.prepare_dpo_batch (max_length 1024, max_prompt_length 512);
+- --sanity_check (:62) truncates to 1000 pairs;
+- LoRA on the policy (:192-207) with the reference's wider target set;
+- --lion/--async_grad optimizer wiring (:209-231).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DPOArguments:
+    """dpo_llama2.py ScriptArguments (:18-81), repaired."""
+
+    model_name: str = "llama2_7b"  # llama2_7b | llama3_8b | tiny
+    dataset: str = "synthetic"     # synthetic | jsonl:<path>
+    sft_checkpoint: Optional[str] = None  # merged .npz from run_sft
+    beta: float = 0.1
+    max_length: int = 1024
+    max_prompt_length: int = 512
+    num_train_samples: int = 512
+    size_valid_set: int = 64
+    sanity_check: bool = False
+    quant_ref: str = "none"        # none | int8 | nf4 — frozen ref model
+    lora_r: int = 8
+    lora_alpha: int = 16
+    tokenizer_name: Optional[str] = None
+    merged_output: Optional[str] = None
+
+
+def main(argv=None):
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    script_args, train_cfg = parse_dataclasses((DPOArguments, _train_cfg_cls()), argv)
+
+    import jax
+    import numpy as np
+
+    from distributed_lion_tpu.cli.run_clm import build_mesh
+    from distributed_lion_tpu.data.dpo import dpo_batch_iterator, prepare_dpo_batch
+    from distributed_lion_tpu.data.sft import load_pairs_jsonl, synthetic_qa_pairs
+    from distributed_lion_tpu.data.tokenizer import load_tokenizer
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
+    from distributed_lion_tpu.models.lora import LoraConfig, lora_apply_fn, lora_init, merge_lora
+    from distributed_lion_tpu.ops.quant import dequantize_tree, quantize_tree
+    from distributed_lion_tpu.train.dpo import make_dpo_loss_fn
+    from distributed_lion_tpu.train.loop import Trainer
+    from distributed_lion_tpu.utils.serialization import load_pytree, save_pytree
+
+    mesh = build_mesh()
+    tok = load_tokenizer(script_args.tokenizer_name)
+
+    model_ctor = {
+        "tiny": LlamaConfig.tiny,
+        "llama2_7b": LlamaConfig.llama2_7b,
+        "llama3_8b": LlamaConfig.llama3_8b,
+    }[script_args.model_name]
+    model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+    if script_args.max_length > model_cfg.n_ctx:
+        script_args.max_length = model_cfg.n_ctx
+    train_cfg.block_size = script_args.max_length
+
+    # Policy and reference both start from the SFT model (dpo_llama2.py:133-152).
+    if script_args.sft_checkpoint:
+        import jax.numpy as jnp
+
+        base_params = load_pytree(script_args.sft_checkpoint)
+        # npz leaves are numpy; move to device arrays (traced indexing needs
+        # jax arrays) and normalize float dtypes to the model's param dtype
+        base_params = jax.tree.map(
+            lambda x: jnp.asarray(
+                x, model_cfg.param_dtype
+                if np.issubdtype(np.asarray(x).dtype, np.floating) else None
+            ),
+            base_params,
+        )
+        print(f"[run_dpo] loaded SFT model from {script_args.sft_checkpoint}")
+    else:
+        print("[run_dpo] no --sft_checkpoint given; starting from fresh init")
+        base_params = llama_init(jax.random.key(train_cfg.seed), model_cfg)
+
+    ref_params = base_params
+    if script_args.quant_ref != "none":
+        ref_params = quantize_tree(base_params, script_args.quant_ref)
+
+    # LoRA on the policy, the reference's wider DPO target set (:192-207).
+    lora_cfg = LoraConfig(
+        r=script_args.lora_r, alpha=script_args.lora_alpha,
+        target_patterns=("wq", "wk", "wv", "wo", "q_proj", "k_proj", "v_proj", "out_proj"),
+    )
+    adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
+
+    policy_apply_lora = lora_apply_fn(
+        lambda p, t: llama_apply(p, t, model_cfg), base_params, lora_cfg
+    )
+    loss_fn = make_dpo_loss_fn(
+        policy_apply=policy_apply_lora,
+        ref_apply=lambda t: llama_apply(ref_params, t, model_cfg),
+        beta=script_args.beta,
+    )
+
+    if script_args.dataset == "synthetic":
+        records = synthetic_qa_pairs(script_args.num_train_samples + script_args.size_valid_set)
+    elif script_args.dataset.startswith("jsonl:"):
+        train_recs, _ = load_pairs_jsonl(script_args.dataset[len("jsonl:"):])
+        records = train_recs
+    else:
+        raise ValueError(f"unknown dataset spec {script_args.dataset!r}")
+
+    data = prepare_dpo_batch(
+        records, tok,
+        max_length=script_args.max_length,
+        max_prompt_length=script_args.max_prompt_length,
+        sanity_check=script_args.sanity_check,
+    )
+    n = len(data["chosen"])
+    n_valid = min(script_args.size_valid_set, n // 4)
+    eval_data = {k: v[:n_valid] for k, v in data.items()} if n_valid else None
+    train_data = {k: v[n_valid:] for k, v in data.items()}
+    print(f"[run_dpo] {len(train_data['chosen'])} train / {n_valid} eval pairs "
+          f"(after length filtering)")
+
+    trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters, loss_fn=loss_fn)
+    it = dpo_batch_iterator(train_data, trainer.global_train_batch(), seed=train_cfg.seed)
+    try:
+        trainer.train(it, eval_blocks=eval_data)
+        if eval_data is not None:
+            trainer.evaluate(eval_data)
+        if trainer.checkpointer:
+            trainer.save()
+        if script_args.merged_output:
+            merged = dequantize_tree(merge_lora(base_params, trainer.params, lora_cfg))
+            save_pytree(script_args.merged_output, merged)
+            print(f"[run_dpo] merged policy saved to {script_args.merged_output}")
+    finally:
+        trainer.close()
+
+
+def _train_cfg_cls():
+    from distributed_lion_tpu.train.loop import TrainConfig
+
+    return TrainConfig
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""DPO data prep: prompt/chosen/rejected triples with completion masks.
+
+Intended semantics of the reference's (broken) dpo_llama2.py:
+- prompt template "Question: ...\\n\\nAnswer: " (:84-125, return_prompt_and_responses);
+- records come from stack-exchange-paired with response_j (chosen) /
+  response_k (rejected);
+- length filtering: drop samples where prompt+response exceeds max_length or
+  prompt exceeds max_prompt_length (:158-168; defaults 1024/512, :51-52);
+- sanity_check truncation to 1000 samples (:62, :110-111).
+
+Output: fixed-shape [N, max_length] int32 token arrays + bool masks over
+completion tokens (prompt and padding excluded from the DPO logprobs).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def return_prompt_and_responses(sample: dict) -> dict:
+    """dpo_llama2.py:91-103 template."""
+    return {
+        "prompt": f"Question: {sample['question']}\n\nAnswer: ",
+        "chosen": sample["response_j"],
+        "rejected": sample["response_k"],
+    }
+
+
+def prepare_dpo_batch(
+    records: Sequence[dict],
+    tokenizer,
+    *,
+    max_length: int = 1024,
+    max_prompt_length: int = 512,
+    sanity_check: bool = False,
+) -> dict:
+    """Tokenize + length-filter + pad to fixed shapes.
+
+    Returns {"chosen", "rejected": [N, max_length] int32,
+             "chosen_mask", "rejected_mask": [N, max_length] bool}.
+    """
+    if sanity_check:  # dpo_llama2.py:110-111
+        records = list(records)[:1000]
+    pad = getattr(tokenizer, "pad_id", 0)
+    eos = getattr(tokenizer, "eos_id", 0)
+
+    rows = {"chosen": [], "rejected": [], "chosen_mask": [], "rejected_mask": []}
+    for rec in records:
+        trip = return_prompt_and_responses(rec)
+        p_ids = tokenizer.encode(trip["prompt"])
+        if len(p_ids) > max_prompt_length:  # dpo_llama2.py:158-168
+            continue
+        keep = True
+        encoded = {}
+        for side in ("chosen", "rejected"):
+            r_ids = tokenizer.encode(trip[side]) + [eos]
+            if len(p_ids) + len(r_ids) > max_length:
+                keep = False
+                break
+            ids = p_ids + r_ids
+            mask = [False] * len(p_ids) + [True] * len(r_ids)
+            ids = ids + [pad] * (max_length - len(ids))
+            mask = mask + [False] * (max_length - len(mask))
+            encoded[side] = (ids, mask)
+        if not keep:
+            continue
+        for side in ("chosen", "rejected"):
+            ids, mask = encoded[side]
+            rows[side].append(ids)
+            rows[f"{side}_mask"].append(mask)
+
+    if not rows["chosen"]:
+        raise ValueError("no DPO samples survived length filtering")
+    return {
+        "chosen": np.asarray(rows["chosen"], np.int32),
+        "rejected": np.asarray(rows["rejected"], np.int32),
+        "chosen_mask": np.asarray(rows["chosen_mask"], bool),
+        "rejected_mask": np.asarray(rows["rejected_mask"], bool),
+    }
+
+
+def dpo_batch_iterator(batch_data: dict, global_batch: int, *, seed: int = 0):
+    """Shuffle-and-cycle iterator over the fixed-shape DPO arrays, yielding
+    pytree batches for the Trainer."""
+    n = len(batch_data["chosen"])
+    if n < global_batch:
+        raise ValueError(f"{n} DPO pairs < global batch {global_batch}")
+    rng = np.random.default_rng(seed)
+    while True:
+        order = rng.permutation(n)
+        for i in range(0, n - global_batch + 1, global_batch):
+            idx = order[i : i + global_batch]
+            yield {k: np.ascontiguousarray(v[idx]) for k, v in batch_data.items()}

@@ -1,0 +1,108 @@
+"""SFT data pipeline: prompt formatting, token-ratio estimation, and
+constant-length packing.
+
+Capability parity with the reference's SFT data path
+(/root/reference/sft_llama2.py):
+
+- :func:`prepare_sample_text` — the "Question:/Answer:" template (:93-96);
+- :func:`chars_token_ratio` — estimate chars/token over ~400 samples (:62-75);
+- :func:`constant_length_batches` — TRL ConstantLengthDataset semantics
+  (:122-137): fill a char-budget buffer, tokenize, append EOS, concatenate,
+  cut fixed seq_length blocks, loop infinitely;
+- :func:`load_pairs_jsonl` — zero-egress stand-in for streaming
+  ``lvwerra/stack-exchange-paired`` (:99-121): local JSONL with
+  question/response fields, take/skip train-eval split.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Iterable, Iterator, List, Sequence
+
+import numpy as np
+
+from distributed_lion_tpu.data.packing import pack_token_stream
+
+
+def prepare_sample_text(example: dict) -> str:
+    """sft_llama2.py:93-96 verbatim template."""
+    return f"Question: {example['question']}\n\nAnswer: {example['response_j']}"
+
+
+def chars_token_ratio(samples: Sequence[dict], tokenizer, nb_examples: int = 400) -> float:
+    """sft_llama2.py:62-75: total chars / total tokens over the first
+    ``nb_examples`` samples."""
+    total_chars, total_tokens = 0, 0
+    for example in list(samples)[:nb_examples]:
+        text = prepare_sample_text(example)
+        total_chars += len(text)
+        total_tokens += len(tokenizer.encode(text))
+    return total_chars / max(total_tokens, 1)
+
+
+def load_pairs_jsonl(path: str | pathlib.Path, *, size_valid_set: int = 0) -> tuple:
+    """Load {"question", "response_j", ...} records; split off the first
+    ``size_valid_set`` as validation (the reference's streaming
+    take/skip split, sft_llama2.py:104-117)."""
+    records: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    valid = records[:size_valid_set]
+    train = records[size_valid_set:]
+    return train, valid
+
+
+def synthetic_qa_pairs(n: int, seed: int = 0) -> List[dict]:
+    """Learnable synthetic Q/A corpus for tests and offline smoke runs."""
+    rng = np.random.default_rng(seed)
+    ops = [("plus", lambda a, b: a + b), ("times", lambda a, b: a * b)]
+    out = []
+    for _ in range(n):
+        a, b = int(rng.integers(0, 50)), int(rng.integers(0, 50))
+        name, fn = ops[int(rng.integers(0, len(ops)))]
+        out.append({
+            "question": f"What is {a} {name} {b}?",
+            "response_j": f"The answer is {fn(a, b)}.",
+            "response_k": f"The answer is {fn(a, b) + int(rng.integers(1, 7))}.",
+        })
+    return out
+
+
+def constant_length_batches(
+    samples: Iterable[dict],
+    tokenizer,
+    seq_length: int = 1024,
+    *,
+    infinite: bool = True,
+    format_fn=prepare_sample_text,
+    chars_per_token: float = 3.6,
+    num_sequences_buffer: int = 1024,
+) -> Iterator[np.ndarray]:
+    """Yield [seq_length] int32 sequences, TRL ConstantLengthDataset-style:
+    format + tokenize each sample, EOS-join, concatenate, cut fixed blocks;
+    when ``infinite``, restart the sample iterator forever (sft_llama2.py's
+    infinite packing loop, :122-137).
+
+    Built on :func:`~distributed_lion_tpu.data.packing.pack_token_stream`, so
+    finite mode drains every sample. ``chars_per_token`` is accepted for API
+    parity with the reference (which uses it to size a char-budget buffer,
+    :130); tokenizing lazily makes the heuristic unnecessary here.
+    """
+    del chars_per_token
+    samples = list(samples)
+    if not samples:
+        raise ValueError("no SFT samples")
+    eos = getattr(tokenizer, "eos_id", 0)
+
+    def token_iter():
+        while True:
+            for s in samples:
+                yield tokenizer.encode(format_fn(s)) + [eos]
+            if not infinite:
+                return
+
+    yield from pack_token_stream(token_iter(), seq_length, buffer_blocks=num_sequences_buffer)

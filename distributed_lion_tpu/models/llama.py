@@ -1,0 +1,182 @@
+"""Llama-class decoder transformer in pure JAX.
+
+The reference's SFT/DPO workloads run Llama-2-7B from HF hub
+(/root/reference/sft_llama2.py:141-154, dpo_llama2.py:133-152); here the
+architecture is our own implementation — RMSNorm, rotary position embeddings,
+SwiGLU MLP, grouped-query attention, no biases, separate (untied) LM head —
+covering Llama-2/-3-style configs. TPU-first like gpt2.py: bf16 compute with
+f32 accumulation/softmax, static shapes, per-block rematerialization.
+
+Frozen-base quantization (the reference's QLoRA 4-bit path) plugs in via
+``ops.quant``: any weight leaf may be a QuantizedTensor and ``_matmul``
+dequantizes on the fly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_lion_tpu.ops.quant import maybe_dequant
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 32          # < n_head → grouped-query attention
+    d_model: int = 4096
+    d_ff: int = 11008
+    n_ctx: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=256, n_layer=2, n_head=4, n_kv_head=2,
+                    d_model=64, d_ff=128, n_ctx=128)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        base = dict(vocab_size=128256, n_layer=32, n_head=32, n_kv_head=8,
+                    d_model=4096, d_ff=14336, n_ctx=8192, rope_theta=500000.0)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape) * std).astype(dtype)
+
+
+def llama_init(key: jax.Array, cfg: LlamaConfig) -> dict:
+    d, dt = cfg.d_model, cfg.param_dtype
+    hd, nh, nkv = cfg.head_dim, cfg.n_head, cfg.n_kv_head
+    std = 0.02
+    keys = iter(jax.random.split(key, 2 + 7 * cfg.n_layer))
+    params: dict = {
+        "wte": _normal(next(keys), (cfg.vocab_size, d), std, dt),
+        "lm_head": _normal(next(keys), (d, cfg.vocab_size), std, dt),
+        "ln_f": {"scale": jnp.ones((d,), dt)},
+        "blocks": [],
+    }
+    for _ in range(cfg.n_layer):
+        params["blocks"].append({
+            "ln_attn": {"scale": jnp.ones((d,), dt)},
+            "attn": {
+                "wq": _normal(next(keys), (d, nh * hd), std, dt),
+                "wk": _normal(next(keys), (d, nkv * hd), std, dt),
+                "wv": _normal(next(keys), (d, nkv * hd), std, dt),
+                "wo": _normal(next(keys), (nh * hd, d), std / math.sqrt(2 * cfg.n_layer), dt),
+            },
+            "ln_mlp": {"scale": jnp.ones((d,), dt)},
+            "mlp": {
+                "w_gate": _normal(next(keys), (d, cfg.d_ff), std, dt),
+                "w_up": _normal(next(keys), (d, cfg.d_ff), std, dt),
+                "w_down": _normal(next(keys), (cfg.d_ff, d), std / math.sqrt(2 * cfg.n_layer), dt),
+            },
+        })
+    return params
+
+
+def _rms_norm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (x32 * scale * p["scale"].astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_angles(t: int, head_dim: int, theta: float, offset: int = 0) -> tuple:
+    """cos/sin tables [T, head_dim/2] (f32)."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
+    pos = jnp.arange(offset, offset + t, dtype=jnp.float32)
+    ang = jnp.outer(pos, inv_freq)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x: [B, H, T, hd]; rotate pairs (even, odd) — the interleaved
+    formulation."""
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    c = cos[None, None, :, :].astype(x.dtype)
+    s = sin[None, None, :, :].astype(x.dtype)
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _matmul(x, w):
+    w = maybe_dequant(w, x.dtype)
+    return x @ w.astype(x.dtype)
+
+
+def _attention(x, p, cfg: LlamaConfig, cos, sin):
+    B, T, D = x.shape
+    H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q = _matmul(x, p["wq"]).reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    k = _matmul(x, p["wk"]).reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
+    v = _matmul(x, p["wv"]).reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if KV != H:  # GQA: repeat kv heads
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(hd)
+    causal = jnp.tril(jnp.ones((T, T), bool))
+    scores = jnp.where(causal, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
+    out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+    return _matmul(out, p["wo"])
+
+
+def _mlp(x, p):
+    gate = jax.nn.silu(_matmul(x, p["w_gate"]))
+    return _matmul(gate * _matmul(x, p["w_up"]), p["w_down"])
+
+
+@partial(jax.checkpoint, static_argnums=(2,))
+def _block(x, p, cfg: LlamaConfig, cos, sin):
+    x = x + _attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"], cfg, cos, sin)
+    x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
+    return x
+
+
+def llama_apply(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    *,
+    dropout_key: Optional[jax.Array] = None,  # parity arg; Llama uses none
+) -> jnp.ndarray:
+    """int32 tokens [B, T] → f32 logits [B, T, vocab]."""
+    B, T = tokens.shape
+    if T > cfg.n_ctx:
+        raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
+    cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta)
+    for p in params["blocks"]:
+        x = _block(x, p, cfg, cos, sin)
+    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    return jnp.einsum(
+        "btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )

@@ -1,0 +1,137 @@
+"""LoRA adapters over frozen (optionally quantized) base weights.
+
+The reference applies PEFT LoRA on Llama-2's q/v projections with r=8, α=16,
+dropout 0.05 (/root/reference/sft_llama2.py:44-51) and a wider target set for
+DPO (q/v/k/out_proj + fc_in/fc_out/wte, dpo_llama2.py:192-207), then merges
+adapters into the base on save (sft_llama2.py:193-199 ``merge_and_unload``).
+
+Native design: adapters live in a SEPARATE flat dict keyed by the adapted
+leaf's '/'-joined path, each entry {"A": [d_in, r], "B": [r, d_out]}. The
+model apply stays untouched — :func:`lora_apply_fn` wraps any base ``apply``
+by materializing ``W + (α/r)·A@B`` per adapted leaf before the call; XLA
+fuses the rank-r update into the surrounding graph. Training differentiates ONLY
+the adapter tree, so the optimizer (and its vote) sees just the LoRA params —
+the base stays frozen/quantized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_lion_tpu.ops.quant import QuantizedTensor, maybe_dequant
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """sft_llama2.py:44-51 defaults: r=8, alpha=16, dropout 0.05 (dropout is
+    applied at the data level here; adapter dropout is rarely load-bearing),
+    targets q/v projections."""
+
+    r: int = 8
+    alpha: int = 16
+    target_patterns: Sequence[str] = ("wq", "wv", "q_proj", "v_proj", "qkv")
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.r
+
+
+def _is_weight_leaf(x) -> bool:
+    return isinstance(x, QuantizedTensor) or getattr(x, "ndim", 0) == 2
+
+
+def _iter_paths(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _iter_paths(v, prefix + (str(k),))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _iter_paths(v, prefix + (str(i),))
+    else:
+        yield prefix, tree
+
+
+def lora_init(key: jax.Array, base_params: Any, cfg: LoraConfig,
+              dtype=jnp.float32) -> dict:
+    """Build the adapter pytree: {'/'-joined path: {"A", "B"}} for every 2-D
+    base leaf whose last path component matches a target pattern.
+
+    A ~ N(0, 1/r), B = 0 (standard LoRA init: adapter starts as identity).
+    """
+    adapters = {}
+    paths = [
+        (path, leaf) for path, leaf in _iter_paths(base_params)
+        if _is_weight_leaf(leaf) and any(re.fullmatch(p, path[-1]) for p in cfg.target_patterns)
+    ]
+    keys = jax.random.split(key, max(len(paths), 1))
+    for k, (path, leaf) in zip(keys, paths):
+        shape = leaf.shape
+        d_in, d_out = int(shape[0]), int(shape[1])
+        adapters["/".join(path)] = {
+            "A": (jax.random.normal(k, (d_in, cfg.r)) / jnp.sqrt(cfg.r)).astype(dtype),
+            "B": jnp.zeros((cfg.r, d_out), dtype),
+        }
+    if not adapters:
+        raise ValueError(f"no base weights matched LoRA targets {cfg.target_patterns}")
+    return adapters
+
+
+def _tree_get(tree, path):
+    node = tree
+    for p in path:
+        node = node[int(p)] if isinstance(node, (list, tuple)) else node[p]
+    return node
+
+
+def _tree_set(tree, path, value):
+    node = tree
+    for p in path[:-1]:
+        node = node[int(p)] if isinstance(node, (list, tuple)) else node[p]
+    last = path[-1]
+    if isinstance(node, (list, tuple)):
+        node[int(last)] = value
+    else:
+        node[last] = value
+
+
+def _copy_tree(tree):
+    if isinstance(tree, dict):
+        return {k: _copy_tree(v) for k, v in tree.items()}
+    if isinstance(tree, list):
+        return [_copy_tree(v) for v in tree]
+    return tree  # leaves shared by reference — merge replaces, never mutates
+
+
+def merge_lora(base_params: Any, adapters: dict, cfg: LoraConfig,
+               dequant_dtype=jnp.float32) -> Any:
+    """W' = W + (α/r)·A@B per adapted leaf (PEFT ``merge_and_unload``,
+    sft_llama2.py:197-199). Quantized bases are dequantized dense first."""
+    merged = _copy_tree(base_params)
+    for path_str, ab in adapters.items():
+        path = tuple(path_str.split("/"))
+        w = maybe_dequant(_tree_get(base_params, path), dequant_dtype)
+        delta = (ab["A"] @ ab["B"]) * cfg.scaling
+        _tree_set(merged, path, (w + delta.astype(w.dtype)))
+    return merged
+
+
+def lora_apply_fn(base_apply: Callable, base_params: Any, cfg: LoraConfig) -> Callable:
+    """Wrap ``base_apply(params, tokens, **kw)`` into
+    ``apply(adapters, tokens, **kw)`` over the frozen base.
+
+    The merged weight is formed inside the traced function, so the rank-r
+    update differentiates only w.r.t. the adapters; the base (captured as a
+    constant, possibly quantized) gets no gradient.
+    """
+
+    def apply(adapters, tokens, *args, **kwargs):
+        effective = merge_lora(base_params, adapters, cfg,
+                               dequant_dtype=jnp.bfloat16)
+        return base_apply(effective, tokens, *args, **kwargs)
+
+    return apply

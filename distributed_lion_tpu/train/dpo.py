@@ -1,0 +1,67 @@
+"""DPO: direct preference optimization loss over policy + frozen reference.
+
+The reference's DPO entry point is broken as shipped (syntax error at
+dpo_llama2.py:81, undefined ``base_model`` at :210-213 — SURVEY §2.10); this
+implements the INTENDED workload: policy and frozen reference model score
+(prompt, chosen) and (prompt, rejected); the loss is
+
+    -log σ(β · [(logπ_c − logπ_r) − (logref_c − logref_r)])
+
+with β=0.1 (dpo_llama2.py:25, :223). Batches are pytrees
+{"chosen", "rejected", "chosen_mask", "rejected_mask"} of [B, T] arrays,
+masks selecting completion tokens only (prompt excluded, padding excluded),
+produced by data/dpo.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def sequence_logprob(logits: jnp.ndarray, tokens: jnp.ndarray,
+                     mask: jnp.ndarray) -> jnp.ndarray:
+    """Sum of label log-probs over masked (completion) positions, [B]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)[..., 0]
+    return (ll * mask[:, 1:].astype(jnp.float32)).sum(-1)
+
+
+def make_dpo_loss_fn(
+    policy_apply: Callable,
+    ref_apply: Callable,
+    beta: float = 0.1,
+) -> Callable:
+    """Build ``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` for
+    the Trainer. ``policy_apply(params, tokens)`` and ``ref_apply(tokens)``
+    (ref params are frozen/closed-over, mirroring the reference's separate
+    4-bit ref model, dpo_llama2.py:146-152)."""
+
+    def loss_fn(params, batch, dropout_key):
+        del dropout_key
+        pol_c = sequence_logprob(policy_apply(params, batch["chosen"]),
+                                 batch["chosen"], batch["chosen_mask"])
+        pol_r = sequence_logprob(policy_apply(params, batch["rejected"]),
+                                 batch["rejected"], batch["rejected_mask"])
+        ref_c = sequence_logprob(ref_apply(batch["chosen"]),
+                                 batch["chosen"], batch["chosen_mask"])
+        ref_r = sequence_logprob(ref_apply(batch["rejected"]),
+                                 batch["rejected"], batch["rejected_mask"])
+        # stop_gradient is belt-and-braces: ref_apply takes no params arg.
+        ref_c = jax.lax.stop_gradient(ref_c)
+        ref_r = jax.lax.stop_gradient(ref_r)
+
+        logits = beta * ((pol_c - pol_r) - (ref_c - ref_r))
+        loss = -jax.nn.log_sigmoid(logits).mean()
+        reward_c = beta * (pol_c - ref_c)
+        reward_r = beta * (pol_r - ref_r)
+        metrics = {
+            "loss": loss,
+            "reward_accuracy": (reward_c > reward_r).mean(),
+            "reward_margin": (reward_c - reward_r).mean(),
+        }
+        return loss, metrics
+
+    return loss_fn

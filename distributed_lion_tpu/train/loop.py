@@ -138,7 +138,12 @@ class Trainer:
         apply_fn: Callable,
         params: Any,
         loss_mask_fn: Optional[Callable] = None,
+        loss_fn: Optional[Callable] = None,
     ):
+        """``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` may
+        replace the default CLM loss; ``batch`` is then any pytree whose
+        leaves carry a leading global-batch axis (e.g. DPO's
+        chosen/rejected pairs)."""
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
@@ -166,8 +171,15 @@ class Trainer:
         self.step_count = 0
         self._resume_skip_batches = 0
         self._schedule = cfg.schedule()
-        self._train_step = self._build_train_step(loss_mask_fn)
-        self._eval_step = self._build_eval_step(loss_mask_fn)
+        if loss_fn is None:
+            def loss_fn(params, batch, dropout_key):
+                logits = self.apply_fn(params, batch, dropout_key)
+                mask = loss_mask_fn(batch) if loss_mask_fn else None
+                return clm_loss_and_metrics(logits, batch, mask)
+
+        self.loss_fn = loss_fn
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
         self.checkpointer = (
             Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit)
             if cfg.output_dir
@@ -177,16 +189,11 @@ class Trainer:
         self._maybe_resume()
 
     # ------------------------------------------------------------------ steps
-    def _loss_fn(self, params, tokens, dropout_key, loss_mask_fn):
-        logits = self.apply_fn(params, tokens, dropout_key)
-        mask = loss_mask_fn(tokens) if loss_mask_fn else None
-        loss, metrics = clm_loss_and_metrics(logits, tokens, mask)
-        return loss, metrics
-
-    def _build_train_step(self, loss_mask_fn):
+    def _build_train_step(self):
         cfg = self.cfg
         accum = cfg.gradient_accumulation_steps
         opt = self.opt
+        loss_fn = self.loss_fn
 
         @partial(
             jax.shard_map,
@@ -196,16 +203,18 @@ class Trainer:
             check_vma=False,
         )
         def step(params, state, batch, base_key):
-            # batch block: [accum * local_bs, T] → [accum, local_bs, T]
-            local = batch.reshape(accum, -1, batch.shape[-1])
+            # each batch leaf: [accum * local_bs, ...] → [accum, local_bs, ...]
+            local = jax.tree.map(
+                lambda b: b.reshape((accum, -1) + b.shape[1:]), batch
+            )
             widx = lax.axis_index(DATA_AXIS)
             key = jax.random.fold_in(jax.random.fold_in(base_key, widx), _count_of(state))
 
             def micro(gsum, inp):
-                tokens, i = inp
+                microbatch, i = inp
                 (loss, metrics), g = jax.value_and_grad(
-                    self._loss_fn, has_aux=True
-                )(params, tokens, jax.random.fold_in(key, i), loss_mask_fn)
+                    loss_fn, has_aux=True
+                )(params, microbatch, jax.random.fold_in(key, i))
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return gsum, metrics
 
@@ -228,7 +237,9 @@ class Trainer:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def _build_eval_step(self, loss_mask_fn):
+    def _build_eval_step(self):
+        loss_fn = self.loss_fn
+
         @partial(
             jax.shard_map,
             mesh=self.mesh,
@@ -237,7 +248,7 @@ class Trainer:
             check_vma=False,
         )
         def step(params, batch):
-            loss, metrics = self._loss_fn(params, batch, None, loss_mask_fn)
+            loss, metrics = loss_fn(params, batch, None)
             return {k: lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
 
         return jax.jit(step)
@@ -298,33 +309,36 @@ class Trainer:
         """Eval loss / token accuracy / perplexity=exp(loss)
         (run_clm.py:630-636)."""
         cfg = self.cfg
+        n_examples = len(jax.tree.leaves(eval_blocks)[0])
         per_dev = cfg.per_device_eval_batch_size
-        if len(eval_blocks) < self.world * per_dev:
+        if n_examples < self.world * per_dev:
             # shrink rather than silently skipping eval on small validation
             # splits (jit re-specializes on the new shape)
-            per_dev = max(1, len(eval_blocks) // self.world)
+            per_dev = max(1, n_examples // self.world)
         bs = self.world * per_dev
-        if len(eval_blocks) < bs:
-            print(f"[trainer] eval skipped: {len(eval_blocks)} blocks < world {self.world}")
+        if n_examples < bs:
+            print(f"[trainer] eval skipped: {n_examples} examples < world {self.world}")
             return {"eval/loss": float("nan"), "eval/accuracy": float("nan"),
                     "eval/perplexity": float("nan")}
         data_spec = NamedSharding(self.mesh, P(DATA_AXIS))
-        losses, accs = [], []
-        n_batches = min(cfg.eval_iters, len(eval_blocks) // bs)
+        per_key: dict = {}
+        n_batches = min(cfg.eval_iters, n_examples // bs)
         for i in range(n_batches):
             batch = jax.device_put(
-                np.ascontiguousarray(eval_blocks[i * bs : (i + 1) * bs]).astype(np.int32),
+                jax.tree.map(
+                    lambda x: np.ascontiguousarray(x[i * bs : (i + 1) * bs]), eval_blocks
+                ),
                 data_spec,
             )
             m = self._eval_step(self.params, batch)
-            losses.append(float(m["loss"]))
-            accs.append(float(m["accuracy"]))
-        loss = float(np.mean(losses)) if losses else float("nan")
-        out = {
-            "eval/loss": loss,
-            "eval/accuracy": float(np.mean(accs)) if accs else float("nan"),
-            "eval/perplexity": float(np.exp(min(loss, 80.0))),
-        }
+            for k, v in m.items():
+                per_key.setdefault(k, []).append(float(v))
+        # aggregate EVERY metric the loss_fn reports (CLM: loss/accuracy/
+        # n_tokens; DPO: loss/reward_accuracy/reward_margin; custom: anything)
+        out = {f"eval/{k}": float(np.mean(v)) for k, v in per_key.items() if k != "n_tokens"}
+        loss = out.get("eval/loss", float("nan"))
+        if "n_tokens" in per_key:  # token-level LM loss → perplexity applies
+            out["eval/perplexity"] = float(np.exp(min(loss, 80.0)))
         self.logger.log(self.step_count, out, prefix="")
         return out
 

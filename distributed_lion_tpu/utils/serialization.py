@@ -1,0 +1,50 @@
+"""Flat .npz pytree serialization — the export format for merged LoRA models
+and adapters (the reference's save_pretrained/merged-save flow,
+sft_llama2.py:183-199). Orbax handles training checkpoints; this handles
+portable single-file model export."""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _flatten(tree, prefix=()):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _flatten(v, prefix + (str(k),))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _flatten(v, prefix + (f"#{i}",))
+    else:
+        yield "/".join(prefix), tree
+
+
+def save_pytree(path: str | pathlib.Path, tree: Any) -> None:
+    flat = {k: np.asarray(v) for k, v in _flatten(tree)}
+    pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, **flat)
+
+
+def load_pytree(path: str | pathlib.Path) -> Any:
+    """Rebuild the nested dict/list structure from flat keys."""
+    data = np.load(path)
+    root: dict = {}
+    for key in data.files:
+        parts = key.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return _listify(root)
+
+
+def _listify(node):
+    if isinstance(node, dict):
+        if node and all(k.startswith("#") for k in node):
+            return [_listify(node[f"#{i}"]) for i in range(len(node))]
+        return {k: _listify(v) for k, v in node.items()}
+    return node

@@ -1,0 +1,71 @@
+"""Quantization tests: NF4/int8 round-trip error, packing, tree targeting."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_lion_tpu.ops.quant import (
+    QuantizedTensor,
+    dequantize,
+    dequantize_tree,
+    maybe_dequant,
+    quantize_int8,
+    quantize_nf4,
+    quantize_tree,
+)
+
+
+def test_nf4_roundtrip_error_bounded():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32) * 0.02)
+    qt = quantize_nf4(w)
+    assert qt.codes.dtype == jnp.uint8
+    assert qt.codes.size == w.size // 2  # 2 codes per byte → 0.5 B/param
+    deq = dequantize(qt, jnp.float32)
+    assert deq.shape == w.shape
+    # NF4 relative error for gaussian weights: well under absmax/2 per block
+    err = np.abs(np.asarray(deq) - np.asarray(w))
+    assert err.max() < 0.02 * 0.5
+    # correlation stays near 1
+    c = np.corrcoef(np.asarray(deq).ravel(), np.asarray(w).ravel())[0, 1]
+    assert c > 0.98
+
+
+def test_int8_roundtrip_tighter_than_nf4():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(64, 64)).astype(np.float32))
+    err8 = np.abs(np.asarray(dequantize(quantize_int8(w), jnp.float32)) - np.asarray(w)).max()
+    err4 = np.abs(np.asarray(dequantize(quantize_nf4(w), jnp.float32)) - np.asarray(w)).max()
+    assert err8 < err4
+
+
+def test_nonmultiple_block_padding():
+    w = jnp.asarray(np.random.default_rng(2).normal(size=(7, 13)).astype(np.float32))
+    deq = dequantize(quantize_nf4(w, block=64), jnp.float32)
+    assert deq.shape == (7, 13)
+
+
+def test_quantize_tree_targets_large_2d_only():
+    tree = {
+        "big": jnp.ones((128, 64)),
+        "norm": jnp.ones((64,)),
+        "small": jnp.ones((4, 4)),
+    }
+    q = quantize_tree(tree, "nf4", min_size=1024)
+    assert isinstance(q["big"], QuantizedTensor)
+    assert not isinstance(q["norm"], QuantizedTensor)
+    assert not isinstance(q["small"], QuantizedTensor)
+    dense = dequantize_tree(q)
+    assert dense["big"].shape == (128, 64)
+
+
+def test_maybe_dequant_passthrough():
+    w = jnp.ones((4, 4))
+    assert maybe_dequant(w, jnp.float32) is w
+
+
+def test_quantized_tensor_is_pytree():
+    qt = quantize_nf4(jnp.ones((64, 64)))
+    moved = jax.tree.map(lambda x: x, qt)
+    assert isinstance(moved, QuantizedTensor)
+    assert moved.shape == (64, 64)
