@@ -53,7 +53,7 @@ class DataArguments:
     synthetic_blocks: int = 4096
 
 
-def build_mesh():
+def build_mesh(tensor_parallel: int = 1):
     import jax
 
     from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
@@ -62,7 +62,7 @@ def build_mesh():
         jax.config.update("jax_platforms", "cpu")
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     multihost_initialize()
-    return make_mesh()
+    return make_mesh(tensor=tensor_parallel)
 
 
 def load_blocks(data_args: DataArguments, block_size: int, vocab_size: int):
@@ -120,7 +120,7 @@ def main(argv=None):
     from distributed_lion_tpu.models.gpt2 import GPT2Config
     from distributed_lion_tpu.train.loop import Trainer
 
-    mesh = build_mesh()
+    mesh = build_mesh(train_cfg.tensor_parallel)
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
     common = dict(
         dropout=model_args.dropout,

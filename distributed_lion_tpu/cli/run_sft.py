@@ -79,7 +79,7 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import save_pytree
 
-    mesh = build_mesh()
+    mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
 
     if script_args.dataset == "synthetic":

@@ -26,6 +26,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_lion_tpu.ops.attention import attention as shared_attention
+
 
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
@@ -35,6 +37,7 @@ class GPT2Config:
     d_model: int = 768
     n_ctx: int = 1024
     dropout: float = 0.0
+    attn_impl: str = "auto"  # ops.attention: auto | xla | flash
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -77,8 +80,10 @@ def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
         block = {
             "ln_1": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
             "attn": {
-                "qkv": _normal(next(keys), (d, 3 * d), std, dt),
-                "qkv_b": jnp.zeros((3 * d,), dt),
+                # [d, 3, d]: q/k/v stacked on axis 1 so tensor parallelism
+                # shards the last (head) dim without cutting across q|k|v
+                "qkv": _normal(next(keys), (d, 3, d), std, dt),
+                "qkv_b": jnp.zeros((3, d), dt),
                 "proj": _normal(next(keys), (d, d), resid_std, dt),
                 "proj_b": jnp.zeros((d,), dt),
             },
@@ -109,41 +114,65 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention(x, p, cfg: GPT2Config, key):
-    """Causal multi-head attention; f32 softmax for stability."""
+def _attention(x, p, cfg: GPT2Config, key, tp_axis=None):
+    """Causal multi-head attention; f32 softmax for stability.
+
+    With ``tp_axis`` (Megatron tensor parallelism): qkv is column-parallel
+    (this device holds H/tp heads), proj is row-parallel (partial sums are
+    psum-reduced over the tensor axis; bias added after the reduction).
+    """
     B, T, D = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
-    qkv = x @ p["qkv"].astype(x.dtype) + p["qkv_b"].astype(x.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
+    H, hd = cfg.n_head // tp, cfg.head_dim
+    qkv = jnp.einsum(
+        "btd,dce->btce", x, p["qkv"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores / math.sqrt(hd)
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    scores = jnp.where(causal, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    probs = _dropout(probs, cfg.dropout, key)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
-    out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, T, D)
-    return out @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+    if cfg.dropout > 0.0 and key is not None:
+        # attention-prob dropout needs materialized scores; training with
+        # dropout keeps the XLA path
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(hd)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(causal, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        probs = _dropout(probs, cfg.dropout, key)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
+        out = out.astype(x.dtype)
+    else:
+        out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+    out = out @ p["proj"].astype(x.dtype)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)  # row-parallel reduction
+    return out + p["proj_b"].astype(x.dtype)
 
 
-def _mlp(x, p):
+def _mlp(x, p, tp_axis=None):
     h = x @ p["fc"].astype(x.dtype) + p["fc_b"].astype(x.dtype)
     h = jax.nn.gelu(h, approximate=True)
-    return h @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+    out = h @ p["proj"].astype(x.dtype)
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out + p["proj_b"].astype(x.dtype)
 
 
-@partial(jax.checkpoint, static_argnums=(3,))
-def _block(x, p, key, cfg: GPT2Config):
+@partial(jax.checkpoint, static_argnums=(3, 4))
+def _block(x, p, key, cfg: GPT2Config, tp_axis=None):
     """One pre-LN transformer block, rematerialized (jax.checkpoint) so
     activations are recomputed in backward — HBM for FLOPs, the standard TPU
     trade (task brief: use remat to trade FLOPs for memory)."""
     k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
-    x = x + _dropout(_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1), cfg.dropout, k2)
-    x = x + _dropout(_mlp(_layer_norm(x, p["ln_2"]), p["mlp"]), cfg.dropout, k3)
+    x = x + _dropout(
+        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, tp_axis),
+        cfg.dropout, k2,
+    )
+    x = x + _dropout(_mlp(_layer_norm(x, p["ln_2"]), p["mlp"], tp_axis), cfg.dropout, k3)
     return x
 
 
@@ -153,10 +182,13 @@ def gpt2_apply(
     cfg: GPT2Config,
     *,
     dropout_key: Optional[jax.Array] = None,
+    tp_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
 
     Output projection is tied to the input embedding (GPT-2 weight tying).
+    With ``tp_axis`` (inside shard_map), attention/MLP weights are expected
+    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``.
     """
     B, T = tokens.shape
     if T > cfg.n_ctx:
@@ -170,7 +202,7 @@ def gpt2_apply(
     )
     x = _dropout(x, cfg.dropout, keys[-1])
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
-        x = _block(x, p, k, cfg)
+        x = _block(x, p, k, cfg, tp_axis)
     x = _layer_norm(x, params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),

@@ -22,6 +22,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_lion_tpu.ops.attention import attention as shared_attention
 from distributed_lion_tpu.ops.quant import maybe_dequant
 
 
@@ -36,6 +37,7 @@ class LlamaConfig:
     n_ctx: int = 4096
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
+    attn_impl: str = "auto"  # ops.attention: auto | xla | flash
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -126,9 +128,13 @@ def _matmul(x, w):
     return x @ w.astype(x.dtype)
 
 
-def _attention(x, p, cfg: LlamaConfig, cos, sin):
+def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
+    """GQA attention; with ``tp_axis``, wq/wk/wv are column-parallel (this
+    device holds n_head/tp query and n_kv_head/tp kv heads) and wo is
+    row-parallel with a psum over the tensor axis (Megatron pattern)."""
     B, T, D = x.shape
-    H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
+    H, KV, hd = cfg.n_head // tp, cfg.n_kv_head // tp, cfg.head_dim
     q = _matmul(x, p["wq"]).reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = _matmul(x, p["wk"]).reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
     v = _matmul(x, p["wv"]).reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
@@ -138,25 +144,27 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin):
         rep = H // KV
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores / math.sqrt(hd)
-    causal = jnp.tril(jnp.ones((T, T), bool))
-    scores = jnp.where(causal, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
-    out = out.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, T, H * hd)
-    return _matmul(out, p["wo"])
+    out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+    out = _matmul(out, p["wo"])
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
-def _mlp(x, p):
+def _mlp(x, p, tp_axis=None):
     gate = jax.nn.silu(_matmul(x, p["w_gate"]))
-    return _matmul(gate * _matmul(x, p["w_up"]), p["w_down"])
+    out = _matmul(gate * _matmul(x, p["w_up"]), p["w_down"])
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
-@partial(jax.checkpoint, static_argnums=(2,))
-def _block(x, p, cfg: LlamaConfig, cos, sin):
-    x = x + _attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"], cfg, cos, sin)
-    x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
+@partial(jax.checkpoint, static_argnums=(2, 5))
+def _block(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
+    x = x + _attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"], cfg,
+                       cos, sin, tp_axis)
+    x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"], tp_axis)
     return x
 
 
@@ -166,15 +174,20 @@ def llama_apply(
     cfg: LlamaConfig,
     *,
     dropout_key: Optional[jax.Array] = None,  # parity arg; Llama uses none
+    tp_axis: Optional[str] = None,
 ) -> jnp.ndarray:
-    """int32 tokens [B, T] → f32 logits [B, T, vocab]."""
+    """int32 tokens [B, T] → f32 logits [B, T, vocab].
+
+    With ``tp_axis`` (inside shard_map), weights are expected pre-sharded per
+    ``parallel.tensor_parallel.llama_param_specs``.
+    """
     B, T = tokens.shape
     if T > cfg.n_ctx:
         raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
     x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
     cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta)
     for p in params["blocks"]:
-        x = _block(x, p, cfg, cos, sin)
+        x = _block(x, p, cfg, cos, sin, tp_axis)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     return jnp.einsum(
         "btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),

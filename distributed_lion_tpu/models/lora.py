@@ -42,7 +42,8 @@ class LoraConfig:
 
 
 def _is_weight_leaf(x) -> bool:
-    return isinstance(x, QuantizedTensor) or getattr(x, "ndim", 0) == 2
+    # 2-D projections and 3-D stacked projections (GPT-2's [d, 3, d] qkv)
+    return isinstance(x, QuantizedTensor) or getattr(x, "ndim", 0) in (2, 3)
 
 
 def _iter_paths(tree, prefix=()):
@@ -70,11 +71,11 @@ def lora_init(key: jax.Array, base_params: Any, cfg: LoraConfig,
     ]
     keys = jax.random.split(key, max(len(paths), 1))
     for k, (path, leaf) in zip(keys, paths):
-        shape = leaf.shape
-        d_in, d_out = int(shape[0]), int(shape[1])
+        shape = tuple(int(s) for s in leaf.shape)
+        d_in, out_dims = shape[0], shape[1:]  # n-D: B carries the trailing dims
         adapters["/".join(path)] = {
             "A": (jax.random.normal(k, (d_in, cfg.r)) / jnp.sqrt(cfg.r)).astype(dtype),
-            "B": jnp.zeros((cfg.r, d_out), dtype),
+            "B": jnp.zeros((cfg.r,) + out_dims, dtype),
         }
     if not adapters:
         raise ValueError(f"no base weights matched LoRA targets {cfg.target_patterns}")
@@ -115,7 +116,8 @@ def merge_lora(base_params: Any, adapters: dict, cfg: LoraConfig,
     for path_str, ab in adapters.items():
         path = tuple(path_str.split("/"))
         w = maybe_dequant(_tree_get(base_params, path), dequant_dtype)
-        delta = (ab["A"] @ ab["B"]) * cfg.scaling
+        b = ab["B"].reshape(ab["B"].shape[0], -1)  # [r, prod(out_dims)]
+        delta = ((ab["A"] @ b) * cfg.scaling).reshape(w.shape)
         _tree_set(merged, path, (w + delta.astype(w.dtype)))
     return merged
 

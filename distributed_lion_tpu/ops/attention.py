@@ -1,0 +1,51 @@
+"""Attention implementations with a single dispatch point.
+
+- ``xla``   — materialized-scores reference: einsum → masked f32 softmax →
+  einsum. XLA's fusion is already MXU-optimal at moderate T (measured
+  competitive with the flash kernel at T=1024 on v5e); it is the default.
+- ``flash`` — Pallas TPU flash attention (jax's bundled
+  ``pallas.ops.tpu.flash_attention``): O(T) memory online-softmax blocking,
+  the choice for long sequences where [B,H,T,T] scores would blow HBM.
+- ``auto``  — flash on TPU for T ≥ 2048, else xla.
+
+All take q, k, v as [B, H, T, head_dim] and return [B, H, T, head_dim] in
+q's dtype. Causal only (decoder framework).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def attention_xla(q, k, v, *, causal: bool = True):
+    T = q.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def attention_flash(q, k, v, *, causal: bool = True):
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    return flash_attention(
+        q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(q.shape[-1])
+    ).astype(q.dtype)
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+    if impl == "auto":
+        impl = "flash" if (jax.default_backend() == "tpu" and q.shape[2] >= 2048) else "xla"
+    if impl == "flash":
+        return attention_flash(q, k, v, causal=causal)
+    if impl == "xla":
+        return attention_xla(q, k, v, causal=causal)
+    raise ValueError(f"unknown attention impl {impl!r}")

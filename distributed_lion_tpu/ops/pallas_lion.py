@@ -1,0 +1,135 @@
+"""Fused Pallas kernels for the Distributed Lion hot loop.
+
+The reference's optimizer is the per-tensor Python loop SURVEY §3.1 flags as
+the main bottleneck (~148 tensors × [sign → pack → all_gather → unpack ×W →
+torch.mode → apply] per step; README.md:2 admits it is "currently slow").
+Here the whole pytree is one flat vector and the step is two VMEM passes
+(SURVEY §7 stage 6):
+
+- :func:`fused_ballots` — one pass over (g, m): ``ballot = ±1 from
+  b1*m + (1-b1)*g > 0`` as int8, ready for the on-fabric ``psum`` vote. No
+  f32 intermediate ever reaches HBM.
+- :func:`fused_apply` — one pass over (p, g, m, vote_total): weight decay,
+  elected-sign application, and the momentum update together:
+  ``p' = p*(1-lr*wd) - lr*sign(total>0)``; ``m' = b2*m + (1-b2)*g``.
+
+Between the two sits exactly one collective. The kernels are elementwise
+VPU work tiled (ROW_BLOCK, 128) with dtype-uniform flat inputs; CPU tests
+run them in interpreter mode (``interpret=True``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+ROW_BLOCK = 512  # rows per grid step → (512, 128) f32 blocks = 256 KiB
+
+
+def _pad_to_grid(flat: jnp.ndarray) -> tuple[jnp.ndarray, int]:
+    """[n] → [rows, 128] with rows a multiple of ROW_BLOCK (zero padded)."""
+    n = flat.shape[0]
+    rows = math.ceil(n / LANES)
+    rows = math.ceil(rows / ROW_BLOCK) * ROW_BLOCK
+    pad = rows * LANES - n
+    return jnp.pad(flat, (0, pad)).reshape(rows, LANES), n
+
+
+def _ballot_kernel(b1: float, g_ref, m_ref, out_ref):
+    u = m_ref[:].astype(jnp.float32) * b1 + g_ref[:].astype(jnp.float32) * (1.0 - b1)
+    out_ref[:] = jnp.where(u > 0, 1, -1).astype(jnp.int8)
+
+
+def fused_ballots(
+    g_flat: jnp.ndarray, m_flat: jnp.ndarray, b1: float, *, interpret: bool = False
+) -> jnp.ndarray:
+    """[n] grads + momentum → [n] int8 ±1 ballots (ref :68-71 semantics:
+    zero update votes −1, the ``> 0`` encoding)."""
+    g2, n = _pad_to_grid(g_flat)
+    m2, _ = _pad_to_grid(m_flat)
+    rows = g2.shape[0]
+    out = pl.pallas_call(
+        functools.partial(_ballot_kernel, b1),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
+        grid=(rows // ROW_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(g2, m2)
+    return out.reshape(-1)[:n]
+
+
+def _apply_kernel(wd: float, b2: float, lr_ref, p_ref, g_ref, m_ref, tot_ref,
+                  p_out, m_out):
+    lr = lr_ref[0]
+    pdt = p_ref.dtype
+    # elected sign: total > 0 → +1, ties/negative → −1 (tie rule SURVEY §2.3)
+    s = jnp.where(tot_ref[:] > 0, 1.0, -1.0)
+    p32 = p_ref[:].astype(jnp.float32)
+    p_out[:] = (p32 * (1.0 - lr * wd) - lr * s).astype(pdt)
+    m_out[:] = (
+        m_ref[:].astype(jnp.float32) * b2 + g_ref[:].astype(jnp.float32) * (1.0 - b2)
+    ).astype(m_ref.dtype)
+
+
+def fused_apply(
+    p_flat: jnp.ndarray,
+    g_flat: jnp.ndarray,
+    m_flat: jnp.ndarray,
+    vote_total: jnp.ndarray,
+    lr,
+    wd: float,
+    b2: float,
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One fused pass: decay + elected update + momentum (ref :64, :91-96)."""
+    p2, n = _pad_to_grid(p_flat)
+    g2, _ = _pad_to_grid(g_flat)
+    m2, _ = _pad_to_grid(m_flat)
+    t2, _ = _pad_to_grid(vote_total.astype(jnp.int32))
+    rows = p2.shape[0]
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1)
+    block = lambda: pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    p_new, m_new = pl.pallas_call(
+        functools.partial(_apply_kernel, wd, b2),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, LANES), p_flat.dtype),
+            jax.ShapeDtypeStruct((rows, LANES), m_flat.dtype),
+        ),
+        grid=(rows // ROW_BLOCK,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lr scalar
+            block(), block(), block(), block(),
+        ],
+        out_specs=(block(), block()),
+        interpret=interpret,
+    )(lr_arr, p2, g2, m2, t2)
+    return p_new.reshape(-1)[:n], m_new.reshape(-1)[:n]
+
+
+def pallas_available() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def resolve_kernel_mode(kernel: str) -> Optional[bool]:
+    """'auto' → pallas on TPU, XLA elsewhere; 'pallas' forces (interpreted on
+    CPU — for tests); 'xla' disables. Returns interpret flag or None for
+    the XLA path."""
+    if kernel == "xla":
+        return None
+    if kernel == "pallas":
+        return not pallas_available()
+    if kernel == "auto":
+        return False if pallas_available() else None
+    raise ValueError(f"unknown kernel mode {kernel!r}")

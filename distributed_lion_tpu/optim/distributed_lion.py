@@ -75,6 +75,7 @@ def distributed_lion(
     max_grad_norm: Optional[float] = None,
     wire: str = "sign_psum",
     mom_dtype: Optional[jnp.dtype] = None,
+    kernel: str = "auto",
 ) -> FunctionalOptimizer:
     """Build the majority-vote Lion optimizer.
 
@@ -89,6 +90,10 @@ def distributed_lion(
         wire: 'sign_psum' (int8 on-fabric reduce; ICI default) or
             'packed_allgather' (1-bit uint8 wire; DCN-friendly).
         mom_dtype: momentum dtype override (default: param dtype, ref :185).
+        kernel: 'auto' (fused Pallas kernels on TPU, plain XLA elsewhere),
+            'pallas' (force; interpreted off-TPU — tests), or 'xla'.
+            The Pallas path covers the deterministic mode with
+            dtype-uniform pytrees; other cases fall back to XLA.
 
     Returns:
         A :class:`FunctionalOptimizer` whose ``step`` MUST be traced inside
@@ -112,6 +117,9 @@ def distributed_lion(
 
     _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
     stochastic = max_grad_norm is not None
+    from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
+
+    interpret = resolve_kernel_mode(kernel)  # None → XLA path
 
     def init(params, rng: Optional[jax.Array] = None) -> LionState:
         if stochastic and rng is None:
@@ -121,7 +129,36 @@ def distributed_lion(
         )
         return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng)
 
+    def _step_pallas(params, grads, state: LionState):
+        """Fused-kernel fast path: two VMEM passes + one collective over the
+        flat pytree (ops/pallas_lion)."""
+        from distributed_lion_tpu.ops import pallas_lion
+
+        lr = resolve_lr(learning_rate, state.count)
+        p_leaves, treedef = jax.tree.flatten(params)
+        m_leaves = treedef.flatten_up_to(state.exp_avg)
+        g_leaves = [g.astype(m.dtype) for g, m in
+                    zip(treedef.flatten_up_to(grads), m_leaves)]
+        p_flat = jnp.concatenate([p.reshape(-1) for p in p_leaves])
+        g_flat = jnp.concatenate([g.reshape(-1) for g in g_leaves])
+        m_flat = jnp.concatenate([m.reshape(-1) for m in m_leaves])
+
+        ballots = pallas_lion.fused_ballots(g_flat, m_flat, b1, interpret=interpret)
+        total = collectives.vote_total(ballots > 0, axis_name, wire)
+        p_new_flat, m_new_flat = pallas_lion.fused_apply(
+            p_flat, g_flat, m_flat, total, lr, weight_decay, b2, interpret=interpret
+        )
+        return (
+            _split_votes(p_new_flat, params),
+            LionState(state.count + 1, _split_votes(m_new_flat, state.exp_avg), state.rng),
+        )
+
     def step(params, grads, state: LionState):
+        if interpret is not None and not stochastic:
+            p_dtypes = {p.dtype for p in jax.tree.leaves(params)}
+            m_dtypes = {m.dtype for m in jax.tree.leaves(state.exp_avg)}
+            if len(p_dtypes) == 1 and len(m_dtypes) == 1:
+                return _step_pallas(params, grads, state)
         lr = resolve_lr(learning_rate, state.count)
         grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, state.exp_avg)
 

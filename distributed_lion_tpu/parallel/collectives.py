@@ -34,41 +34,42 @@ def axis_size(axis_name: str) -> int:
     return lax.psum(1, axis_name)
 
 
-def majority_vote_psum(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Majority vote via an on-fabric sum of ±1 votes.
+def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
+    """The raw vote sum over workers: Σ ±1 ballots, in [-W, W].
 
-    Args:
-        vote_pos: bool array, this worker's votes (True = +1).
-        axis_name: mesh axis to vote across (the ``data`` axis).
-
-    Returns:
-        bool array: the elected majority (True = +1); ties → False (−1).
+    ``total > 0`` ⇔ majority True; ``total == 0`` is an exact tie (elects −1
+    downstream, the torch.mode smaller-value rule). Single source of truth
+    for both wire protocols — the XLA and Pallas optimizer paths, and both
+    ``majority_vote_*`` views, all reduce through here.
     """
     w = axis_size(axis_name)
-    # ±1 in int8 keeps the wire at 1 byte/param; XLA accumulates int8
-    # exactly for |sum| ≤ 127, so promote only for large worlds.
-    acc = jnp.int8 if w <= 127 else jnp.int32
-    ballots = jnp.where(vote_pos, 1, -1).astype(acc)
-    total = lax.psum(ballots, axis_name)
-    return total > 0
+    if wire == "sign_psum":
+        # ±1 in int8 keeps the wire at 1 byte/param; XLA accumulates int8
+        # exactly for |sum| ≤ 127, so promote only for large worlds.
+        acc = jnp.int8 if w <= 127 else jnp.int32
+        ballots = jnp.where(vote_pos, 1, -1).astype(acc)
+        return lax.psum(ballots, axis_name)
+    if wire == "packed_allgather":
+        # The reference's pack → all_gather → unpack → vote pipeline
+        # (distributed_lion.py:71-91) with a true-uint8 wire format;
+        # vote_pos must be 1-D (callers vote on a flattened pytree).
+        packed = pack_signs(vote_pos)                  # [ceil(n/8)] uint8
+        gathered = lax.all_gather(packed, axis_name)   # [W, ceil(n/8)] uint8
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = (gathered[:, :, None] >> shifts) & 1    # [W, n8, 8]
+        count = bits.astype(jnp.int32).sum(0).reshape(-1)[: vote_pos.shape[0]]
+        return count * 2 - w
+    raise ValueError(f"unknown wire format: {wire!r}")
+
+
+def majority_vote_psum(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Majority vote via an on-fabric sum of ±1 votes; ties → False (−1)."""
+    return vote_total(vote_pos, axis_name, "sign_psum") > 0
 
 
 def majority_vote_packed_allgather(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarray:
-    """Majority vote via 1-bit packed all-gather + local popcount.
-
-    Semantics of the reference's pack → all_gather → unpack → ``torch.mode``
-    pipeline (distributed_lion.py:71-91) with a true-uint8 wire format.
-    ``vote_pos`` must be 1-D (callers vote on a flattened pytree; see
-    optim.distributed_lion).
-    """
-    w = axis_size(axis_name)
-    packed = pack_signs(vote_pos)                      # [ceil(n/8)] uint8
-    gathered = lax.all_gather(packed, axis_name)       # [W, ceil(n/8)] uint8
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = (gathered[:, :, None] >> shifts) & 1        # [W, n8, 8]
-    true_count = bits.astype(jnp.int32).sum(0).reshape(-1)[: vote_pos.shape[0]]
-    # Majority of W voters; exact tie (2*count == W) → False (−1).
-    return true_count * 2 > w
+    """Majority vote via 1-bit packed all-gather + local popcount."""
+    return vote_total(vote_pos, axis_name, "packed_allgather") > 0
 
 
 def majority_vote(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:

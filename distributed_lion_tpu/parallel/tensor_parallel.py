@@ -1,0 +1,78 @@
+"""Megatron-style tensor-parallel sharding rules for the model families.
+
+Net-new vs the reference (data parallelism only — SURVEY §2.7): attention
+qkv / MLP up-projections are column-parallel (sharded on the output dim),
+attention proj / MLP down-projections are row-parallel (sharded on the input
+dim, partial products ``psum``-reduced over the ``tensor`` axis inside the
+model, see models/gpt2._attention / models/llama TP paths). LayerNorms,
+embeddings and the LM head stay replicated.
+
+The spec trees returned here drive shard_map in/out specs AND device_put
+layouts; the optimizer is oblivious — its ``data``-axis vote runs
+independently on each tensor shard.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
+
+def gpt2_param_specs(cfg) -> dict:
+    """PartitionSpec pytree matching models/gpt2.gpt2_init's structure."""
+    col = P(None, TENSOR_AXIS)   # column-parallel weight [d, k*d]
+    row = P(TENSOR_AXIS, None)   # row-parallel weight [k*d, d]
+    rep1 = P()
+    ln = {"scale": rep1, "bias": rep1}
+    block = {
+        "ln_1": ln,
+        "attn": {
+            "qkv": P(None, None, TENSOR_AXIS),
+            "qkv_b": P(None, TENSOR_AXIS),
+            "proj": row,
+            "proj_b": rep1,
+        },
+        "ln_2": ln,
+        "mlp": {"fc": col, "fc_b": P(TENSOR_AXIS), "proj": row, "proj_b": rep1},
+    }
+    return {
+        "wte": rep1,
+        "wpe": rep1,
+        "ln_f": ln,
+        "blocks": [block] * cfg.n_layer,
+    }
+
+
+def llama_param_specs(cfg) -> dict:
+    """PartitionSpec pytree matching models/llama.llama_init's structure."""
+    col = P(None, TENSOR_AXIS)
+    row = P(TENSOR_AXIS, None)
+    rep = P()
+    block = {
+        "ln_attn": {"scale": rep},
+        "attn": {"wq": col, "wk": col, "wv": col, "wo": row},
+        "ln_mlp": {"scale": rep},
+        "mlp": {"w_gate": col, "w_up": col, "w_down": row},
+    }
+    return {
+        "wte": rep,
+        "lm_head": rep,
+        "ln_f": {"scale": rep},
+        "blocks": [block] * cfg.n_layer,
+    }
+
+
+def validate_tp(cfg, tp: int, model: str = "gpt2") -> None:
+    if model == "gpt2":
+        if cfg.n_head % tp:
+            raise ValueError(f"n_head {cfg.n_head} not divisible by tensor axis {tp}")
+        if (4 * cfg.d_model) % tp:
+            raise ValueError(f"d_ff {4 * cfg.d_model} not divisible by tensor axis {tp}")
+    else:
+        if cfg.n_head % tp or cfg.n_kv_head % tp:
+            raise ValueError(
+                f"heads ({cfg.n_head}/{cfg.n_kv_head}kv) not divisible by tensor axis {tp}"
+            )
+        if cfg.d_ff % tp:
+            raise ValueError(f"d_ff {cfg.d_ff} not divisible by tensor axis {tp}")

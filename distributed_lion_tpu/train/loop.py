@@ -41,7 +41,6 @@ from distributed_lion_tpu.optim import (
 )
 from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
 from distributed_lion_tpu.optim.optax_adapter import OptaxState, adamw
-from distributed_lion_tpu.optim.sharded import state_specs
 from distributed_lion_tpu.parallel.mesh import DATA_AXIS, data_axis_size
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
@@ -62,6 +61,9 @@ class TrainConfig:
     lion: bool = True
     async_grad: bool = True
     wire: str = "sign_psum"
+    kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
+    tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
+                              # when building the mesh; net-new vs reference)
     max_grad_norm: Optional[float] = None  # set → stochastic binarization
     learning_rate: float = 1e-4
     weight_decay: float = 0.1
@@ -105,6 +107,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             axis_name=DATA_AXIS,
             max_grad_norm=cfg.max_grad_norm,
             wire=cfg.wire,
+            kernel=cfg.kernel,
         )
     if cfg.async_grad:
         raise ValueError(
@@ -118,9 +121,11 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
     return adamw(cfg.schedule(), weight_decay=cfg.weight_decay)
 
 
-def _opt_state_specs(cfg: TrainConfig):
+def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
     if cfg.lion:
-        return state_specs()  # stacked per-worker momentum over 'data'
+        # stacked per-worker momentum: [world, ...] over 'data' (+ any
+        # tensor-parallel dims the param itself carries)
+        return LionState(count=P(), exp_avg=exp_avg_specs, rng=P())
     return OptaxState(count=P(), inner=P(), rng=P())  # replicated
 
 
@@ -139,19 +144,32 @@ class Trainer:
         params: Any,
         loss_mask_fn: Optional[Callable] = None,
         loss_fn: Optional[Callable] = None,
+        param_specs: Any = None,
     ):
         """``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` may
         replace the default CLM loss; ``batch`` is then any pytree whose
         leaves carry a leading global-batch axis (e.g. DPO's
-        chosen/rejected pairs)."""
+        chosen/rejected pairs). ``param_specs`` is an optional PartitionSpec
+        pytree (parallel.tensor_parallel) for tensor-parallel params;
+        default replicated."""
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
         self.apply_fn = apply_fn
         self.opt = make_optimizer(cfg)
+        if param_specs is None:
+            param_specs = jax.tree.map(lambda _: P(), params)
+        elif not cfg.lion:
+            raise NotImplementedError("tensor-parallel param_specs require the Lion path")
+        self.param_specs = param_specs
 
-        self.params = jax.device_put(params, NamedSharding(mesh, P()))
+        self.params = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, param_specs
+        )
         rng = jax.random.key(cfg.seed)
+        self._exp_avg_specs = jax.tree.map(
+            lambda s: P(*((DATA_AXIS,) + tuple(s))), param_specs
+        )
         if cfg.lion:
             state = init_global_state(
                 self.opt, self.params, self.world,
@@ -161,7 +179,9 @@ class Trainer:
                 state,
                 LionState(
                     count=NamedSharding(mesh, P()),
-                    exp_avg=jax.tree.map(lambda _: NamedSharding(mesh, P(DATA_AXIS)), state.exp_avg),
+                    exp_avg=jax.tree.map(
+                        lambda s: NamedSharding(mesh, s), self._exp_avg_specs
+                    ),
                     rng=None if state.rng is None else NamedSharding(mesh, P()),
                 ),
             )
@@ -195,11 +215,13 @@ class Trainer:
         opt = self.opt
         loss_fn = self.loss_fn
 
+        st_specs = _opt_state_specs(cfg, self._exp_avg_specs if cfg.lion else None)
+
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(P(), _opt_state_specs(cfg), P(DATA_AXIS), P()),
-            out_specs=(P(), _opt_state_specs(cfg), P()),
+            in_specs=(self.param_specs, st_specs, P(DATA_AXIS), P()),
+            out_specs=(self.param_specs, st_specs, P()),
             check_vma=False,
         )
         def step(params, state, batch, base_key):
@@ -243,7 +265,7 @@ class Trainer:
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(P(), P(DATA_AXIS)),
+            in_specs=(self.param_specs, P(DATA_AXIS)),
             out_specs=P(),
             check_vma=False,
         )
@@ -376,19 +398,33 @@ class Trainer:
     # ------------------------------------------------------------- factories
     @staticmethod
     def for_gpt2(cfg: TrainConfig, mesh, model_cfg: GPT2Config, seed: Optional[int] = None):
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            gpt2_param_specs,
+            validate_tp,
+        )
+
         params = gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg)
         n = count_params(params)
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire)
+        tp = mesh.shape[TENSOR_AXIS]
         print(
-            f"[trainer] GPT-2 {n/1e6:.1f}M params | world={data_axis_size(mesh)} | "
-            f"vote wire={cfg.wire}: {acct['bits_per_param']:.2f} bits/param/step "
-            f"({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce)"
+            f"[trainer] GPT-2 {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
+            f"tp={tp} | vote wire={cfg.wire}: {acct['bits_per_param']:.2f} "
+            f"bits/param/step ({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce)"
         )
+        param_specs = None
+        tp_axis = None
+        if tp > 1:
+            validate_tp(model_cfg, tp, "gpt2")
+            param_specs = gpt2_param_specs(model_cfg)
+            tp_axis = TENSOR_AXIS
 
         def apply_fn(params, tokens, dropout_key):
-            return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key)
+            return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key,
+                              tp_axis=tp_axis)
 
-        return Trainer(cfg, mesh, apply_fn, params)
+        return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs)
 
 
 def _count_of(state) -> jnp.ndarray:
