@@ -51,6 +51,8 @@ class DataArguments:
     max_train_samples: Optional[int] = None  # debug truncation (:186-203)
     max_eval_samples: Optional[int] = None
     synthetic_blocks: int = 4096
+    native_loader: bool = True  # C++ mmap+prefetch loader for bin: datasets
+    bin_dtype: str = "uint16"  # token width of bin: shards (uint16 | uint32)
 
 
 def build_mesh(tensor_parallel: int = 1):
@@ -107,6 +109,59 @@ def load_blocks(data_args: DataArguments, block_size: int, vocab_size: int):
     return np.asarray(train), np.asarray(val)
 
 
+def make_native_pipeline(
+    data_args: DataArguments, block_size: int, vocab_size: int,
+    global_batch: int, seed: int,
+):
+    """C++ mmap+prefetch input pipeline for ``bin:<glob>`` datasets. Returns
+    (train_iter, eval_blocks, loader) or None to fall back to Python."""
+    if not (data_args.dataset.startswith("bin:") and data_args.native_loader):
+        return None
+    import numpy as np
+
+    from distributed_lion_tpu.data.native_loader import (
+        NativeTokenLoader,
+        native_available,
+    )
+
+    if not native_available():
+        print("[run_clm] no C++ toolchain; falling back to Python loader")
+        return None
+    paths = sorted(glob.glob(data_args.dataset[len("bin:"):]))
+    if not paths:
+        raise FileNotFoundError(f"no files match {data_args.dataset!r}")
+    loader = NativeTokenLoader(
+        paths, block_size, dtype=np.dtype(data_args.bin_dtype)
+    )
+    n = len(loader)
+    # hold-out range is ALWAYS the full split percentage so the training set
+    # is identical whether or not --max_eval_samples caps the blocks actually
+    # evaluated (and identical to the Python load_blocks path).
+    n_val = max(1, n * data_args.validation_split_percentage // 100)
+    hi = n
+    if data_args.max_train_samples:
+        hi = min(n, n_val + data_args.max_train_samples)
+    n_eval_read = min(n_val, data_args.max_eval_samples or 4096, 4096)
+    eval_blocks = loader.read_blocks(0, n_eval_read)
+    # vocab check must also sample the TRAIN range — eval-only coverage would
+    # let out-of-range train ids reach XLA gather's silent clamp.
+    n_probe = max(1, min(hi - n_val, 4_000_000 // block_size))
+    probe_idx = np.linspace(n_val, hi - 1, n_probe, dtype=np.int64)
+    mx = max(
+        int(eval_blocks.max()) if n_eval_read else 0,
+        max(int(loader.read_block(int(i)).max()) for i in probe_idx),
+    )
+    if mx >= vocab_size:
+        raise ValueError(
+            f"dataset contains token id {mx} >= model vocab_size {vocab_size}; "
+            "set --vocab_size (or use a matching tokenizer)"
+        )
+    it = loader.batches(global_batch, seed=seed, block_range=(n_val, hi))
+    print(f"[run_clm] native loader: {len(paths)} shard(s), {n} blocks "
+          f"({n_val} held out for eval)")
+    return it, eval_blocks, loader
+
+
 def main(argv=None):
     from distributed_lion_tpu.utils.argparsing import parse_dataclasses
 
@@ -148,9 +203,18 @@ def main(argv=None):
         print(f"[run_clm] capping block_size {train_cfg.block_size} -> n_ctx {model_cfg.n_ctx}")
         train_cfg.block_size = model_cfg.n_ctx
 
-    train_blocks, eval_blocks = load_blocks(data_args, train_cfg.block_size, model_cfg.vocab_size)
     trainer = Trainer.for_gpt2(train_cfg, mesh, model_cfg)
-    it = batch_iterator(train_blocks, trainer.global_train_batch(), seed=train_cfg.seed)
+    native = make_native_pipeline(
+        data_args, train_cfg.block_size, model_cfg.vocab_size,
+        trainer.global_train_batch(), train_cfg.seed,
+    )
+    if native is not None:
+        it, eval_blocks, _loader = native
+    else:
+        train_blocks, eval_blocks = load_blocks(
+            data_args, train_cfg.block_size, model_cfg.vocab_size
+        )
+        it = batch_iterator(train_blocks, trainer.global_train_batch(), seed=train_cfg.seed)
     try:
         trainer.train(it, eval_blocks=eval_blocks)
         if eval_blocks is not None and len(eval_blocks):

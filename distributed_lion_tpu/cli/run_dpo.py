@@ -59,6 +59,14 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import load_pytree, save_pytree
 
+    if train_cfg.tensor_parallel > 1:
+        # see run_sft.py: frozen-base sharding over the tensor axis is not
+        # wired into the LoRA Trainer path yet; fail fast instead of
+        # silently disabling data parallelism.
+        raise NotImplementedError(
+            "--tensor_parallel > 1 is not yet wired into the SFT/DPO LoRA "
+            "path; use run_clm for tensor parallelism"
+        )
     mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
 

@@ -79,6 +79,15 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import save_pytree
 
+    if train_cfg.tensor_parallel > 1:
+        # LoRA training closes over the frozen base inside the train step;
+        # sharding it over the tensor axis needs frozen-param specs in the
+        # Trainer. Until then, accepting the flag would silently shrink the
+        # data axis while every tensor-axis device redoes identical work.
+        raise NotImplementedError(
+            "--tensor_parallel > 1 is not yet wired into the SFT/DPO LoRA "
+            "path; use run_clm for tensor parallelism"
+        )
     mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
 

@@ -1,0 +1,107 @@
+"""ctypes front-end for the C++ prefetching token loader.
+
+Same batch contract as :func:`distributed_lion_tpu.data.sources.batch_iterator`
+([global_batch, block] int32, per-epoch reshuffle, drop-last) but the gather
+and shuffle run in a C++ background thread over mmap'd shards, overlapping
+host input with the TPU step — the framework-native stand-in for the
+reference's HF-datasets worker processes (run_clm.py:316-381).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from distributed_lion_tpu import native
+
+_DTYPES = {np.dtype(np.uint16): 2, np.dtype(np.uint32): 4}
+
+
+class NativeTokenLoader:
+    """Mmap'd `.bin` token shards cut into fixed blocks, served by a C++
+    prefetch thread. The per-shard tail below one block is dropped (each
+    shard is packed independently, the usual sharded-pretraining layout)."""
+
+    def __init__(
+        self,
+        paths: Sequence[str | pathlib.Path],
+        block_size: int,
+        dtype=np.uint16,
+    ):
+        self._lib = native.load()
+        self.block_size = int(block_size)
+        dtype_bytes = _DTYPES.get(np.dtype(dtype))
+        if dtype_bytes is None:
+            raise ValueError(f"dtype must be uint16 or uint32, got {dtype}")
+        enc = [str(p).encode() for p in paths]
+        arr = (ctypes.c_char_p * len(enc))(*enc)
+        self._h = self._lib.dl_open(arr, len(enc), dtype_bytes, self.block_size)
+        if not self._h:
+            raise OSError(self._lib.dl_last_error().decode())
+        self._batch = None
+
+    def __len__(self) -> int:
+        return int(self._lib.dl_num_blocks(self._h))
+
+    def read_block(self, idx: int) -> np.ndarray:
+        out = np.empty(self.block_size, np.int32)
+        ok = self._lib.dl_read_block(
+            self._h, idx, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if not ok:
+            raise IndexError(self._lib.dl_last_error().decode())
+        return out
+
+    def read_blocks(self, start: int, stop: int) -> np.ndarray:
+        return np.stack([self.read_block(i) for i in range(start, stop)])
+
+    def batches(
+        self,
+        global_batch: int,
+        *,
+        seed: int = 0,
+        shuffle: bool = True,
+        prefetch_depth: int = 4,
+        epochs: int | None = None,
+        block_range: tuple[int, int] | None = None,
+    ) -> Iterator[np.ndarray]:
+        """Start the prefetch thread and yield [global_batch, block] int32
+        batches. ``epochs=None`` cycles forever (step-based training);
+        ``block_range=(lo, hi)`` samples only that half-open block range
+        (validation hold-out)."""
+        if self._batch is not None:
+            raise RuntimeError("loader already started")
+        lo, hi = block_range if block_range is not None else (0, 0)
+        ok = self._lib.dl_start(
+            self._h, global_batch, seed, int(shuffle), prefetch_depth,
+            0 if epochs is None else int(epochs), lo, hi,
+        )
+        if not ok:
+            raise RuntimeError(self._lib.dl_last_error().decode())
+        self._batch = int(global_batch)
+
+        def gen():
+            out = np.empty((self._batch, self.block_size), np.int32)
+            ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            while self._h and self._lib.dl_next(self._h, ptr):
+                yield out.copy()
+
+        return gen()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dl_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_available() -> bool:
+    return native.available()

@@ -1,0 +1,101 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The reference's input pipeline is HF ``datasets`` (Arrow + Python worker
+processes). Here the equivalent is a small C++ runtime (``dataloader.cc``):
+mmap'd token shards, shuffled sampling, and a background prefetch thread,
+exposed over a C ABI and consumed via :mod:`ctypes` (no pybind11 in this
+environment). The library is compiled lazily into the package directory the
+first time it is needed and cached; callers fall back to the pure-Python
+path when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_HERE = pathlib.Path(__file__).resolve().parent
+_SRC = _HERE / "dataloader.cc"
+_LIB = _HERE / "_dataloader.so"
+_lock = threading.Lock()
+_cached: Optional[ctypes.CDLL] = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def library_path() -> pathlib.Path:
+    return _LIB
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Compile dataloader.cc → _dataloader.so (atomic rename, so concurrent
+    builders race benignly). Raises NativeBuildError on failure."""
+    if not force and _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _LIB
+    with tempfile.NamedTemporaryFile(
+        suffix=".so", dir=str(_HERE), delete=False
+    ) as tmp:
+        tmp_path = tmp.name
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        str(_SRC), "-o", tmp_path,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        pathlib.Path(tmp_path).unlink(missing_ok=True)
+        raise NativeBuildError(f"cannot run {cmd[0]}: {e}") from e
+    if proc.returncode != 0:
+        pathlib.Path(tmp_path).unlink(missing_ok=True)
+        raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
+    os.replace(tmp_path, _LIB)
+    return _LIB
+
+
+def load() -> ctypes.CDLL:
+    """Build (if needed) and load the native library, with typed signatures."""
+    global _cached
+    with _lock:
+        if _cached is not None:
+            return _cached
+        lib = ctypes.CDLL(str(build()))
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.dl_open.restype = ctypes.c_void_p
+        lib.dl_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_longlong,
+        ]
+        lib.dl_num_blocks.restype = ctypes.c_longlong
+        lib.dl_num_blocks.argtypes = [ctypes.c_void_p]
+        lib.dl_read_block.restype = ctypes.c_int
+        lib.dl_read_block.argtypes = [ctypes.c_void_p, ctypes.c_longlong, c_i32p]
+        lib.dl_start.restype = ctypes.c_int
+        lib.dl_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_ulonglong,
+            ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_longlong,
+        ]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [ctypes.c_void_p, c_i32p]
+        lib.dl_close.restype = None
+        lib.dl_close.argtypes = [ctypes.c_void_p]
+        lib.dl_last_error.restype = ctypes.c_char_p
+        lib.dl_last_error.argtypes = []
+        _cached = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except NativeBuildError:
+        return False
