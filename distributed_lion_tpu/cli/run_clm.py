@@ -67,6 +67,26 @@ def build_mesh(tensor_parallel: int = 1):
     return make_mesh(tensor=tensor_parallel)
 
 
+VOCAB_PROBE_TOKENS = 4_000_000  # sample budget for the token-id range check
+
+
+def _check_vocab(max_token_id: int, vocab_size: int) -> None:
+    # token ids must fit the model's embedding table — XLA gather would
+    # silently clamp out-of-range ids into wrong-but-running training.
+    if max_token_id >= vocab_size:
+        raise ValueError(
+            f"dataset contains token id {max_token_id} >= model vocab_size "
+            f"{vocab_size}; set --vocab_size (or use a matching tokenizer)"
+        )
+
+
+def _bin_paths(spec: str) -> list:
+    paths = sorted(glob.glob(spec[len("bin:"):]))
+    if not paths:
+        raise FileNotFoundError(f"no files match {spec!r}")
+    return paths
+
+
 def load_blocks(data_args: DataArguments, block_size: int, vocab_size: int):
     import numpy as np
 
@@ -84,20 +104,20 @@ def load_blocks(data_args: DataArguments, block_size: int, vocab_size: int):
             raise FileNotFoundError(f"no files match {data_args.dataset!r}")
         blocks = tokens_from_text_files(paths, block_size, data_args.tokenizer_name)
     elif data_args.dataset.startswith("bin:"):
-        blocks = TokenDataset.from_bin(data_args.dataset[len("bin:"):], block_size).blocks
+        # glob + per-shard block cut (tail below one block dropped per shard),
+        # matching the native loader's layout exactly
+        dtype = np.dtype(data_args.bin_dtype)
+        shards = [
+            TokenDataset.from_bin(p, block_size, dtype).blocks
+            for p in _bin_paths(data_args.dataset)
+        ]
+        blocks = np.concatenate([s for s in shards if len(s)]) if shards else shards
     else:
         raise ValueError(f"unknown dataset spec {data_args.dataset!r}")
 
-    # token ids must fit the model's embedding table — XLA gather would
-    # silently clamp out-of-range ids into wrong-but-running training.
     if len(blocks):
-        sample = np.asarray(blocks[: max(1, 4_000_000 // blocks.shape[1])])
-        mx = int(sample.max())
-        if mx >= vocab_size:
-            raise ValueError(
-                f"dataset contains token id {mx} >= model vocab_size {vocab_size}; "
-                "set --vocab_size (or use a matching tokenizer)"
-            )
+        sample = np.asarray(blocks[: max(1, VOCAB_PROBE_TOKENS // blocks.shape[1])])
+        _check_vocab(int(sample.max()), vocab_size)
 
     # validation split + debug truncation (run_clm.py:181-203, 355-381)
     n_val = max(1, len(blocks) * data_args.validation_split_percentage // 100)
@@ -127,9 +147,7 @@ def make_native_pipeline(
     if not native_available():
         print("[run_clm] no C++ toolchain; falling back to Python loader")
         return None
-    paths = sorted(glob.glob(data_args.dataset[len("bin:"):]))
-    if not paths:
-        raise FileNotFoundError(f"no files match {data_args.dataset!r}")
+    paths = _bin_paths(data_args.dataset)
     loader = NativeTokenLoader(
         paths, block_size, dtype=np.dtype(data_args.bin_dtype)
     )
@@ -141,21 +159,25 @@ def make_native_pipeline(
     hi = n
     if data_args.max_train_samples:
         hi = min(n, n_val + data_args.max_train_samples)
-    n_eval_read = min(n_val, data_args.max_eval_samples or 4096, 4096)
+    # an explicit --max_eval_samples is honored in full; the 4096 default cap
+    # only bounds the eager read on huge unconfigured splits (noted below)
+    if data_args.max_eval_samples:
+        n_eval_read = min(n_val, data_args.max_eval_samples)
+    else:
+        n_eval_read = min(n_val, 4096)
+        if n_eval_read < n_val:
+            print(f"[run_clm] eval uses the first {n_eval_read} of {n_val} "
+                  "held-out blocks (set --max_eval_samples to override)")
     eval_blocks = loader.read_blocks(0, n_eval_read)
     # vocab check must also sample the TRAIN range — eval-only coverage would
     # let out-of-range train ids reach XLA gather's silent clamp.
-    n_probe = max(1, min(hi - n_val, 4_000_000 // block_size))
+    n_probe = max(1, min(hi - n_val, VOCAB_PROBE_TOKENS // block_size))
     probe_idx = np.linspace(n_val, hi - 1, n_probe, dtype=np.int64)
     mx = max(
         int(eval_blocks.max()) if n_eval_read else 0,
         max(int(loader.read_block(int(i)).max()) for i in probe_idx),
     )
-    if mx >= vocab_size:
-        raise ValueError(
-            f"dataset contains token id {mx} >= model vocab_size {vocab_size}; "
-            "set --vocab_size (or use a matching tokenizer)"
-        )
+    _check_vocab(mx, vocab_size)
     it = loader.batches(global_batch, seed=seed, block_range=(n_val, hi))
     print(f"[run_clm] native loader: {len(paths)} shard(s), {n} blocks "
           f"({n_val} held out for eval)")

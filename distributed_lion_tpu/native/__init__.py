@@ -97,5 +97,7 @@ def available() -> bool:
     try:
         load()
         return True
-    except NativeBuildError:
+    except (NativeBuildError, OSError):
+        # OSError covers a stale/corrupt/wrong-arch .so that CDLL rejects —
+        # callers should fall back to the Python path, not crash
         return False

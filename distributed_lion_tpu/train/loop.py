@@ -44,6 +44,7 @@ from distributed_lion_tpu.optim.optax_adapter import OptaxState, adamw
 from distributed_lion_tpu.parallel.mesh import DATA_AXIS, data_axis_size
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
+from distributed_lion_tpu.train.profiling import StepProfiler, StepTimer, comm_report
 from distributed_lion_tpu.train.schedule import (
     constant_schedule,
     cosine_schedule_with_warmup,
@@ -85,6 +86,9 @@ class TrainConfig:
     output_dir: Optional[str] = None
     resume_from_checkpoint: bool = True
     report_to_wandb: bool = False
+    profile_dir: Optional[str] = None  # capture a jax.profiler trace window
+    profile_start_step: int = 10
+    profile_num_steps: int = 3
 
     def schedule(self) -> Callable:
         if self.lr_scheduler_type == "cosine":
@@ -206,7 +210,18 @@ class Trainer:
             else None
         )
         self.logger = MetricsLogger(cfg.output_dir, use_wandb=cfg.report_to_wandb)
+        self.profiler = StepProfiler(cfg.profile_dir, cfg.profile_start_step,
+                                     cfg.profile_num_steps)
+        self.timer = StepTimer()
+        self.n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         self._maybe_resume()
+
+    def comm_stats(self, steps_per_sec: Optional[float] = None) -> dict:
+        """Analytic bytes-on-wire report for the vote collective (empty for
+        the AdamW path, which has no optimizer collective)."""
+        if not self.cfg.lion:
+            return {}
+        return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec)
 
     # ------------------------------------------------------------------ steps
     def _build_train_step(self):
@@ -304,18 +319,28 @@ class Trainer:
         t_last, s_last = time.time(), self.step_count
 
         while self.step_count < total:
+            self.profiler.maybe_start(self.step_count)
             batch = jax.device_put(next(train_iter), data_spec)
-            self.params, self.state, metrics = self._train_step(
-                self.params, self.state, batch, base_key
-            )
+            with self.profiler.annotate(self.step_count):
+                self.params, self.state, metrics = self._train_step(
+                    self.params, self.state, batch, base_key
+                )
             self.step_count += 1
+            self.timer.tick()
+            self.profiler.maybe_stop(self.step_count, sync=metrics)
 
             if self.step_count % cfg.logging_steps == 0 or self.step_count == total:
                 m = {k: float(v) for k, v in metrics.items()}
                 now = time.time()
-                m["tokens_per_sec"] = tokens_per_step * (self.step_count - s_last) / max(now - t_last, 1e-9)
+                steps_per_sec = (self.step_count - s_last) / max(now - t_last, 1e-9)
+                m["tokens_per_sec"] = tokens_per_step * steps_per_sec
                 # the step just executed ran with optimizer count step_count-1
                 m["lr"] = float(self._schedule(jnp.asarray(self.step_count - 1, jnp.float32)))
+                m.update(self.timer.stats())
+                comm = self.comm_stats(steps_per_sec)
+                if comm:
+                    m["comm_bytes_per_step"] = comm["comm_bytes_per_step"]
+                    m["comm_mbytes_per_sec"] = comm.get("comm_mbytes_per_sec", 0.0)
                 t_last, s_last = now, self.step_count
                 self.logger.log(self.step_count, m, prefix="train")
                 history.append({"step": self.step_count, **m})
@@ -391,6 +416,7 @@ class Trainer:
         print(f"[trainer] resumed from checkpoint step {last}")
 
     def close(self) -> None:
+        self.profiler.close()
         if self.checkpointer:
             self.checkpointer.close()
         self.logger.close()

@@ -1,0 +1,139 @@
+"""Tracing / profiling hooks for the train loop.
+
+The reference has no profiling at all (SURVEY §5: no profiler imports; its
+only perf statement is README.md:2's "currently slow" admission). Here
+profiling is a first-class trainer subsystem:
+
+- :class:`StepProfiler` — captures a ``jax.profiler`` device trace (viewable
+  in TensorBoard / Perfetto) for a configurable window of steps, and tags
+  every step with ``StepTraceAnnotation`` so the trace viewer groups ops by
+  step. Capturing a bounded window (not the whole run) keeps trace files
+  small and the steady-state steps representative.
+- :class:`StepTimer` — lightweight wall-clock EMA of step latency with
+  percentile tracking, always on (no device sync: it times the *dispatch*
+  cadence which equals steady-state step time once the pipeline fills).
+- :func:`comm_report` — analytic bytes-on-the-wire accounting for the vote
+  collective (ops/codec.wire_bytes_per_param), the number BASELINE.md's
+  ≤1/32-of-bf16-all-reduce budget is judged against.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from distributed_lion_tpu.ops.codec import wire_bytes_per_param
+
+
+class StepProfiler:
+    """Trace steps [start_step, start_step + num_steps) to ``trace_dir``.
+
+    Inactive when ``trace_dir`` is None — zero overhead beyond an int
+    compare per step. ``annotate()`` returns a ``StepTraceAnnotation``
+    context while tracing (so ops group per-step in the viewer) and a
+    null context otherwise.
+    """
+
+    def __init__(self, trace_dir: Optional[str], start_step: int = 10,
+                 num_steps: int = 3):
+        self.trace_dir = trace_dir
+        self.start_step = int(start_step)
+        self.num_steps = int(num_steps)
+        self.stop_step = self.start_step + self.num_steps
+        self._active = False
+        self._done = False
+
+    def maybe_start(self, step: int) -> None:
+        # >= (not ==) so a checkpoint-resumed run that re-enters past the
+        # configured start still captures a window (anchored at the first
+        # step it actually sees)
+        if (self.trace_dir and not self._active and not self._done
+                and step >= self.start_step):
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self.stop_step = step + self.num_steps
+            self._active = True
+
+    def annotate(self, step: int):
+        if self._active:
+            import jax
+
+            return jax.profiler.StepTraceAnnotation("train", step_num=step)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def maybe_stop(self, step: int, sync=None) -> None:
+        """Stop at the window end. ``sync`` (e.g. the last metrics pytree) is
+        block_until_ready'd first so in-flight device work lands in the
+        trace."""
+        if self._active and step >= self.stop_step:
+            import jax
+
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            print(f"[profiler] trace for steps [{self.start_step}, "
+                  f"{self.stop_step}) written to {self.trace_dir}")
+
+    def close(self, sync=None) -> None:
+        if self._active:
+            self.maybe_stop(self.stop_step, sync)
+
+
+class StepTimer:
+    """Step-latency stats from dispatch timestamps: EMA + p50/p95 over a
+    sliding window."""
+
+    def __init__(self, ema_alpha: float = 0.1, window: int = 256):
+        self.alpha = ema_alpha
+        self.window = window
+        self.ema: Optional[float] = None
+        self._samples: list[float] = []
+        self._last: Optional[float] = None
+
+    def tick(self) -> Optional[float]:
+        """Call once per step; returns this step's latency (None on first)."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return None
+        dt = now - self._last
+        self._last = now
+        self.ema = dt if self.ema is None else self.alpha * dt + (1 - self.alpha) * self.ema
+        self._samples.append(dt)
+        if len(self._samples) > self.window:
+            self._samples.pop(0)
+        return dt
+
+    def stats(self) -> dict:
+        if not self._samples:
+            return {}
+        arr = np.asarray(self._samples)
+        return {
+            "step_time_ema_s": float(self.ema),
+            "step_time_p50_s": float(np.percentile(arr, 50)),
+            "step_time_p95_s": float(np.percentile(arr, 95)),
+        }
+
+
+def comm_report(num_params: int, world: int, wire: str,
+                steps_per_sec: Optional[float] = None) -> dict:
+    """Vote-collective wire accounting (+ bandwidth when a rate is known)."""
+    acct = wire_bytes_per_param(num_params, world, wire)
+    out = {
+        "wire": acct["wire"],
+        "comm_bytes_per_step": acct["bytes_per_step"],
+        "comm_bits_per_param": acct["bits_per_param"],
+        "vs_bf16_allreduce": acct["vs_bf16_allreduce"],
+        "vs_reference_wire": acct["bytes_per_step"]
+        / max(acct["reference_bytes_per_step"], 1),
+    }
+    if steps_per_sec:
+        out["comm_mbytes_per_sec"] = acct["bytes_per_step"] * steps_per_sec / 1e6
+    return out
