@@ -8,3 +8,10 @@ from distributed_lion_tpu.parallel.collectives import (
     majority_vote_psum,
     majority_vote_packed_allgather,
 )
+from distributed_lion_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    unstack_stage_params,
+    from_last_stage,
+)
+from distributed_lion_tpu.parallel.expert import moe_init, moe_ffn, moe_param_specs

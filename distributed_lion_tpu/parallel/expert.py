@@ -1,0 +1,126 @@
+"""Expert parallelism: Switch-style MoE FFN with all_to_all dispatch.
+
+Net-new vs the reference (data-parallel only, SURVEY §2.7), designed for the
+TPU fabric rather than ported: experts live sharded over the ``expert`` mesh
+axis, and each device's tokens reach their experts through exactly two
+``lax.all_to_all`` collectives (dispatch + return) riding ICI — the standard
+TPU MoE layout (tokens stay in fixed-capacity buffers, every shape static,
+no host-side routing).
+
+Routing is top-1 ("Switch Transformer"): per-token argmax over a learned
+gate, fixed per-expert capacity ``ceil(cf * N / E)`` with overflow dropped
+(the residual path carries dropped tokens unchanged), and the usual
+load-balancing auxiliary loss. All arithmetic is batched einsums over
+[tokens, experts, capacity] one-hot masks — MXU-friendly, autodiff-clean.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_lion_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def moe_init(key, n_experts: int, d_model: int, d_ff: int, dtype=jnp.float32):
+    """Gate + per-expert FFN params. Shard the ``w_/b_`` leaves over the
+    expert axis with :func:`moe_param_specs`; the gate stays replicated."""
+    kg, ki, ko = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(0.02)
+    return {
+        "gate": init(kg, (d_model, n_experts), dtype),
+        "w_in": init(ki, (n_experts, d_model, d_ff), dtype),
+        "b_in": jnp.zeros((n_experts, d_ff), dtype),
+        "w_out": init(ko, (n_experts, d_ff, d_model), dtype),
+        "b_out": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def moe_param_specs():
+    from jax.sharding import PartitionSpec as P
+
+    e = EXPERT_AXIS
+    return {
+        "gate": P(),
+        "w_in": P(e), "b_in": P(e),
+        "w_out": P(e), "b_out": P(e),
+    }
+
+
+def capacity(n_tokens: int, n_experts: int, capacity_factor: float) -> int:
+    return max(1, math.ceil(capacity_factor * n_tokens / n_experts))
+
+
+def moe_ffn(
+    params,
+    x: jnp.ndarray,
+    *,
+    capacity_factor: float = 1.25,
+    axis_name: Optional[str] = EXPERT_AXIS,
+):
+    """Apply the MoE FFN to local tokens ``x [N, D]``.
+
+    Under ``shard_map`` with ``axis_name`` bound, ``params['w_in']`` etc.
+    hold only this shard's experts ``[E_local, ...]`` while the gate scores
+    ALL ``E = E_local * shards`` experts; tokens travel over the fabric.
+    With ``axis_name=None`` (or axis size 1) it is the single-device
+    reference semantics — same routing, same drops, no collectives.
+
+    Returns ``(y [N, D], aux_loss scalar)``; add ``aux`=0.01*aux_loss`` to
+    the train loss to balance expert load (Switch Transformer recipe).
+    """
+    n, d = x.shape
+    ep = 1 if axis_name is None else lax.psum(1, axis_name)
+    e_local = params["w_in"].shape[0]
+    n_experts = e_local * ep
+    cap = capacity(n, n_experts, capacity_factor)
+
+    # --- route (every device scores the full expert set) ---
+    logits = x @ params["gate"]  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+    gate_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)  # [N, E]
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0  # slot in expert queue
+    keep = (pos >= 0) & (pos < cap)
+    slot = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), cap, dtype=x.dtype)  # [N, C]
+    mask = one_hot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None]
+
+    # --- load-balance aux loss (computed on pre-drop assignments) ---
+    frac_tokens = one_hot.mean(axis=0)  # [E]
+    frac_probs = probs.mean(axis=0)
+    aux = n_experts * jnp.sum(frac_tokens * frac_probs)
+
+    # --- dispatch: [E, C, D] buffers, tokens in their expert's slots ---
+    dispatch = jnp.einsum("nec,nd->ecd", mask, x)
+    if axis_name is not None and ep > 1:
+        # split the expert axis across shards, concat arrivals along
+        # capacity: [E, C, D] -> [E_local, S*C, D] in ONE all_to_all
+        dispatch = lax.all_to_all(
+            dispatch, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    # --- expert FFN (batched over this shard's experts) ---
+    h = jax.nn.gelu(
+        jnp.einsum("ecd,edf->ecf", dispatch, params["w_in"])
+        + params["b_in"][:, None, :]
+    )
+    out = (
+        jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+        + params["b_out"][:, None, :]
+    )
+
+    if axis_name is not None and ep > 1:
+        # inverse: [E_local, S*C, D] -> [E, C, D] back on the token's shard
+        out = lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    # --- combine: weight each token's slot by its gate probability ---
+    y = jnp.einsum("nec,ecd->nd", mask * gate_p[:, None, None], out)
+    return y, aux

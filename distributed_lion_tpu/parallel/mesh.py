@@ -9,6 +9,8 @@ Axis conventions used throughout the framework:
 - ``data``   — data parallelism (the reference's DDP ranks; the vote axis).
 - ``tensor`` — tensor/model parallelism (net-new vs the reference).
 - ``seq``    — sequence/context parallelism for ring attention (net-new).
+- ``pipe``   — pipeline parallelism over layer stages (net-new).
+- ``expert`` — expert parallelism for MoE layers (net-new).
 """
 
 from __future__ import annotations
@@ -24,16 +26,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 TENSOR_AXIS = "tensor"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
 
 def make_mesh(
     data: int | None = None,
     tensor: int = 1,
     seq: int = 1,
+    pipe: int = 1,
+    expert: int = 1,
     *,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a (data, tensor, seq) mesh over the available devices.
+    """Build a (data, tensor, seq, pipe, expert) mesh over the devices.
 
     ``data=None`` absorbs all remaining devices, mirroring how ``torchrun
     --nproc_per_node N`` sizes the reference's world (README.md:19). On real
@@ -42,19 +48,25 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    model = tensor * seq * pipe * expert
     if data is None:
-        if n % (tensor * seq):
-            raise ValueError(f"{n} devices not divisible by tensor*seq={tensor * seq}")
-        data = n // (tensor * seq)
-    if data * tensor * seq != n:
-        raise ValueError(f"mesh {data}x{tensor}x{seq} != {n} devices")
+        if n % model:
+            raise ValueError(
+                f"{n} devices not divisible by tensor*seq*pipe*expert={model}"
+            )
+        data = n // model
+    if data * model != n:
+        raise ValueError(
+            f"mesh {data}x{tensor}x{seq}x{pipe}x{expert} != {n} devices"
+        )
+    shape = (data, tensor, seq, pipe, expert)
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh((data, tensor, seq), devices=devices)
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
-        dev_array = np.array(devices).reshape(data, tensor, seq)
-    return Mesh(dev_array, (DATA_AXIS, TENSOR_AXIS, SEQ_AXIS))
+        dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, (DATA_AXIS, TENSOR_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS))
 
 
 def data_axis_size(mesh: Mesh) -> int:
