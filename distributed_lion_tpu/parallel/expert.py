@@ -85,14 +85,19 @@ def moe_ffn(
     expert_idx = jnp.argmax(probs, axis=-1)  # [N]
     gate_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
 
-    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=x.dtype)  # [N, E]
-    pos = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0  # slot in expert queue
+    # Routing arithmetic stays in int32/float32 regardless of x.dtype:
+    # bf16 can't represent integers > 256, so a bf16 cumsum would collide
+    # ranks once an expert sees > 256 local tokens (tokens silently summed
+    # into one dispatch slot). Only the final masks are cast to x.dtype.
+    one_hot_i = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [N, E]
+    pos = jnp.cumsum(one_hot_i, axis=0) * one_hot_i - 1  # slot in expert queue
     keep = (pos >= 0) & (pos < cap)
-    slot = jax.nn.one_hot(pos.max(axis=-1).astype(jnp.int32), cap, dtype=x.dtype)  # [N, C]
-    mask = one_hot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None]
+    slot = jax.nn.one_hot(pos.max(axis=-1), cap, dtype=x.dtype)  # [N, C]
+    one_hot = one_hot_i.astype(x.dtype)
+    mask = one_hot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None].astype(x.dtype)
 
     # --- load-balance aux loss (computed on pre-drop assignments) ---
-    frac_tokens = one_hot.mean(axis=0)  # [E]
+    frac_tokens = one_hot_i.astype(jnp.float32).mean(axis=0)  # [E]
     frac_probs = probs.mean(axis=0)
     aux = n_experts * jnp.sum(frac_tokens * frac_probs)
 

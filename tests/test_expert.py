@@ -119,3 +119,31 @@ def test_capacity_formula():
     assert capacity(64, 8, 1.0) == 8
     assert capacity(64, 8, 1.25) == 10
     assert capacity(3, 8, 1.0) == 1  # floor at 1
+
+
+def test_bf16_routing_no_slot_collisions():
+    """bf16 cumsum can't count past 256 — routing must stay exact in int32.
+
+    Regression: with bf16 activations and >256 tokens on one expert, a
+    bf16 cumsum collides ranks and silently sums tokens into shared
+    dispatch slots. Routing must match the float32 reference exactly.
+    """
+    n = 1024
+    params = moe_init(jax.random.key(11), E, D, F, dtype=jnp.bfloat16)
+    # strong gate bias: most tokens land on one expert (>256 local tokens)
+    x = jax.random.normal(jax.random.key(12), (n, D), jnp.bfloat16)
+    params["gate"] = params["gate"].at[:, 0].add(5.0)
+
+    y16, _ = moe_ffn(params, x, capacity_factor=float(E), axis_name=None)
+    p32 = jax.tree.map(lambda a: a.astype(jnp.float32), params)
+    y32, _ = moe_ffn(p32, x.astype(jnp.float32), capacity_factor=float(E),
+                     axis_name=None)
+    # no dropped-vs-kept disagreement and no summed-slot corruption:
+    # bf16 output tracks the float32 reference within bf16 tolerance
+    np.testing.assert_allclose(
+        np.asarray(y16, np.float32), np.asarray(y32), rtol=0.1, atol=0.1
+    )
+    # every dispatch slot holds at most one token
+    logits = x.astype(jnp.float32) @ p32["gate"]
+    idx = np.asarray(jnp.argmax(jax.nn.softmax(logits, -1), -1))
+    assert (np.bincount(idx, minlength=E) > 256).any()  # premise holds
