@@ -76,8 +76,9 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
     Args:
         num_params: total parameters voted on.
         world_size: number of data-parallel voters.
-        wire: 'sign_psum' (int8 on-fabric all-reduce) or 'packed_allgather'
-            (1-bit uint8 all-gather).
+        wire: 'sign_psum' (int8 on-fabric all-reduce), 'packed_allgather'
+            (1-bit uint8 all-gather), or 'packed_a2a' (two-phase 1-bit
+            all_to_all + all_gather; ~2 bits/param, W-independent).
 
     Returns:
         dict with bytes received per worker per step for this build, the
@@ -93,6 +94,11 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
         ours = num_params * acc_bytes
     elif wire == "packed_allgather":
         ours = world_size * packed_size(num_params)
+    elif wire == "packed_a2a":
+        # phase 1: (W-1) peers each send me their packed copy of my chunk;
+        # phase 2: (W-1) peers each send me their chunk's packed verdict.
+        chunk = max(1, -(-num_params // (8 * world_size)))
+        ours = 2 * (world_size - 1) * chunk
     else:
         raise ValueError(f"unknown wire format: {wire!r}")
     reference = world_size * packed_size(num_params) * 8  # int64 lanes

@@ -87,8 +87,10 @@ def distributed_lion(
             set → stochastic binarization with range bound
             ``r = (1 + 1/b1) * max_grad_norm`` (ref :106-108). Requires an
             ``rng`` key at ``init``.
-        wire: 'sign_psum' (int8 on-fabric reduce; ICI default) or
-            'packed_allgather' (1-bit uint8 wire; DCN-friendly).
+        wire: 'sign_psum' (int8 on-fabric reduce; ICI default),
+            'packed_allgather' (1-bit uint8 wire; DCN-friendly), or
+            'packed_a2a' (two-phase 1-bit vote, ~2 bits/param independent
+            of world size; minimum-bandwidth choice for large worlds).
         mom_dtype: momentum dtype override (default: param dtype, ref :185).
         kernel: 'auto' (fused Pallas kernels on TPU, plain XLA elsewhere),
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
@@ -101,7 +103,7 @@ def distributed_lion(
         None). Params in/out are replicated; ``state.exp_avg`` is this
         worker's momentum shard (see :func:`init_global_state`).
     """
-    if wire not in ("sign_psum", "packed_allgather"):
+    if wire not in collectives.WIRE_FORMATS:
         raise ValueError(f"unknown wire format: {wire!r}")
     if axis_name is None:
         # The reference's uninitialized-process-group fallback is plain local

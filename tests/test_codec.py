@@ -42,6 +42,11 @@ def test_wire_accounting_beats_baseline():
     # BASELINE.md: ≤ 1/32 of bf16 grad all-reduce → packed path at W=4 is 1/4 byte/param vs 2
     assert packed["vs_bf16_allreduce"] <= 1 / 4
     assert psum["bits_per_param"] == 8.0
+    # two-phase a2a wire: ~2 bits/param and INDEPENDENT of world size
+    for w2 in (4, 64, 512):
+        a2a = wire_bytes_per_param(n, w2, "packed_a2a")
+        assert a2a["bits_per_param"] <= 2.0
+        assert a2a["vs_bf16_allreduce"] <= 1 / 8
 
 
 def test_unknown_wire_raises():

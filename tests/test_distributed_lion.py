@@ -37,7 +37,7 @@ def _run_steps(mesh, opt, params, stacked_grads, state, n=1):
     return params, state
 
 
-@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather"])
+@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather", "packed_a2a"])
 def test_world1_matches_local(wire):
     mesh = make_mesh(data=1, devices=jax.devices()[:1])
     params = _params()
@@ -56,7 +56,7 @@ def test_world1_matches_local(wire):
         np.testing.assert_allclose(np.asarray(new_p[k]), np.asarray(exp_p[k]), rtol=1e-6)
 
 
-@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather"])
+@pytest.mark.parametrize("wire", ["sign_psum", "packed_allgather", "packed_a2a"])
 def test_replica_consistency_and_vote_semantics(wire):
     """All workers apply the identical elected update; the election matches a
     numpy majority vote of the per-worker signs."""
@@ -84,13 +84,14 @@ def test_wire_paths_agree():
     params = _params()
     grads = _stacked_grads(8, seed=11)
     outs = []
-    for wire in ("sign_psum", "packed_allgather"):
+    for wire in ("sign_psum", "packed_allgather", "packed_a2a"):
         opt = distributed_lion(learning_rate=0.05, wire=wire)
         state = shard_state(init_global_state(opt, params, world=8), mesh)
         new_p, _ = _run_steps(mesh, opt, params, grads, state, n=3)
         outs.append(new_p)
     for k in params:
-        np.testing.assert_array_equal(np.asarray(outs[0][k]), np.asarray(outs[1][k]))
+        for other in outs[1:]:
+            np.testing.assert_array_equal(np.asarray(outs[0][k]), np.asarray(other[k]))
 
 
 def test_permutation_invariance():
