@@ -8,20 +8,36 @@ The reference publishes no numbers (BASELINE.md); its stated target is "GPT-2
 throughput under the reference's stack (HF Trainer + DDP + its Python-loop
 optimizer, which README.md:2 admits is slow) — so vs_baseline > 1 means one
 TPU chip under this framework out-trains one A100 under the reference.
+
+Measurement discipline: the K optimizer steps of each timed dispatch run as
+ONE device program (Trainer._train_chunk, lax.scan over staged batches), and
+the timer stops only after a device_get of the final chunk's loss — a value
+data-dependent on every step — so queued-but-unexecuted work can't inflate
+the number (remote/tunneled backends ack dispatch long before execution).
+Config picked by scripts/bench_sweep.py on v5e: remat off (124M activations
+fit HBM), XLA attention (beats Pallas flash at T=1024), bf16 params (the
+reference's canonical bf16 config), microbatch 4 with 16-step grad
+accumulation — small microbatches keep the f32 attention-score traffic per
+pass low while accumulation amortizes the optimizer's full-pytree
+ballot/vote/apply passes over 16x the tokens.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 
 BASELINE_TOKENS_PER_SEC_PER_DEVICE = 100_000.0
+STEPS_PER_CALL = 10
+TIMED_CALLS = 4
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distributed_lion_tpu.data.sources import synthetic_lm_dataset
     from distributed_lion_tpu.models.gpt2 import GPT2Config
@@ -30,8 +46,11 @@ def main() -> None:
 
     n_dev = len(jax.devices())
     mesh = make_mesh()
-    model_cfg = GPT2Config.gpt2_124m()
-    batch_per_dev, accum = 8, 1
+    model_cfg = dataclasses.replace(
+        GPT2Config.gpt2_124m(), remat=False, attn_impl="xla",
+        param_dtype=jnp.bfloat16,
+    )
+    batch_per_dev, accum = 4, 16
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
@@ -42,6 +61,7 @@ def main() -> None:
         per_device_train_batch_size=batch_per_dev,
         gradient_accumulation_steps=accum,
         block_size=model_cfg.n_ctx,
+        steps_per_call=STEPS_PER_CALL,
         logging_steps=10_000,
         output_dir=None,
     )
@@ -49,36 +69,38 @@ def main() -> None:
     global_bs = trainer.global_train_batch()
     tokens_per_step = global_bs * cfg.block_size
 
-    blocks = synthetic_lm_dataset(global_bs * 4, cfg.block_size, model_cfg.vocab_size, seed=0)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    batch = jax.device_put(
-        blocks[:global_bs].astype(np.int32), NamedSharding(mesh, P("data"))
+    blocks = synthetic_lm_dataset(
+        global_bs * STEPS_PER_CALL, cfg.block_size, model_cfg.vocab_size, seed=0
+    )
+    batches = jax.device_put(
+        blocks.astype(np.int32).reshape(STEPS_PER_CALL, global_bs, cfg.block_size),
+        NamedSharding(mesh, P(None, "data")),
     )
     base_key = jax.random.key(0)
 
-    # warmup/compile
-    trainer.params, trainer.state, m = trainer._train_step(
-        trainer.params, trainer.state, batch, base_key
+    # warmup/compile + honest sync
+    trainer.params, trainer.state, m = trainer._train_chunk(
+        trainer.params, trainer.state, batches, base_key
     )
-    jax.block_until_ready(m["loss"])
+    _ = float(np.asarray(jax.device_get(m["loss"])))
 
-    steps = 20
     t0 = time.perf_counter()
-    for _ in range(steps):
-        trainer.params, trainer.state, m = trainer._train_step(
-            trainer.params, trainer.state, batch, base_key
+    for _ in range(TIMED_CALLS):
+        trainer.params, trainer.state, m = trainer._train_chunk(
+            trainer.params, trainer.state, batches, base_key
         )
-    jax.block_until_ready(m["loss"])
+    _ = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
 
+    steps = STEPS_PER_CALL * TIMED_CALLS
     tokens_per_sec = tokens_per_step * steps / dt
     per_chip = tokens_per_sec / n_dev
     print(
         json.dumps(
             {
                 "metric": "tokens/sec/chip, GPT-2 124M vote-Lion train step "
-                f"(bs={batch_per_dev}x{cfg.block_size}, {n_dev} device(s))",
+                f"(microbatch {batch_per_dev}x{cfg.block_size}, accum {accum}, "
+                f"{n_dev} device(s))",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(per_chip / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),

@@ -39,6 +39,7 @@ class ModelArguments:
     dropout: float = 0.0
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
+    remat: bool = True  # per-block activation remat (off = faster when HBM allows)
 
 
 @dataclasses.dataclass
@@ -203,6 +204,7 @@ def main(argv=None):
         dropout=model_args.dropout,
         param_dtype=dtypes[model_args.param_dtype],
         compute_dtype=dtypes[model_args.compute_dtype],
+        remat=model_args.remat,
     )
     if model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from distributed_lion_tpu.ops.attention import attention as shared_attention
 from distributed_lion_tpu.ops.quant import maybe_dequant
+from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +39,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash
+    remat: bool = True  # per-block jax.checkpoint; off when activations fit
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -134,6 +136,9 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
     row-parallel with a psum over the tensor axis (Megatron pattern)."""
     B, T, D = x.shape
     tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
+    if tp_axis is not None:
+        # Megatron f: identity fwd, psum bwd (see parallel.tensor_parallel)
+        x = copy_to_tp_region(x, tp_axis)
     H, KV, hd = cfg.n_head // tp, cfg.n_kv_head // tp, cfg.head_dim
     q = _matmul(x, p["wq"]).reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = _matmul(x, p["wk"]).reshape(B, T, KV, hd).transpose(0, 2, 1, 3)
@@ -153,6 +158,8 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
 
 
 def _mlp(x, p, tp_axis=None):
+    if tp_axis is not None:
+        x = copy_to_tp_region(x, tp_axis)
     gate = jax.nn.silu(_matmul(x, p["w_gate"]))
     out = _matmul(gate * _matmul(x, p["w_up"]), p["w_down"])
     if tp_axis is not None:
@@ -160,12 +167,14 @@ def _mlp(x, p, tp_axis=None):
     return out
 
 
-@partial(jax.checkpoint, static_argnums=(2, 5))
 def _block(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
     x = x + _attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"], cfg,
                        cos, sin, tp_axis)
     x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"], tp_axis)
     return x
+
+
+_block_remat = partial(jax.checkpoint, static_argnums=(2, 5))(_block)
 
 
 def llama_apply(
@@ -186,8 +195,9 @@ def llama_apply(
         raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
     x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
     cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta)
+    block = _block_remat if cfg.remat else _block
     for p in params["blocks"]:
-        x = _block(x, p, cfg, cos, sin, tp_axis)
+        x = block(x, p, cfg, cos, sin, tp_axis)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     return jnp.einsum(
         "btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),

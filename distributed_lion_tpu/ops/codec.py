@@ -27,6 +27,14 @@ def packed_size(n: int) -> int:
     return (n + 7) // 8
 
 
+def a2a_chunk_bytes(n: int, world_size: int) -> int:
+    """uint8 bytes per worker-chunk in the packed_a2a wire: the ballot vector
+    is padded so every worker owns an equal ceil(n/8W)-byte chunk. Single
+    source of truth for collectives._packed_a2a_elect and the byte
+    accounting below."""
+    return max(1, -(-n // (8 * world_size)))
+
+
 def pack_signs(positive: jnp.ndarray) -> jnp.ndarray:
     """Pack a boolean array (True = +1 vote) into uint8, 8 votes per byte.
 
@@ -97,8 +105,7 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
     elif wire == "packed_a2a":
         # phase 1: (W-1) peers each send me their packed copy of my chunk;
         # phase 2: (W-1) peers each send me their chunk's packed verdict.
-        chunk = max(1, -(-num_params // (8 * world_size)))
-        ours = 2 * (world_size - 1) * chunk
+        ours = 2 * (world_size - 1) * a2a_chunk_bytes(num_params, world_size)
     else:
         raise ValueError(f"unknown wire format: {wire!r}")
     reference = world_size * packed_size(num_params) * 8  # int64 lanes

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_lion_tpu.ops.codec import pack_signs, unpack_signs
+from distributed_lion_tpu.ops.codec import a2a_chunk_bytes, pack_signs, unpack_signs
 
 
 def axis_size(axis_name: str) -> int:
@@ -41,12 +41,16 @@ def axis_size(axis_name: str) -> int:
 
 
 def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
-    """The raw vote sum over workers: Σ ±1 ballots, in [-W, W].
-
-    ``total > 0`` ⇔ majority True; ``total == 0`` is an exact tie (elects −1
-    downstream, the torch.mode smaller-value rule). Single source of truth
-    for both wire protocols — the XLA and Pallas optimizer paths, and both
-    ``majority_vote_*`` views, all reduce through here.
+    """The vote reduction over workers. Every wire satisfies the contract
+    callers rely on — ``total > 0`` ⇔ majority True, ``total ≤ 0`` ⇔ elect −1
+    (ties → −1, the torch.mode smaller-value rule) — but only ``sign_psum``
+    and ``packed_allgather`` return the exact tally Σ ±1 ballots in [-W, W];
+    ``packed_a2a`` reduces at the chunk owner and returns the elected sign as
+    a ±1 proxy (magnitude information never crosses the wire — that is the
+    point of the two-phase format). Do not consume the magnitude for
+    vote-margin metrics without excluding the a2a wire. Single source of
+    truth for the XLA and Pallas optimizer paths and both ``majority_vote_*``
+    views.
     """
     w = axis_size(axis_name)
     if wire == "sign_psum":
@@ -61,9 +65,8 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
         # vote_pos must be 1-D (callers vote on a flattened pytree).
         packed = pack_signs(vote_pos)                  # [ceil(n/8)] uint8
         gathered = lax.all_gather(packed, axis_name)   # [W, ceil(n/8)] uint8
-        shifts = jnp.arange(8, dtype=jnp.uint8)
-        bits = (gathered[:, :, None] >> shifts) & 1    # [W, n8, 8]
-        count = bits.astype(jnp.int32).sum(0).reshape(-1)[: vote_pos.shape[0]]
+        bits = unpack_signs(gathered.reshape(-1), (w, gathered.shape[1] * 8))
+        count = bits.astype(jnp.int32).sum(0)[: vote_pos.shape[0]]
         return count * 2 - w
     if wire == "packed_a2a":
         # Two-phase vote. The verdict (not the tally) crosses the wire in
@@ -78,20 +81,18 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndar
     """Elected bool votes via all_to_all of 1-bit ballots + all_gather of
     1-bit verdicts (~2 bits/param received per worker, W-independent)."""
     n = vote_pos.shape[0]
-    chunk = max(1, -(-n // (8 * w)))  # uint8 bytes per worker-chunk
+    chunk = a2a_chunk_bytes(n, w)  # uint8 bytes per worker-chunk
     pad = chunk * 8 * w - n
     padded = jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)]) if pad else vote_pos
     packed = pack_signs(padded).reshape(w, chunk)  # row j = my ballot for chunk j
     # phase 1: worker j receives every worker's row j → [W, chunk]
     arrived = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0, tiled=True)
-    shifts = jnp.arange(8, dtype=jnp.uint8)
-    bits = (arrived[:, :, None] >> shifts) & 1        # [W, chunk, 8]
-    count = bits.astype(jnp.int32).sum(0).reshape(-1)  # per-bit True tally
+    bits = unpack_signs(arrived.reshape(-1), (w, chunk * 8))
+    count = bits.astype(jnp.int32).sum(0)              # per-bit True tally
     verdict = count * 2 > w                            # tie → False (−1)
     # phase 2: broadcast my chunk's packed verdict to everyone
     gathered = lax.all_gather(pack_signs(verdict), axis_name)  # [W, chunk]
-    vbits = (gathered[:, :, None] >> shifts) & 1
-    return vbits.reshape(-1)[:n].astype(jnp.bool_)
+    return unpack_signs(gathered.reshape(-1), (n,))
 
 
 def majority_vote_psum(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarray:

@@ -10,13 +10,51 @@ embeddings and the LM head stay replicated.
 The spec trees returned here drive shard_map in/out specs AND device_put
 layouts; the optimizer is oblivious — its ``data``-axis vote runs
 independently on each tensor shard.
+
+:func:`copy_to_tp_region` is Megatron's *f* operator — identity forward,
+``psum`` over the tensor axis backward. The models insert it where replicated
+activations enter a column-parallel region (attention/MLP entry): each tensor
+rank's backward only carries its own heads'/columns' contribution to dx, so
+without the boundary psum the gradients of everything upstream (layer norms,
+embeddings) would be per-rank partials — and per-rank momenta/votes would
+silently drift replicated parameters apart. (Under ``shard_map`` with
+``check_vma=False`` JAX does not insert this reduction automatically.)
 """
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tp_region(x, axis_name: str):
+    """Identity forward; backward ``psum``s the cotangent over ``axis_name``."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (lax.psum(g, axis_name),)
+
+
+copy_to_tp_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+def spec_uses_axis(spec, axis_name: str) -> bool:
+    """True if a PartitionSpec shards any dim over ``axis_name``."""
+    return any(
+        p == axis_name or (isinstance(p, (tuple, list)) and axis_name in p)
+        for p in spec
+    )
 
 
 def gpt2_param_specs(cfg) -> dict:

@@ -41,7 +41,7 @@ from distributed_lion_tpu.optim import (
 )
 from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
 from distributed_lion_tpu.optim.optax_adapter import OptaxState, adamw
-from distributed_lion_tpu.parallel.mesh import DATA_AXIS, data_axis_size
+from distributed_lion_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, data_axis_size
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import StepProfiler, StepTimer, comm_report
@@ -66,6 +66,11 @@ class TrainConfig:
     tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
                               # when building the mesh; net-new vs reference)
     max_grad_norm: Optional[float] = None  # set → stochastic binarization
+    grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
+    # (HF Trainer, which the reference sits on, clips at 1.0 by default —
+    # run_clm inherits it via TrainingArguments). When max_grad_norm is set
+    # and this is not, grads are clipped at max_grad_norm: the stochastic
+    # quantizer's unbiasedness needs |β₁m+(1−β₁)g| ≤ r (SURVEY §2.4).
     learning_rate: float = 1e-4
     weight_decay: float = 0.1
     beta1: float = 0.9
@@ -76,6 +81,10 @@ class TrainConfig:
     per_device_train_batch_size: int = 20
     gradient_accumulation_steps: int = 8
     per_device_eval_batch_size: int = 20
+    steps_per_call: int = 1  # optimizer steps fused into one device dispatch
+    # (lax.scan over staged batches). >1 amortizes host→device dispatch
+    # latency — the hot loop stays on device; logging granularity coarsens
+    # to the chunk. Net-new vs the reference (HF Trainer dispatches per step).
     block_size: int = 1024
     seed: int = 42
     logging_steps: int = 50
@@ -202,7 +211,9 @@ class Trainer:
                 return clm_loss_and_metrics(logits, batch, mask)
 
         self.loss_fn = loss_fn
-        self._train_step = self._build_train_step()
+        self._train_step_core = self._build_train_step_core()
+        self._train_step = jax.jit(self._train_step_core, donate_argnums=(0, 1))
+        self._train_chunk = jax.jit(self._build_train_chunk(), donate_argnums=(0, 1))
         self._eval_step = self._build_eval_step()
         self.checkpointer = (
             Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit)
@@ -224,11 +235,13 @@ class Trainer:
         return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec)
 
     # ------------------------------------------------------------------ steps
-    def _build_train_step(self):
+    def _build_train_step_core(self):
         cfg = self.cfg
         accum = cfg.gradient_accumulation_steps
         opt = self.opt
         loss_fn = self.loss_fn
+        tp_axis = TENSOR_AXIS if dict(self.mesh.shape).get(TENSOR_AXIS, 1) > 1 else None
+        param_specs = self.param_specs
 
         st_specs = _opt_state_specs(cfg, self._exp_avg_specs if cfg.lion else None)
 
@@ -265,6 +278,16 @@ class Trainer:
             # else: no gradient sync — the AsyncTrainer contract
             # (async_trainer.py:15). The ONLY collective is the vote in
             # opt.step.
+            clip = (cfg.grad_clip_norm if cfg.grad_clip_norm is not None
+                    else cfg.max_grad_norm)
+            if clip:
+                # per-worker clip (grads are local in async mode; in DDP mode
+                # this runs on the already-averaged grads, matching HF Trainer
+                # clipping after the all-reduce). Under TP the grads are
+                # sharded over the tensor axis → norm psum'd across it so all
+                # shards of one gradient scale uniformly.
+                grads = clip_by_global_norm(grads, clip, specs=param_specs,
+                                            tp_axis=tp_axis)
             st = squeeze_worker_state(state) if cfg.lion else state
             new_params, new_st = opt.step(params, grads, st)
             new_state = expand_worker_state(new_st) if cfg.lion else new_st
@@ -272,7 +295,26 @@ class Trainer:
             mean_metrics = {k: lax.pmean(v.mean(), DATA_AXIS) for k, v in metrics.items()}
             return new_params, new_state, mean_metrics
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return step
+
+    def _build_train_chunk(self):
+        """K optimizer steps per device dispatch: ``lax.scan`` of the train
+        step over a staged ``[K, global_batch, ...]`` batch stack. One
+        host→device round trip per K steps instead of per step."""
+        step = self._train_step_core
+
+        def chunk(params, state, batches, base_key):
+            def body(carry, batch):
+                p, s = carry
+                p, s, m = step(p, s, batch, base_key)
+                return (p, s), m
+
+            (params, state), ms = lax.scan(body, (params, state), batches)
+            # per-chunk mean for logging (loss of the last step alone would
+            # alias a single microbatch draw)
+            return params, state, jax.tree.map(lambda x: x.mean(0), ms)
+
+        return chunk
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
@@ -317,19 +359,39 @@ class Trainer:
                 next(train_iter)
             self._resume_skip_batches = 0
         t_last, s_last = time.time(), self.step_count
+        chunk_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
 
         while self.step_count < total:
             self.profiler.maybe_start(self.step_count)
-            batch = jax.device_put(next(train_iter), data_spec)
-            with self.profiler.annotate(self.step_count):
-                self.params, self.state, metrics = self._train_step(
-                    self.params, self.state, batch, base_key
+            k = min(self.cfg.steps_per_call, total - self.step_count)
+            advanced = k
+            if k == self.cfg.steps_per_call and k > 1:
+                # fused K-step dispatch; the tail below K runs step-by-step
+                # (avoids a second jit specialization for the remainder)
+                stack = [next(train_iter) for _ in range(k)]
+                batches = jax.device_put(
+                    jax.tree.map(lambda *xs: np.stack(xs), *stack), chunk_spec
                 )
-            self.step_count += 1
-            self.timer.tick()
+                with self.profiler.annotate(self.step_count):
+                    self.params, self.state, metrics = self._train_chunk(
+                        self.params, self.state, batches, base_key
+                    )
+                self.step_count += k
+                self.timer.tick(k)
+            else:
+                batch = jax.device_put(next(train_iter), data_spec)
+                with self.profiler.annotate(self.step_count):
+                    self.params, self.state, metrics = self._train_step(
+                        self.params, self.state, batch, base_key
+                    )
+                self.step_count += 1
+                self.timer.tick()
+                advanced = 1
             self.profiler.maybe_stop(self.step_count, sync=metrics)
 
-            if self.step_count % cfg.logging_steps == 0 or self.step_count == total:
+            # boundary tests are "crossed a multiple of N during this
+            # dispatch" so chunked advances never skip a log/eval/save
+            if self.step_count % cfg.logging_steps < advanced or self.step_count == total:
                 m = {k: float(v) for k, v in metrics.items()}
                 now = time.time()
                 steps_per_sec = (self.step_count - s_last) / max(now - t_last, 1e-9)
@@ -345,10 +407,10 @@ class Trainer:
                 self.logger.log(self.step_count, m, prefix="train")
                 history.append({"step": self.step_count, **m})
 
-            if eval_blocks is not None and self.step_count % cfg.eval_steps == 0:
+            if eval_blocks is not None and self.step_count % cfg.eval_steps < advanced:
                 history.append({"step": self.step_count, **self.evaluate(eval_blocks)})
 
-            if self.checkpointer and self.step_count % cfg.save_steps == 0:
+            if self.checkpointer and self.step_count % cfg.save_steps < advanced:
                 self.save()
         return history
 
@@ -455,3 +517,39 @@ class Trainer:
 
 def _count_of(state) -> jnp.ndarray:
     return state.count
+
+
+def clip_by_global_norm(grads, clip: float, specs=None, tp_axis: Optional[str] = None):
+    """Scale the whole pytree so its global L2 norm is ≤ ``clip`` — the
+    torch.nn.utils.clip_grad_norm_ semantics HF Trainer applies before every
+    optimizer step (default max_grad_norm=1.0), which the reference inherits.
+
+    Inside shard_map under tensor parallelism (``tp_axis`` + ``specs``), the
+    squared norm of tensor-SHARDED leaves is psum'd across the tensor axis
+    (each rank holds one shard of those gradients) while tensor-replicated
+    leaves — whose grads are already complete and identical on every rank,
+    thanks to the copy_to_tp_region boundary — are counted once. Every rank
+    then applies the same scale. The data axis is deliberately never summed:
+    per-worker grads get per-worker norms (they are different gradients, not
+    shards of one)."""
+    def _sq(g):
+        return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+    if tp_axis is None:
+        sq = sum(_sq(g) for g in jax.tree.leaves(grads))
+    else:
+        from distributed_lion_tpu.parallel.tensor_parallel import spec_uses_axis
+
+        flat_g = jax.tree.leaves(grads)
+        flat_s = jax.tree.leaves(specs)  # P leaves; same structure as grads
+        sq_sharded = sum(
+            (_sq(g) for g, s in zip(flat_g, flat_s) if spec_uses_axis(s, tp_axis)),
+            start=jnp.float32(0),
+        )
+        sq_rep = sum(
+            (_sq(g) for g, s in zip(flat_g, flat_s) if not spec_uses_axis(s, tp_axis)),
+            start=jnp.float32(0),
+        )
+        sq = lax.psum(sq_sharded, tp_axis) + sq_rep
+    scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
+    return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)

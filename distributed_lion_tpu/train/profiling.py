@@ -97,13 +97,14 @@ class StepTimer:
         self._samples: list[float] = []
         self._last: Optional[float] = None
 
-    def tick(self) -> Optional[float]:
-        """Call once per step; returns this step's latency (None on first)."""
+    def tick(self, n_steps: int = 1) -> Optional[float]:
+        """Call once per dispatch covering ``n_steps`` optimizer steps;
+        returns per-step latency (None on first call)."""
         now = time.perf_counter()
         if self._last is None:
             self._last = now
             return None
-        dt = now - self._last
+        dt = (now - self._last) / max(n_steps, 1)
         self._last = now
         self.ema = dt if self.ema is None else self.alpha * dt + (1 - self.alpha) * self.ema
         self._samples.append(dt)

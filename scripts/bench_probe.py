@@ -1,0 +1,68 @@
+"""Probe the timing semantics of the tunneled TPU backend: compare
+block_until_ready vs device_get sync, and throughput vs number of steps.
+If tokens/s inflates with step count or sync method, the dispatch queue is
+absorbing work and the timer must fetch a value dependent on the full chain.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(batch_per_dev=8, remat=True):
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_lion_tpu.data.sources import synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    mesh = make_mesh()
+    model_cfg = dataclasses.replace(GPT2Config.gpt2_124m(), remat=remat)
+    cfg = TrainConfig(
+        lion=True, async_grad=True, learning_rate=1e-4, weight_decay=0.1,
+        warmup_steps=10, max_steps=10_000,
+        per_device_train_batch_size=batch_per_dev,
+        gradient_accumulation_steps=1, block_size=model_cfg.n_ctx,
+        logging_steps=10_000, output_dir=None,
+    )
+    trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
+    global_bs = trainer.global_train_batch()
+    tokens_per_step = global_bs * cfg.block_size
+    blocks = synthetic_lm_dataset(global_bs, cfg.block_size, model_cfg.vocab_size, seed=0)
+    batch = jax.device_put(blocks[:global_bs].astype(np.int32),
+                           NamedSharding(mesh, P("data")))
+    key = jax.random.key(0)
+    trainer.params, trainer.state, m = trainer._train_step(
+        trainer.params, trainer.state, batch, key)
+    print("warmup loss:", float(np.asarray(jax.device_get(m["loss"]))), flush=True)
+
+    for steps, sync in [(5, "get"), (20, "get"), (50, "get"), (20, "block"),
+                        (20, "get_each")]:
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            trainer.params, trainer.state, m = trainer._train_step(
+                trainer.params, trainer.state, batch, key)
+            if sync == "get_each":
+                _ = float(np.asarray(jax.device_get(m["loss"])))
+        if sync == "block":
+            jax.block_until_ready(m["loss"])
+        elif sync == "get":
+            _ = float(np.asarray(jax.device_get(m["loss"])))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "steps": steps, "sync": sync, "ms_per_step": round(dt / steps * 1e3, 1),
+            "tokens_per_sec": round(tokens_per_step * steps / dt, 1),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Throughput sweep for bench.py tuning: remat × batch × attention impl.
+
+Uses the fused K-step dispatch (Trainer._train_chunk) and an honest
+device_get sync on the final loss, so tunnel dispatch latency is amortized
+and the timer can't stop before the device work exists. Prints one JSON line
+per config. Used to pick the flagship bench configuration; not run by the
+driver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K = 10          # steps per device dispatch
+N_CHUNKS = 4    # timed dispatches → K * N_CHUNKS steps
+
+
+def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
+        accum: int = 1, dtype: str = "f32") -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_lion_tpu.data.sources import synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    mesh = make_mesh()
+    model_cfg = dataclasses.replace(
+        GPT2Config.gpt2_124m(), remat=remat, attn_impl=attn_impl,
+        param_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
+    )
+    cfg = TrainConfig(
+        lion=True, async_grad=True, learning_rate=1e-4, weight_decay=0.1,
+        warmup_steps=10, max_steps=10_000,
+        per_device_train_batch_size=batch_per_dev,
+        gradient_accumulation_steps=accum, block_size=model_cfg.n_ctx,
+        steps_per_call=K, logging_steps=10_000, output_dir=None,
+    )
+    trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
+    global_bs = trainer.global_train_batch()
+    tokens_per_step = global_bs * cfg.block_size
+    blocks = synthetic_lm_dataset(global_bs * K, cfg.block_size,
+                                  model_cfg.vocab_size, seed=0)
+    batches = jax.device_put(
+        blocks[: global_bs * K].astype(np.int32).reshape(K, global_bs, cfg.block_size),
+        NamedSharding(mesh, P(None, "data")),
+    )
+    key = jax.random.key(0)
+    trainer.params, trainer.state, m = trainer._train_chunk(
+        trainer.params, trainer.state, batches, key
+    )
+    _ = float(np.asarray(jax.device_get(m["loss"])))  # warmup + honest sync
+    t0 = time.perf_counter()
+    for _ in range(N_CHUNKS):
+        trainer.params, trainer.state, m = trainer._train_chunk(
+            trainer.params, trainer.state, batches, key
+        )
+    final_loss = float(np.asarray(jax.device_get(m["loss"])))
+    dt = time.perf_counter() - t0
+    steps = K * N_CHUNKS
+    tps = tokens_per_step * steps / dt / n_dev
+    print(json.dumps({
+        "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_impl,
+        "accum": accum, "dtype": dtype,
+        "ms_per_step": round(dt / steps * 1e3, 1), "loss": round(final_loss, 3),
+        "tokens_per_sec_per_chip": round(tps, 1),
+    }), flush=True)
+    return tps
+
+
+if __name__ == "__main__":
+    DEFAULTS = ["auto", "1", "f32"]  # attn, accum, dtype
+    for spec in sys.argv[1:]:
+        parts = spec.split(":")
+        parts += DEFAULTS[len(parts) - 2:]  # pad only the missing tail
+        remat_s, bs_s, attn, accum_s, dtype = parts[:5]
+        try:
+            run(remat_s == "remat", int(bs_s), attn, int(accum_s), dtype)
+        except Exception as e:  # OOM on big configs: report and keep sweeping
+            print(json.dumps({
+                "remat": remat_s == "remat", "batch_per_dev": int(bs_s),
+                "attn": attn, "accum": int(accum_s), "dtype": dtype,
+                "error": str(e).split("\n")[0][:160],
+            }), flush=True)

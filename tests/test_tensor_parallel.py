@@ -50,6 +50,14 @@ def test_dp_tp_training_runs_and_learns():
     # TP-sharded weights really are sharded over the tensor axis
     qkv = trainer.params["blocks"][0]["attn"]["qkv"]
     assert qkv.sharding.spec == P(None, None, TENSOR_AXIS)
+    # replicated leaves must not drift across tensor ranks: grads of LN /
+    # embeddings are completed by the copy_to_tp_region backward psum —
+    # without it each tensor rank votes on its own partial grad (regression
+    # for the missing Megatron f-operator)
+    for leaf in (trainer.params["ln_f"]["scale"], trainer.params["wte"]):
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
     trainer.close()
 
 
