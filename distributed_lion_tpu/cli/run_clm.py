@@ -56,7 +56,7 @@ class DataArguments:
     bin_dtype: str = "uint16"  # token width of bin: shards (uint16 | uint32)
 
 
-def build_mesh(tensor_parallel: int = 1):
+def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1):
     import jax
 
     from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
@@ -64,8 +64,28 @@ def build_mesh(tensor_parallel: int = 1):
     if os.environ.get("DLION_PLATFORM") == "cpu8":
         jax.config.update("jax_platforms", "cpu")
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    enable_compilation_cache()
     multihost_initialize()
-    return make_mesh(tensor=tensor_parallel)
+    return make_mesh(tensor=tensor_parallel, seq=seq_parallel)
+
+
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache (~20-40s per TPU compile amortized
+    across runs). Opt-out with DLION_COMPILE_CACHE=0; directory override via
+    DLION_COMPILE_CACHE_DIR."""
+    import jax
+
+    if os.environ.get("DLION_COMPILE_CACHE", "1") == "0":
+        return
+    cache_dir = os.environ.get(
+        "DLION_COMPILE_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "dlion_xla"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # older jax without the knob: run uncached
+        print(f"[run_clm] compilation cache unavailable: {e}")
 
 
 VOCAB_PROBE_TOKENS = 4_000_000  # sample budget for the token-id range check
@@ -198,7 +218,7 @@ def main(argv=None):
     from distributed_lion_tpu.models.gpt2 import GPT2Config
     from distributed_lion_tpu.train.loop import Trainer
 
-    mesh = build_mesh(train_cfg.tensor_parallel)
+    mesh = build_mesh(train_cfg.tensor_parallel, train_cfg.seq_parallel)
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
     common = dict(
         dropout=model_args.dropout,
@@ -245,6 +265,12 @@ def main(argv=None):
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:
             trainer.save()
+        if train_cfg.output_dir:
+            # portable single-file export (HF save_pretrained role) —
+            # consumed by cli/run_generate
+            from distributed_lion_tpu.utils.serialization import save_pytree
+
+            save_pytree(f"{train_cfg.output_dir}/model.npz", trainer.params)
     finally:
         trainer.close()
 

@@ -1,0 +1,95 @@
+"""Text-generation entry point: load an exported model, decode with KV cache.
+
+Net-new vs the reference (it has no inference path). Completes the train →
+export → use cycle: ``run_clm``/``run_sft`` export ``model.npz`` via
+utils.serialization; this CLI loads it and generates.
+
+    python -m distributed_lion_tpu.cli.run_generate \
+        --model_path ./out/model.npz --model_family gpt2 --model_name tiny \
+        --prompt "Question: " --max_new_tokens 64 --temperature 0.8 --top_k 40
+
+With no --model_path, random-init weights are used (smoke mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+
+@dataclasses.dataclass
+class GenerateArguments:
+    model_path: Optional[str] = None  # .npz from utils.serialization (else random init)
+    model_family: str = "gpt2"  # gpt2 | llama
+    model_name: str = "tiny"    # gpt2: gpt2_124m | tiny; llama: llama2_7b | llama3_8b | tiny
+    tokenizer_name: Optional[str] = None  # HF cache name; byte tokenizer otherwise
+    prompt: str = "Hello"
+    max_new_tokens: int = 64
+    temperature: float = 0.8
+    top_k: Optional[int] = 40
+    seed: int = 0
+    vocab_size: Optional[int] = None
+
+
+def build(args: GenerateArguments):
+    import jax
+
+    from distributed_lion_tpu.data.tokenizer import load_tokenizer
+    from distributed_lion_tpu.utils.serialization import load_pytree
+
+    tok = load_tokenizer(args.tokenizer_name)
+    vocab = args.vocab_size or tok.vocab_size
+
+    if args.model_family == "gpt2":
+        from distributed_lion_tpu.models.gpt2 import (
+            GPT2Config, gpt2_decode, gpt2_init, gpt2_init_cache,
+        )
+
+        cfg = (GPT2Config.tiny if args.model_name == "tiny" else GPT2Config.gpt2_124m)(
+            vocab_size=vocab
+        )
+        params = (load_pytree(args.model_path) if args.model_path
+                  else gpt2_init(jax.random.key(args.seed), cfg))
+        decode = partial(lambda c, p, t, k, pos: gpt2_decode(p, t, c, k, pos), cfg)
+        init_cache = partial(gpt2_init_cache, cfg)
+    elif args.model_family == "llama":
+        from distributed_lion_tpu.models.llama import (
+            LlamaConfig, llama_decode, llama_init, llama_init_cache,
+        )
+
+        factory = {"tiny": LlamaConfig.tiny, "llama2_7b": LlamaConfig.llama2_7b,
+                   "llama3_8b": LlamaConfig.llama3_8b}[args.model_name]
+        cfg = factory(vocab_size=vocab)
+        params = (load_pytree(args.model_path) if args.model_path
+                  else llama_init(jax.random.key(args.seed), cfg))
+        decode = partial(lambda c, p, t, k, pos: llama_decode(p, t, c, k, pos), cfg)
+        init_cache = partial(llama_init_cache, cfg)
+    else:
+        raise ValueError(f"unknown model family {args.model_family!r}")
+    return tok, cfg, params, decode, init_cache
+
+
+def main(argv=None):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.generate import generate
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    (args,) = parse_dataclasses((GenerateArguments,), argv)
+    tok, cfg, params, decode, init_cache = build(args)
+    ids = tok.encode(args.prompt, add_bos=False) or [0]
+    prompt = jnp.asarray([ids], jnp.int32)
+    out = generate(
+        decode, init_cache, params, prompt, args.max_new_tokens,
+        key=jax.random.key(args.seed), temperature=args.temperature,
+        top_k=args.top_k, eos_id=getattr(tok, "eos_id", None),
+    )
+    text = tok.decode([int(t) for t in out[0]])
+    print(args.prompt + text)
+    return text
+
+
+if __name__ == "__main__":
+    main()

@@ -1,2 +1,16 @@
-from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init, gpt2_apply
+from distributed_lion_tpu.models.generate import generate, sample_logits
+from distributed_lion_tpu.models.gpt2 import (
+    GPT2Config,
+    gpt2_apply,
+    gpt2_decode,
+    gpt2_init,
+    gpt2_init_cache,
+)
+from distributed_lion_tpu.models.llama import (
+    LlamaConfig,
+    llama_apply,
+    llama_decode,
+    llama_init,
+    llama_init_cache,
+)
 from distributed_lion_tpu.models.loss import clm_loss_and_metrics

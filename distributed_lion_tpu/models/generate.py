@@ -1,0 +1,72 @@
+"""Autoregressive generation: KV-cache prefill + decode with sampling.
+
+Net-new capability vs the reference (which has no inference path anywhere —
+its three scripts only train). TPU-first design: the whole generation runs
+as ONE jitted ``lax.scan`` over decode steps — static shapes (fixed-size KV
+cache written at a position index), no host round-trip per token.
+
+Sampling: greedy (``temperature=0``), temperature, and top-k, with explicit
+PRNG keys. EOS handling: once a row emits ``eos_id`` every later position is
+padded with ``pad_id`` (the sampled token is masked), so finished rows cost
+no extra host logic.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def sample_logits(logits: jnp.ndarray, key, temperature: float = 1.0,
+                  top_k: Optional[int] = None) -> jnp.ndarray:
+    """[B, V] logits → [B] sampled token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("decode_fn", "init_cache_fn", "max_new_tokens",
+                                   "temperature", "top_k", "eos_id", "pad_id",
+                                   "max_len"))
+def generate(decode_fn, init_cache_fn, params, prompt: jnp.ndarray,
+             max_new_tokens: int, *, key=None, temperature: float = 0.0,
+             top_k: Optional[int] = None, eos_id: Optional[int] = None,
+             pad_id: int = 0, max_len: Optional[int] = None) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations for ``prompt`` [B, T].
+
+    ``decode_fn(params, tokens, cache, pos) -> (logits, cache)`` and
+    ``init_cache_fn(batch, max_len) -> cache`` come from the model module
+    (``gpt2_decode``/``gpt2_init_cache`` or the llama pair, partially applied
+    over their config). Returns [B, max_new_tokens] token ids.
+    """
+    B, T = prompt.shape
+    total = max_len or (T + max_new_tokens)
+    cache = init_cache_fn(B, total)
+    key = key if key is not None else jax.random.key(0)
+
+    logits, cache = decode_fn(params, prompt, cache, 0)  # prefill
+    tok = sample_logits(logits[:, -1], key, temperature, top_k)
+    finished = jnp.zeros((B,), bool) if eos_id is None else tok == eos_id
+
+    def step(carry, i):
+        tok, cache, finished, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = decode_fn(params, tok[:, None], cache, T + i)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        if eos_id is not None:
+            nxt = jnp.where(finished, pad_id, nxt)
+            finished = finished | (nxt == eos_id)
+        return (nxt, cache, finished, key), tok
+
+    (last, _, _, _), toks = lax.scan(
+        step, (tok, cache, finished, key), jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)  # [B, max_new]

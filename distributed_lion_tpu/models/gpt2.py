@@ -25,6 +25,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from distributed_lion_tpu.ops.attention import attention as shared_attention
 from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
@@ -117,12 +118,15 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
-def _attention(x, p, cfg: GPT2Config, key, tp_axis=None):
+def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
     """Causal multi-head attention; f32 softmax for stability.
 
     With ``tp_axis`` (Megatron tensor parallelism): qkv is column-parallel
     (this device holds H/tp heads), proj is row-parallel (partial sums are
     psum-reduced over the tensor axis; bias added after the reduction).
+    With ``seq_axis`` (sequence/context parallelism): x holds this device's
+    contiguous token chunk and attention runs as ring attention — (k, v)
+    blocks rotate over the seq axis (parallel.ring_attention).
     """
     B, T, D = x.shape
     tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
@@ -140,9 +144,11 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None):
     k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
 
-    if cfg.dropout > 0.0 and key is not None:
+    if cfg.dropout > 0.0 and key is not None and seq_axis is None:
         # attention-prob dropout needs materialized scores; training with
-        # dropout keeps the XLA path
+        # dropout keeps the XLA path. Under sequence parallelism the scores
+        # never exist in one place, so attention-prob dropout is skipped
+        # (residual/embedding dropout still applies).
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
         scores = scores / math.sqrt(hd)
         causal = jnp.tril(jnp.ones((T, T), bool))
@@ -151,6 +157,10 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None):
         probs = _dropout(probs, cfg.dropout, key)
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
         out = out.astype(x.dtype)
+    elif seq_axis is not None:
+        from distributed_lion_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, axis_name=seq_axis)
     else:
         out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
@@ -171,7 +181,7 @@ def _mlp(x, p, tp_axis=None):
     return out + p["proj_b"].astype(x.dtype)
 
 
-def _block(x, p, key, cfg: GPT2Config, tp_axis=None):
+def _block(x, p, key, cfg: GPT2Config, tp_axis=None, seq_axis=None):
     """One pre-LN transformer block. When ``cfg.remat`` the block is wrapped
     in ``jax.checkpoint`` so activations are recomputed in backward — HBM for
     FLOPs, the standard TPU trade for big models/long context; small models
@@ -179,14 +189,14 @@ def _block(x, p, key, cfg: GPT2Config, tp_axis=None):
     forward FLOPs in backward."""
     k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
     x = x + _dropout(
-        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, tp_axis),
+        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, tp_axis, seq_axis),
         cfg.dropout, k2,
     )
     x = x + _dropout(_mlp(_layer_norm(x, p["ln_2"]), p["mlp"], tp_axis), cfg.dropout, k3)
     return x
 
 
-_block_remat = partial(jax.checkpoint, static_argnums=(3, 4))(_block)
+_block_remat = partial(jax.checkpoint, static_argnums=(3, 4, 5))(_block)
 
 
 def gpt2_apply(
@@ -196,18 +206,31 @@ def gpt2_apply(
     *,
     dropout_key: Optional[jax.Array] = None,
     tp_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
 
     Output projection is tied to the input embedding (GPT-2 weight tying).
     With ``tp_axis`` (inside shard_map), attention/MLP weights are expected
-    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``.
+    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``. With
+    ``seq_axis`` (sequence parallelism), ``tokens`` is this device's
+    contiguous chunk of the full sequence: positions offset by the shard
+    index, attention rings over the axis, per-shard dropout keys.
     """
     B, T = tokens.shape
-    if T > cfg.n_ctx:
-        raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+    if seq_axis is None:
+        if T > cfg.n_ctx:
+            raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+        pos_start = 0
+    else:
+        sidx = lax.axis_index(seq_axis)
+        pos_start = sidx * T
+        if dropout_key is not None:
+            dropout_key = jax.random.fold_in(dropout_key, sidx)
     x = params["wte"][tokens].astype(cfg.compute_dtype)
-    x = x + params["wpe"][:T].astype(cfg.compute_dtype)
+    x = x + lax.dynamic_slice_in_dim(params["wpe"], pos_start, T, axis=0).astype(
+        cfg.compute_dtype
+    )
     keys = (
         [None] * (cfg.n_layer + 1)
         if dropout_key is None
@@ -216,7 +239,7 @@ def gpt2_apply(
     x = _dropout(x, cfg.dropout, keys[-1])
     block = _block_remat if cfg.remat else _block
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
-        x = block(x, p, k, cfg, tp_axis)
+        x = block(x, p, k, cfg, tp_axis, seq_axis)
     x = _layer_norm(x, params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),
@@ -227,3 +250,62 @@ def gpt2_apply(
 
 def count_params(params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
+
+
+# ------------------------------------------------------------------ decoding
+def gpt2_init_cache(cfg: GPT2Config, batch: int, max_len: int) -> list:
+    """Per-layer KV cache [B, H, max_len, hd] (static shape: decode writes
+    into a fixed-size buffer with a position index — no dynamic shapes under
+    jit). Net-new vs the reference, which has no inference path at all."""
+    shape = (batch, cfg.n_head, max_len, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, cfg.compute_dtype), "v": jnp.zeros(shape, cfg.compute_dtype)}
+        for _ in range(cfg.n_layer)
+    ]
+
+
+def _decode_attention(x, p, cfg: GPT2Config, c, pos):
+    """Cache-aware attention for S new tokens at absolute position ``pos``:
+    project qkv for the new tokens, write k/v into the cache, attend q over
+    the whole (masked) cache."""
+    B, S, _ = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    qkv = jnp.einsum(
+        "btd,dce->btce", x, p["qkv"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+    q, k, v = (qkv[:, :, i].reshape(B, S, H, hd).transpose(0, 2, 1, 3) for i in range(3))
+    k_cache = lax.dynamic_update_slice_in_dim(c["k"], k.astype(c["k"].dtype), pos, axis=2)
+    v_cache = lax.dynamic_update_slice_in_dim(c["v"], v.astype(c["v"].dtype), pos, axis=2)
+    T = k_cache.shape[2]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_cache,
+                        preferred_element_type=jnp.float32) / math.sqrt(hd)
+    valid = jnp.arange(T)[None, :] <= (pos + jnp.arange(S))[:, None]  # causal + unwritten
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v_cache,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    out = out @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+    return out, {"k": k_cache, "v": v_cache}
+
+
+def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list, pos):
+    """Incremental forward: ``tokens`` [B, S] are the next S tokens at
+    absolute positions [pos, pos+S). Returns (logits [B, S, vocab] f32,
+    updated cache). ``gpt2_decode(params, prompt, cfg, cache, 0)`` is the
+    prefill; single-token calls are the decode loop. Matches ``gpt2_apply``
+    logits position-for-position (pinned by tests/test_generate.py)."""
+    B, S = tokens.shape
+    x = params["wte"][tokens].astype(cfg.compute_dtype)
+    x = x + lax.dynamic_slice_in_dim(params["wpe"], pos, S, axis=0).astype(cfg.compute_dtype)
+    new_cache = []
+    for p, c in zip(params["blocks"], cache):
+        a, c = _decode_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, c, pos)
+        x = x + a
+        x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+        new_cache.append(c)
+    x = _layer_norm(x, params["ln_f"])
+    logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, new_cache

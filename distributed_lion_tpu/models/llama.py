@@ -107,10 +107,12 @@ def _rms_norm(x, p, eps):
     return (x32 * scale * p["scale"].astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_angles(t: int, head_dim: int, theta: float, offset: int = 0) -> tuple:
-    """cos/sin tables [T, head_dim/2] (f32)."""
+def rope_angles(t: int, head_dim: int, theta: float, offset=0) -> tuple:
+    """cos/sin tables [T, head_dim/2] (f32). ``offset`` may be a traced
+    scalar (sequence-parallel shard start), so the arange is static-length
+    with the offset added."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, jnp.float32) / head_dim))
-    pos = jnp.arange(offset, offset + t, dtype=jnp.float32)
+    pos = jnp.arange(t, dtype=jnp.float32) + offset
     ang = jnp.outer(pos, inv_freq)
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -130,10 +132,12 @@ def _matmul(x, w):
     return x @ w.astype(x.dtype)
 
 
-def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
+def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):
     """GQA attention; with ``tp_axis``, wq/wk/wv are column-parallel (this
     device holds n_head/tp query and n_kv_head/tp kv heads) and wo is
-    row-parallel with a psum over the tensor axis (Megatron pattern)."""
+    row-parallel with a psum over the tensor axis (Megatron pattern). With
+    ``seq_axis``, x is this device's contiguous token chunk and attention
+    rings over the sequence axis (cos/sin already offset by the caller)."""
     B, T, D = x.shape
     tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
     if tp_axis is not None:
@@ -149,7 +153,12 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
         rep = H // KV
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+    if seq_axis is not None:
+        from distributed_lion_tpu.parallel.ring_attention import ring_attention
+
+        out = ring_attention(q, k, v, axis_name=seq_axis)
+    else:
+        out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _matmul(out, p["wo"])
     if tp_axis is not None:
@@ -167,14 +176,74 @@ def _mlp(x, p, tp_axis=None):
     return out
 
 
-def _block(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None):
+def _block(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):
     x = x + _attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"], cfg,
-                       cos, sin, tp_axis)
+                       cos, sin, tp_axis, seq_axis)
     x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"], tp_axis)
     return x
 
 
-_block_remat = partial(jax.checkpoint, static_argnums=(2, 5))(_block)
+_block_remat = partial(jax.checkpoint, static_argnums=(2, 5, 6))(_block)
+
+
+def llama_init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> list:
+    """Per-layer KV cache [B, n_kv_head, max_len, hd] — stored UN-repeated
+    (GQA): repeat-to-query-heads happens at attend time, so cache memory
+    scales with kv heads, the GQA payoff."""
+    shape = (batch, cfg.n_kv_head, max_len, cfg.head_dim)
+    return [
+        {"k": jnp.zeros(shape, cfg.compute_dtype), "v": jnp.zeros(shape, cfg.compute_dtype)}
+        for _ in range(cfg.n_layer)
+    ]
+
+
+def _decode_attention(x, p, cfg: LlamaConfig, c, pos, cos, sin):
+    B, S, _ = x.shape
+    H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q = _matmul(x, p["wq"]).reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = _matmul(x, p["wk"]).reshape(B, S, KV, hd).transpose(0, 2, 1, 3)
+    v = _matmul(x, p["wv"]).reshape(B, S, KV, hd).transpose(0, 2, 1, 3)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(c["k"], k.astype(c["k"].dtype), pos, axis=2)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(c["v"], v.astype(c["v"].dtype), pos, axis=2)
+    rep = H // KV
+    k_full = jnp.repeat(k_cache, rep, axis=1) if rep > 1 else k_cache
+    v_full = jnp.repeat(v_cache, rep, axis=1) if rep > 1 else v_cache
+    T = k_cache.shape[2]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_full,
+                        preferred_element_type=jnp.float32) / math.sqrt(hd)
+    valid = jnp.arange(T)[None, :] <= (pos + jnp.arange(S))[:, None]
+    scores = jnp.where(valid[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v_full,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    return _matmul(out, p["wo"]), {"k": k_cache, "v": v_cache}
+
+
+def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: list, pos):
+    """Incremental forward with rotary offset: prefill with the prompt at
+    pos=0, then one token at a time. Matches ``llama_apply`` logits
+    position-for-position (tests/test_generate.py)."""
+    B, S = tokens.shape
+    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
+    # rope tables at the absolute positions of these S tokens: build a
+    # max-length table once and slice at pos (pos is traced under jit)
+    cos_all, sin_all = rope_angles(cache[0]["k"].shape[2], cfg.head_dim, cfg.rope_theta)
+    cos = jax.lax.dynamic_slice_in_dim(cos_all, pos, S, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_all, pos, S, axis=0)
+    new_cache = []
+    for p, c in zip(params["blocks"], cache):
+        a, c = _decode_attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"],
+                                 cfg, c, pos, cos, sin)
+        x = x + a
+        x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
+        new_cache.append(c)
+    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    logits = jnp.einsum("btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, new_cache
 
 
 def llama_apply(
@@ -184,20 +253,27 @@ def llama_apply(
     *,
     dropout_key: Optional[jax.Array] = None,  # parity arg; Llama uses none
     tp_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """int32 tokens [B, T] → f32 logits [B, T, vocab].
 
     With ``tp_axis`` (inside shard_map), weights are expected pre-sharded per
-    ``parallel.tensor_parallel.llama_param_specs``.
+    ``parallel.tensor_parallel.llama_param_specs``. With ``seq_axis``,
+    ``tokens`` is this device's contiguous chunk: rotary angles are offset by
+    the shard index and attention rings over the axis.
     """
     B, T = tokens.shape
-    if T > cfg.n_ctx:
-        raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+    if seq_axis is None:
+        if T > cfg.n_ctx:
+            raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+        offset = 0
+    else:
+        offset = jax.lax.axis_index(seq_axis) * T
     x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
-    cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta, offset=offset)
     block = _block_remat if cfg.remat else _block
     for p in params["blocks"]:
-        x = block(x, p, cfg, cos, sin, tp_axis)
+        x = block(x, p, cfg, cos, sin, tp_axis, seq_axis)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     return jnp.einsum(
         "btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),

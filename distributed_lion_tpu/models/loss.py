@@ -45,3 +45,50 @@ def clm_loss_and_metrics(
     pred = shift_logits.argmax(-1)
     acc = ((pred == shift_labels) * mask).sum() / n
     return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}
+
+
+def clm_loss_seq_parallel(
+    logits: jnp.ndarray,
+    tokens: jnp.ndarray,
+    axis_name: str,
+) -> tuple[jnp.ndarray, dict]:
+    """CLM loss under sequence parallelism (inside shard_map).
+
+    Each device holds a contiguous chunk ``tokens`` [B, T_local] of the full
+    sequence and that chunk's ``logits``. The label of a chunk's LAST
+    position is the NEXT chunk's first token — fetched with one tiny
+    ``ppermute`` ([B, 1] per hop) — so no token's loss signal is dropped at
+    shard boundaries; only the final position of the final chunk (which has
+    no next token, exactly like the last position in the non-parallel loss)
+    is masked.
+
+    Returns a loss whose value is ``local_nll_sum / global_token_count`` —
+    psum of its GRADIENT over ``axis_name`` equals the full-sequence
+    gradient, which is how the train loop reduces it. The reported metrics
+    are globally reduced (identical on every shard).
+    """
+    S = jax.lax.psum(1, axis_name)
+    sidx = jax.lax.axis_index(axis_name)
+    # my last position's label = next shard's first token (shard i gets it
+    # from shard i+1; shard S-1 receives garbage from shard 0 and masks it)
+    nxt = jax.lax.ppermute(
+        tokens[:, :1], axis_name, [(i, (i - 1) % S) for i in range(S)]
+    )
+    labels = jnp.concatenate([tokens[:, 1:], nxt], axis=1)  # [B, T_local]
+    mask = jnp.ones(labels.shape, jnp.float32)
+    mask = mask.at[:, -1].set(jnp.where(sidx == S - 1, 0.0, 1.0))
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    n_global = jnp.maximum(jax.lax.psum(mask.sum(), axis_name), 1.0)
+    loss_local = (nll * mask).sum() / n_global  # grad psums to the full grad
+
+    pred = logits.argmax(-1)
+    acc = jax.lax.psum(((pred == labels) * mask).sum(), axis_name) / n_global
+    loss_global = jax.lax.psum(loss_local, axis_name)
+    return loss_local, {
+        "loss": loss_global,
+        "accuracy": acc,
+        "n_tokens": n_global / jnp.maximum(S, 1),  # per-shard average, matches
+        # the replicated path's per-device count convention for logging
+    }

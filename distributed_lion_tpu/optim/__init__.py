@@ -5,3 +5,9 @@ from distributed_lion_tpu.optim.distributed_lion import (
     squeeze_worker_state,
     expand_worker_state,
 )
+from distributed_lion_tpu.optim.zero import (
+    Zero1State,
+    adamw_zero1,
+    expand_zero_state,
+    squeeze_zero_state,
+)

@@ -41,7 +41,18 @@ from distributed_lion_tpu.optim import (
 )
 from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
 from distributed_lion_tpu.optim.optax_adapter import OptaxState, adamw
-from distributed_lion_tpu.parallel.mesh import DATA_AXIS, TENSOR_AXIS, data_axis_size
+from distributed_lion_tpu.optim.zero import (
+    Zero1State,
+    adamw_zero1,
+    expand_zero_state,
+    squeeze_zero_state,
+)
+from distributed_lion_tpu.parallel.mesh import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+    data_axis_size,
+)
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import StepProfiler, StepTimer, comm_report
@@ -61,10 +72,16 @@ class TrainConfig:
 
     lion: bool = True
     async_grad: bool = True
+    zero1: bool = False  # AdamW path only: shard Adam m/v over the data axis
+    # (ZeRO-1, optim/zero.py) — 2N/W floats of optimizer state per device
+    # instead of 2N, updated chunks re-assembled with one all_gather.
     wire: str = "sign_psum"
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
                               # when building the mesh; net-new vs reference)
+    seq_parallel: int = 1  # sequence/context mesh axis size: batches are
+                           # sharded over tokens, attention rings over the
+                           # 'seq' axis (parallel.ring_attention); net-new
     max_grad_norm: Optional[float] = None  # set → stochastic binarization
     grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
     # (HF Trainer, which the reference sits on, clips at 1.0 by default —
@@ -131,6 +148,9 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
     # default weight_decay=0.1 matches the reference's hardcoded AdamW value
     # (run_clm.py:583-585), but an explicit --weight_decay is honored here
     # rather than silently dropped as the reference does.
+    if cfg.zero1:
+        return adamw_zero1(cfg.schedule(), weight_decay=cfg.weight_decay,
+                           axis_name=DATA_AXIS)
     return adamw(cfg.schedule(), weight_decay=cfg.weight_decay)
 
 
@@ -139,6 +159,9 @@ def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
         # stacked per-worker momentum: [world, ...] over 'data' (+ any
         # tensor-parallel dims the param itself carries)
         return LionState(count=P(), exp_avg=exp_avg_specs, rng=P())
+    if cfg.zero1:
+        # [world, chunk] m/v sharded over 'data': ZeRO-1 state partitioning
+        return Zero1State(count=P(), m=P(DATA_AXIS), v=P(DATA_AXIS))
     return OptaxState(count=P(), inner=P(), rng=P())  # replicated
 
 
@@ -158,6 +181,7 @@ class Trainer:
         loss_mask_fn: Optional[Callable] = None,
         loss_fn: Optional[Callable] = None,
         param_specs: Any = None,
+        batch_spec: Optional[P] = None,
     ):
         """``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` may
         replace the default CLM loss; ``batch`` is then any pytree whose
@@ -168,6 +192,7 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
+        self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         self.apply_fn = apply_fn
         self.opt = make_optimizer(cfg)
         if param_specs is None:
@@ -196,6 +221,16 @@ class Trainer:
                         lambda s: NamedSharding(mesh, s), self._exp_avg_specs
                     ),
                     rng=None if state.rng is None else NamedSharding(mesh, P()),
+                ),
+            )
+        elif cfg.zero1:
+            state = self.opt.init(self.params, world=self.world)
+            self.state = jax.device_put(
+                state,
+                Zero1State(
+                    count=NamedSharding(mesh, P()),
+                    m=NamedSharding(mesh, P(DATA_AXIS)),
+                    v=NamedSharding(mesh, P(DATA_AXIS)),
                 ),
             )
         else:
@@ -245,10 +280,12 @@ class Trainer:
 
         st_specs = _opt_state_specs(cfg, self._exp_avg_specs if cfg.lion else None)
 
+        sp = dict(self.mesh.shape).get(SEQ_AXIS, 1)
+
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(self.param_specs, st_specs, P(DATA_AXIS), P()),
+            in_specs=(self.param_specs, st_specs, self.batch_spec, P()),
             out_specs=(self.param_specs, st_specs, P()),
             check_vma=False,
         )
@@ -272,6 +309,11 @@ class Trainer:
             gsum, metrics = lax.scan(micro, zeros, (local, jnp.arange(accum)))
             grads = jax.tree.map(lambda g: g / accum, gsum)
 
+            if sp > 1:
+                # sequence parallelism: each seq shard computed the grad of
+                # ITS tokens' loss term (normalized by the global token
+                # count) — the full gradient is their sum.
+                grads = lax.psum(grads, SEQ_AXIS)
             if not cfg.async_grad:
                 # classic DDP all-reduce; the reference's non-async path.
                 grads = lax.pmean(grads, DATA_AXIS)
@@ -288,9 +330,19 @@ class Trainer:
                 # shards of one gradient scale uniformly.
                 grads = clip_by_global_norm(grads, clip, specs=param_specs,
                                             tp_axis=tp_axis)
-            st = squeeze_worker_state(state) if cfg.lion else state
+            if cfg.lion:
+                st = squeeze_worker_state(state)
+            elif cfg.zero1:
+                st = squeeze_zero_state(state)
+            else:
+                st = state
             new_params, new_st = opt.step(params, grads, st)
-            new_state = expand_worker_state(new_st) if cfg.lion else new_st
+            if cfg.lion:
+                new_state = expand_worker_state(new_st)
+            elif cfg.zero1:
+                new_state = expand_zero_state(new_st)
+            else:
+                new_state = new_st
 
             mean_metrics = {k: lax.pmean(v.mean(), DATA_AXIS) for k, v in metrics.items()}
             return new_params, new_state, mean_metrics
@@ -322,7 +374,7 @@ class Trainer:
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(self.param_specs, P(DATA_AXIS)),
+            in_specs=(self.param_specs, self.batch_spec),
             out_specs=P(),
             check_vma=False,
         )
@@ -348,7 +400,7 @@ class Trainer:
         cfg = self.cfg
         total = min(cfg.max_steps, self.step_count + max_steps if max_steps else cfg.max_steps)
         history = []
-        data_spec = NamedSharding(self.mesh, P(DATA_AXIS))
+        data_spec = NamedSharding(self.mesh, self.batch_spec)
         base_key = jax.random.key(cfg.seed + 1)
         tokens_per_step = self.global_train_batch() * cfg.block_size
         # After resume, fast-forward the (deterministically seeded) data
@@ -359,7 +411,7 @@ class Trainer:
                 next(train_iter)
             self._resume_skip_batches = 0
         t_last, s_last = time.time(), self.step_count
-        chunk_spec = NamedSharding(self.mesh, P(None, DATA_AXIS))
+        chunk_spec = NamedSharding(self.mesh, P(None, *self.batch_spec))
 
         while self.step_count < total:
             self.profiler.maybe_start(self.step_count)
@@ -429,7 +481,7 @@ class Trainer:
             print(f"[trainer] eval skipped: {n_examples} examples < world {self.world}")
             return {"eval/loss": float("nan"), "eval/accuracy": float("nan"),
                     "eval/perplexity": float("nan")}
-        data_spec = NamedSharding(self.mesh, P(DATA_AXIS))
+        data_spec = NamedSharding(self.mesh, self.batch_spec)
         per_key: dict = {}
         n_batches = min(cfg.eval_iters, n_examples // bs)
         for i in range(n_batches):
@@ -508,11 +560,27 @@ class Trainer:
             param_specs = gpt2_param_specs(model_cfg)
             tp_axis = TENSOR_AXIS
 
+        sp = dict(mesh.shape).get(SEQ_AXIS, 1)
+        seq_axis = SEQ_AXIS if sp > 1 else None
+        batch_spec = None
+        loss_fn = None
+        if seq_axis:
+            if cfg.block_size % sp:
+                raise ValueError(f"block_size {cfg.block_size} not divisible by "
+                                 f"seq axis {sp}")
+            batch_spec = P(DATA_AXIS, SEQ_AXIS)  # rows over data, tokens over seq
+            from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
+
+            def loss_fn(params, batch, dropout_key):
+                logits = apply_fn(params, batch, dropout_key)
+                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
         def apply_fn(params, tokens, dropout_key):
             return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key,
-                              tp_axis=tp_axis)
+                              tp_axis=tp_axis, seq_axis=seq_axis)
 
-        return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs)
+        return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs,
+                       loss_fn=loss_fn, batch_spec=batch_spec)
 
 
 def _count_of(state) -> jnp.ndarray:

@@ -54,6 +54,29 @@ def test_loss_decreases_under_vote_lion():
     assert losses[-1] < losses[0] - 0.3, f"loss did not fall: {losses}"
 
 
+def test_vote_lion_loss_parity_with_single_worker():
+    """BASELINE.md discipline (a): 8-worker majority-vote Lion tracks
+    single-worker Lion's loss curve at equal global batch. The algorithms
+    differ (majority of per-worker signs vs sign of pooled momentum) so the
+    match is statistical, not exact — final losses within 15%."""
+    model_cfg = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(512, 32, model_cfg.vocab_size)
+
+    def final_loss(mesh, world):
+        # equal global batch: world * per_device * accum = 16 in both runs
+        cfg = _tiny_cfg(per_device_train_batch_size=16 // world // 2,
+                        gradient_accumulation_steps=2, max_steps=60)
+        t = Trainer.for_gpt2(cfg, mesh, model_cfg)
+        assert t.global_train_batch() == 16
+        h = t.train(batch_iterator(blocks, 16, seed=3), max_steps=60)
+        t.close()
+        return [x["loss"] for x in h if "loss" in x][-1]
+
+    loss_vote = final_loss(make_mesh(data=8), 8)
+    loss_single = final_loss(make_mesh(data=1, devices=jax.devices()[:1]), 1)
+    assert abs(loss_vote - loss_single) / loss_single < 0.15, (loss_vote, loss_single)
+
+
 def test_adamw_non_async_path():
     cfg = _tiny_cfg(lion=False, async_grad=False, learning_rate=1e-3)
     trainer, history, _ = _run(cfg, steps=20)
