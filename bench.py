@@ -1,13 +1,21 @@
-"""Benchmark: GPT-2 124M vote-Lion training throughput on the local chip(s).
+"""Benchmark: GPT-2 124M vote-Lion training throughput + MFU on the local chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+ALWAYS exits 0 with a parseable JSON line, even when the accelerator backend is
+down — round 1 lost its perf axis to a single hanging `jax.devices()` call
+(BENCH_r01.json rc=1), so the measurement now runs in a child process under a
+hard timeout with bounded retries, falling back to CPU on the final attempt so
+the driver always records *a* number plus diagnostics.
 
-The reference publishes no numbers (BASELINE.md); its stated target is "GPT-2
-124M on v5e-8 competitive with 8xA100 wall-clock". We anchor vs_baseline to
-100_000 tokens/s per device — a stand-in for per-A100 GPT-2-small training
-throughput under the reference's stack (HF Trainer + DDP + its Python-loop
-optimizer, which README.md:2 admits is slow) — so vs_baseline > 1 means one
-TPU chip under this framework out-trains one A100 under the reference.
+Anchor derivation (vs_baseline): the reference publishes no numbers
+(BASELINE.md); its stated target is "GPT-2 124M on v5e-8 competitive with
+8xA100". GPT-2 124M costs ~857 MFLOPs/token (6N = 744M for N=124M, plus
+12*L*d*T = 113M of attention matmuls at L=12, d=768, T=1024). An A100 at 312
+bf16 TFLOP/s would give ~145k tokens/s at a strong 40% MFU; under the
+reference's stack (HF Trainer + DDP + a per-tensor Python-loop optimizer its
+own README calls "currently slow") ~28% MFU is generous, giving the anchor
+BASELINE_TOKENS_PER_SEC_PER_DEVICE = 100_000. vs_baseline > 1 therefore means
+one TPU chip under this framework out-trains one A100 under the reference.
 
 Measurement discipline: the K optimizer steps of each timed dispatch run as
 ONE device program (Trainer._train_chunk, lax.scan over staged batches), and
@@ -20,20 +28,50 @@ reference's canonical bf16 config), microbatch 4 with 16-step grad
 accumulation — small microbatches keep the f32 attention-score traffic per
 pass low while accumulation amortizes the optimizer's full-pytree
 ballot/vote/apply passes over 16x the tokens.
+
+MFU = achieved model FLOP/s / chip peak bf16 FLOP/s, with model FLOPs/token =
+6N + 12*L*d*T (fwd+bwd, PaLM appendix-B convention, attention included,
+rematerialization not counted as useful work).
 """
 
 from __future__ import annotations
 
-import dataclasses
 import json
+import os
+import subprocess
+import sys
 import time
 
 BASELINE_TOKENS_PER_SEC_PER_DEVICE = 100_000.0
 STEPS_PER_CALL = 10
 TIMED_CALLS = 4
 
+# Peak dense bf16 FLOP/s per chip by device_kind substring (ordered: first
+# match wins). Public figures from cloud.google.com/tpu/docs/system-architecture.
+_PEAK_FLOPS = (
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
 
-def main() -> None:
+
+def _peak_flops_per_chip(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def run_inner() -> None:
+    """The actual measurement. Runs in a child process (see main)."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -44,7 +82,10 @@ def main() -> None:
     from distributed_lion_tpu.parallel.mesh import make_mesh
     from distributed_lion_tpu.train.loop import TrainConfig, Trainer
 
-    n_dev = len(jax.devices())
+    devices = jax.devices()
+    n_dev = len(devices)
+    backend = devices[0].platform
+    device_kind = devices[0].device_kind
     mesh = make_mesh()
     model_cfg = dataclasses.replace(
         GPT2Config.gpt2_124m(), remat=False, attn_impl="xla",
@@ -68,6 +109,7 @@ def main() -> None:
     trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
     global_bs = trainer.global_train_batch()
     tokens_per_step = global_bs * cfg.block_size
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(trainer.params))
 
     blocks = synthetic_lm_dataset(
         global_bs * STEPS_PER_CALL, cfg.block_size, model_cfg.vocab_size, seed=0
@@ -95,19 +137,102 @@ def main() -> None:
     steps = STEPS_PER_CALL * TIMED_CALLS
     tokens_per_sec = tokens_per_step * steps / dt
     per_chip = tokens_per_sec / n_dev
+
+    # Model FLOPs per token: 6N (fwd+bwd matmuls) + attention 12*L*d*T.
+    flops_per_token = (
+        6.0 * n_params
+        + 12.0 * model_cfg.n_layer * model_cfg.n_embd * cfg.block_size
+    )
+    peak = _peak_flops_per_chip(device_kind) if backend == "tpu" else None
+    mfu = (per_chip * flops_per_token / peak) if peak else None
+
+    on_tpu = backend == "tpu"
     print(
         json.dumps(
             {
                 "metric": "tokens/sec/chip, GPT-2 124M vote-Lion train step "
                 f"(microbatch {batch_per_dev}x{cfg.block_size}, accum {accum}, "
-                f"{n_dev} device(s))",
+                f"{n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
-                "vs_baseline": round(per_chip / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3),
+                "vs_baseline": (
+                    round(per_chip / BASELINE_TOKENS_PER_SEC_PER_DEVICE, 3)
+                    if on_tpu
+                    else 0.0
+                ),
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "flops_per_token": round(flops_per_token),
+                "n_params": n_params,
+                "backend": backend,
+                "device_kind": device_kind,
             }
-        )
+        ),
+        flush=True,
+    )
+
+
+def _extract_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(obj, dict) and "metric" in obj:
+                return obj
+    return None
+
+
+def main() -> None:
+    """Orchestrator: run the measurement in a child process under a hard
+    timeout, retry on failure, fall back to CPU, and ALWAYS print one JSON
+    line and exit 0. Never imports jax itself (backend init can hang)."""
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
+    attempts = (
+        ("default", {}),
+        ("default", {}),
+        ("cpu", {"JAX_PLATFORMS": "cpu"}),
+    )
+    errors: list[str] = []
+    for label, env_extra in attempts:
+        env = dict(os.environ)
+        env.update(env_extra)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+                env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"[{label}] timeout after {timeout_s:.0f}s")
+            continue
+        result = _extract_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            print(json.dumps(result), flush=True)
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        errors.append(f"[{label}] rc={proc.returncode}: " + " | ".join(tail))
+    print(
+        json.dumps(
+            {
+                "metric": "tokens/sec/chip, GPT-2 124M vote-Lion train step "
+                "(ALL BACKENDS FAILED)",
+                "value": 0.0,
+                "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": " || ".join(errors)[-2000:],
+            }
+        ),
+        flush=True,
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        run_inner()
+    else:
+        main()

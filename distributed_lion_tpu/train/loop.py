@@ -128,6 +128,19 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
     """The reference's optimizer wiring (run_clm.py:580-585): ``--lion`` →
     Lion(lr, wd) else AdamW(wd=0.1 hardcoded); both under a cosine-warmup
     schedule."""
+    if cfg.zero1 and cfg.lion:
+        raise ValueError(
+            "--zero1 applies only to the AdamW path; with --lion the optimizer "
+            "state is the per-worker vote momentum, which ZeRO-1 sharding "
+            "would silently drop — drop one of the two flags"
+        )
+    if cfg.zero1 and cfg.async_grad:
+        raise ValueError(
+            "--zero1 requires synchronized gradients (async_grad=False): each "
+            "worker updates the Adam-state chunk it owns, so all workers must "
+            "see the same gradient for that chunk — with async_grad the "
+            "all_gather would stitch together chunk-wise single-worker updates"
+        )
     if cfg.lion:
         return distributed_lion(
             cfg.schedule(),
@@ -192,6 +205,18 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
+        if cfg.zero1:
+            shape = dict(mesh.shape)
+            for ax in (TENSOR_AXIS, SEQ_AXIS):
+                if shape.get(ax, 1) > 1:
+                    raise ValueError(
+                        f"--zero1 is incompatible with a '{ax}' mesh axis of "
+                        f"size {shape[ax]}: inside shard_map each {ax} rank "
+                        "ravels its own local param shard, so the m/v chunks "
+                        "diverge across ranks while the out_specs assume "
+                        f"{ax}-replication — one rank's moments would silently "
+                        "win. Use pure data parallelism with ZeRO-1."
+                    )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         self.apply_fn = apply_fn
         self.opt = make_optimizer(cfg)
@@ -568,6 +593,24 @@ class Trainer:
             if cfg.block_size % sp:
                 raise ValueError(f"block_size {cfg.block_size} not divisible by "
                                  f"seq axis {sp}")
+            if cfg.block_size > model_cfg.n_ctx:
+                # each shard holds block_size/sp tokens at positions
+                # [sidx*T_local, ...); without this check the wpe
+                # dynamic_slice clamps at the table end and later shards get
+                # silently duplicated positional embeddings.
+                raise ValueError(
+                    f"seq-parallel block_size {cfg.block_size} (total tokens "
+                    f"across the {sp}-way seq axis) exceeds n_ctx "
+                    f"{model_cfg.n_ctx}: positional table too small"
+                )
+            if model_cfg.dropout > 0.0:
+                print(
+                    "[trainer] WARNING: attention-probability dropout is "
+                    "disabled under sequence parallelism (scores never exist "
+                    "in one place on the ring path); residual/embedding "
+                    "dropout still applies — semantics differ from "
+                    "replicated training at the same dropout rate"
+                )
             batch_spec = P(DATA_AXIS, SEQ_AXIS)  # rows over data, tokens over seq
             from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
 

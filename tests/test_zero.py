@@ -110,3 +110,39 @@ def test_zero1_trainer_converges():
     for s in shards[1:]:
         np.testing.assert_array_equal(shards[0], s)
     t.close()
+
+
+def test_zero1_guards():
+    """Misuse combinations fail fast instead of silently corrupting state
+    (ADVICE r1): zero1+lion, zero1+async_grad, zero1+tensor/seq axis."""
+    import pytest
+
+    from distributed_lion_tpu.train.loop import make_optimizer
+
+    with pytest.raises(ValueError, match="zero1"):
+        make_optimizer(TrainConfig(lion=True, zero1=True))
+    with pytest.raises(ValueError, match="async_grad"):
+        make_optimizer(TrainConfig(lion=False, async_grad=True, zero1=True))
+    cfg = TrainConfig(
+        lion=False, async_grad=False, zero1=True, max_steps=1,
+        per_device_train_batch_size=1, gradient_accumulation_steps=1,
+        block_size=32, output_dir=None,
+    )
+    with pytest.raises(ValueError, match="tensor"):
+        Trainer.for_gpt2(cfg, make_mesh(data=4, tensor=2), GPT2Config.tiny())
+    with pytest.raises(ValueError, match="seq"):
+        Trainer.for_gpt2(cfg, make_mesh(data=4, seq=2), GPT2Config.tiny())
+
+
+def test_seq_parallel_nctx_guard():
+    """sp*T_local beyond the positional table must raise at config time, not
+    silently clamp the wpe slice (ADVICE r1)."""
+    import pytest
+
+    cfg = TrainConfig(
+        lion=True, async_grad=True, max_steps=1,
+        per_device_train_batch_size=1, gradient_accumulation_steps=1,
+        block_size=256, seq_parallel=2, output_dir=None,
+    )
+    with pytest.raises(ValueError, match="n_ctx"):
+        Trainer.for_gpt2(cfg, make_mesh(data=4, seq=2), GPT2Config.tiny())
