@@ -34,6 +34,10 @@ class ModelArguments:
     from-scratch model rather than an HF hub download."""
 
     model_name: str = "gpt2_124m"  # gpt2_124m | tiny
+    model_path: Optional[str] = None  # local HF checkpoint (save_pretrained
+    # dir / .safetensors / .bin / .npz) → finetune from pretrained weights,
+    # the reference's from_pretrained path (run_clm.py:425-444). Overrides
+    # model_name's architecture with the checkpoint's.
     vocab_size: Optional[int] = None  # default: tokenizer/model default
     n_ctx: Optional[int] = None
     dropout: float = 0.0
@@ -226,13 +230,30 @@ def main(argv=None):
         compute_dtype=dtypes[model_args.compute_dtype],
         remat=model_args.remat,
     )
-    if model_args.model_name == "tiny":
+    initial_params = None
+    if model_args.model_path:
+        from distributed_lion_tpu.models.hf_import import gpt2_from_hf
+
+        initial_params, model_cfg = gpt2_from_hf(
+            model_args.model_path,
+            dropout=model_args.dropout,
+            param_dtype=dtypes[model_args.param_dtype],
+            compute_dtype=dtypes[model_args.compute_dtype],
+            remat=model_args.remat,
+        )
+        print(f"[run_clm] loaded pretrained GPT-2 from {model_args.model_path}: "
+              f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+    elif model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)
     else:
         model_cfg = GPT2Config.gpt2_124m(**common)
+    if model_args.model_path and (model_args.vocab_size or model_args.n_ctx):
+        raise ValueError("--vocab_size/--n_ctx cannot override a loaded checkpoint's architecture")
     if model_args.vocab_size:
         model_cfg = dataclasses.replace(model_cfg, vocab_size=model_args.vocab_size)
-    elif data_args.dataset.startswith("text:"):
+    elif data_args.dataset.startswith("text:") and initial_params is None:
+        # (with a loaded checkpoint the embedding is fixed; out-of-range
+        # tokenizer ids are caught by the _check_vocab probe instead)
         # size the embedding to the tokenizer when the user didn't pin it
         from distributed_lion_tpu.data.tokenizer import load_tokenizer
 
@@ -247,7 +268,7 @@ def main(argv=None):
         print(f"[run_clm] capping block_size {train_cfg.block_size} -> n_ctx {model_cfg.n_ctx}")
         train_cfg.block_size = model_cfg.n_ctx
 
-    trainer = Trainer.for_gpt2(train_cfg, mesh, model_cfg)
+    trainer = Trainer.for_gpt2(train_cfg, mesh, model_cfg, initial_params=initial_params)
     native = make_native_pipeline(
         data_args, train_cfg.block_size, model_cfg.vocab_size,
         trainer.global_train_batch(), train_cfg.seed,

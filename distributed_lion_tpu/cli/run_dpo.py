@@ -25,6 +25,10 @@ class DPOArguments:
     """dpo_llama2.py ScriptArguments (:18-81), repaired."""
 
     model_name: str = "llama2_7b"  # llama2_7b | llama3_8b | tiny
+    model_path: Optional[str] = None  # local HF Llama checkpoint: policy+ref
+    # both start from the pretrained base (dpo_llama2.py:133-152); an
+    # --sft_checkpoint takes precedence (the reference's canonical flow runs
+    # DPO on the SFT-merged model)
     dataset: str = "synthetic"     # synthetic | jsonl:<path>
     sft_checkpoint: Optional[str] = None  # merged .npz from run_sft
     beta: float = 0.1
@@ -70,12 +74,20 @@ def main(argv=None):
     mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
 
-    model_ctor = {
-        "tiny": LlamaConfig.tiny,
-        "llama2_7b": LlamaConfig.llama2_7b,
-        "llama3_8b": LlamaConfig.llama3_8b,
-    }[script_args.model_name]
-    model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+    pretrained_params = None
+    if script_args.model_path:
+        from distributed_lion_tpu.models.hf_import import llama_from_hf
+
+        pretrained_params, model_cfg = llama_from_hf(script_args.model_path)
+        print(f"[run_dpo] loaded pretrained Llama from {script_args.model_path}: "
+              f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+    else:
+        model_ctor = {
+            "tiny": LlamaConfig.tiny,
+            "llama2_7b": LlamaConfig.llama2_7b,
+            "llama3_8b": LlamaConfig.llama3_8b,
+        }[script_args.model_name]
+        model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
     if script_args.max_length > model_cfg.n_ctx:
         script_args.max_length = model_cfg.n_ctx
     train_cfg.block_size = script_args.max_length
@@ -95,8 +107,10 @@ def main(argv=None):
             base_params,
         )
         print(f"[run_dpo] loaded SFT model from {script_args.sft_checkpoint}")
+    elif pretrained_params is not None:
+        base_params = pretrained_params
     else:
-        print("[run_dpo] no --sft_checkpoint given; starting from fresh init")
+        print("[run_dpo] no --sft_checkpoint/--model_path given; starting from fresh init")
         base_params = llama_init(jax.random.key(train_cfg.seed), model_cfg)
 
     ref_params = base_params

@@ -31,6 +31,9 @@ class SFTArguments:
     """sft_llama2.py ScriptArguments (:20-40) equivalents."""
 
     model_name: str = "llama2_7b"  # llama2_7b | llama3_8b | tiny
+    model_path: Optional[str] = None  # local HF Llama checkpoint → finetune a
+    # PRETRAINED base, the reference's from_pretrained path
+    # (sft_llama2.py:141-154); overrides model_name's architecture
     dataset: str = "synthetic"     # synthetic | jsonl:<path>
     seq_length: int = 1024
     size_valid_set: int = 64
@@ -105,17 +108,30 @@ def main(argv=None):
     ratio = chars_token_ratio(train, tok)
     print(f"[run_sft] chars/token ratio: {ratio:.2f} over {min(len(train), 400)} samples")
 
-    model_ctor = {
-        "tiny": LlamaConfig.tiny,
-        "llama2_7b": LlamaConfig.llama2_7b,
-        "llama3_8b": LlamaConfig.llama3_8b,
-    }[script_args.model_name]
-    model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+    if script_args.model_path:
+        from distributed_lion_tpu.models.hf_import import llama_from_hf
+
+        base_params, model_cfg = llama_from_hf(script_args.model_path)
+        print(f"[run_sft] loaded pretrained Llama from {script_args.model_path}: "
+              f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+        if tok.vocab_size > model_cfg.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tok.vocab_size} exceeds the checkpoint's "
+                f"{model_cfg.vocab_size}; pass the checkpoint's own tokenizer"
+            )
+    else:
+        model_ctor = {
+            "tiny": LlamaConfig.tiny,
+            "llama2_7b": LlamaConfig.llama2_7b,
+            "llama3_8b": LlamaConfig.llama3_8b,
+        }[script_args.model_name]
+        model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
     if script_args.seq_length > model_cfg.n_ctx:
         script_args.seq_length = model_cfg.n_ctx
     train_cfg.block_size = script_args.seq_length
 
-    base_params = llama_init(jax.random.key(train_cfg.seed), model_cfg)
+    if not script_args.model_path:
+        base_params = llama_init(jax.random.key(train_cfg.seed), model_cfg)
     if script_args.quant != "none":
         print(f"[run_sft] quantizing frozen base to {script_args.quant}")
         base_params = quantize_tree(base_params, script_args.quant)

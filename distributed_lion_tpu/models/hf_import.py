@@ -1,0 +1,302 @@
+"""HF-checkpoint ingestion: local GPT-2 / Llama weights → this repo's pytrees.
+
+The reference finetunes *pretrained* models pulled from HF hub — GPT-2 via
+``AutoModelForCausalLM.from_pretrained`` (/root/reference/run_clm.py:425-444)
+and Llama-2-7B for SFT/DPO (/root/reference/sft_llama2.py:141-154,
+dpo_llama2.py:133-152). This environment is zero-egress, so ingestion is from
+*local files only*: a ``save_pretrained`` directory (``*.safetensors`` —
+optionally index-sharded — or ``pytorch_model.bin`` + ``config.json``), a bare
+safetensors/bin file, or an ``.npz``. No hub, no network.
+
+Layout notes (the actual conversion work):
+
+- **GPT-2 stores Conv1D weights as [in, out]** (not torch-Linear's
+  [out, in]), so ``c_attn``/``c_proj``/``c_fc`` map without transposition;
+  ``c_attn.weight [d, 3d]`` reshapes straight into our stacked
+  ``qkv [d, 3, d]`` because HF packs q|k|v contiguously on the output dim.
+- **Llama stores Linear weights as [out, in]** → every projection is
+  transposed into our [in, out] matmul layout.
+- **RoPE convention**: HF Llama applies the *half-rotation* (rotate_half)
+  form; this repo's ``apply_rope`` uses the *interleaved* (even/odd pairs)
+  form. The two are related by a per-head permutation of the q/k output
+  channels — ``new[2i] = old[i]``, ``new[2i+1] = old[i + hd/2]`` — which is
+  the inverse of the permutation HF's own conversion script applies to the
+  original Meta weights. Applied here to ``wq``/``wk`` so logits match HF
+  bit-for-bit-in-fp32 (pinned by tests/test_hf_import.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import numpy as np
+
+
+# --------------------------------------------------------------------- loading
+
+def _load_safetensors(path: str) -> dict:
+    """One .safetensors file → {name: np.ndarray} (bf16 via torch)."""
+    from safetensors import safe_open
+
+    out = {}
+    with safe_open(path, framework="pt", device="cpu") as f:
+        for name in f.keys():
+            t = f.get_tensor(name)
+            if t.dtype.is_floating_point:
+                t = t.float()
+            out[name] = t.numpy()
+    return out
+
+
+def _load_torch_bin(path: str) -> dict:
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    out = {}
+    for name, t in sd.items():
+        if t.dtype.is_floating_point:
+            t = t.float()
+        out[name] = t.numpy()
+    return out
+
+
+def load_state_dict(path: str) -> dict:
+    """Local checkpoint → flat {hf_name: np.ndarray} (floats upcast to f32).
+
+    ``path`` may be a ``save_pretrained`` directory, a single
+    ``.safetensors`` / ``.bin`` / ``.pt`` file, or an ``.npz``.
+    """
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                shards = sorted(set(json.load(f)["weight_map"].values()))
+            sd = {}
+            for shard in shards:
+                sd.update(_load_safetensors(os.path.join(path, shard)))
+            return sd
+        single = os.path.join(path, "model.safetensors")
+        if os.path.exists(single):
+            return _load_safetensors(single)
+        bin_index = os.path.join(path, "pytorch_model.bin.index.json")
+        if os.path.exists(bin_index):
+            with open(bin_index) as f:
+                shards = sorted(set(json.load(f)["weight_map"].values()))
+            sd = {}
+            for shard in shards:
+                sd.update(_load_torch_bin(os.path.join(path, shard)))
+            return sd
+        bin_path = os.path.join(path, "pytorch_model.bin")
+        if os.path.exists(bin_path):
+            return _load_torch_bin(bin_path)
+        raise FileNotFoundError(
+            f"no model.safetensors(.index.json) or pytorch_model.bin under {path!r}"
+        )
+    if path.endswith(".safetensors"):
+        return _load_safetensors(path)
+    if path.endswith((".bin", ".pt")):
+        return _load_torch_bin(path)
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    raise ValueError(f"unrecognized checkpoint format: {path!r}")
+
+
+def load_hf_config(path: str) -> Optional[dict]:
+    cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) else None
+    if cfg_path and os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            return json.load(f)
+    return None
+
+
+def _strip_prefix(sd: dict, prefix: str) -> dict:
+    if any(k.startswith(prefix) for k in sd):
+        return {k[len(prefix):] if k.startswith(prefix) else k: v for k, v in sd.items()}
+    return sd
+
+
+def _cast_tree(params, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+
+
+# ----------------------------------------------------------------------- GPT-2
+
+def gpt2_from_hf(path: str, param_dtype: Any = None, **config_overrides):
+    """HF GPT-2 checkpoint → ``(params, GPT2Config)``.
+
+    Parity target: ``GPT2LMHeadModel.from_pretrained`` as used by the
+    reference's run_clm (run_clm.py:425-444). Logit equivalence vs the torch
+    model is pinned by tests/test_hf_import.py.
+    """
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+
+    sd = _strip_prefix(load_state_dict(path), "transformer.")
+    hf_cfg = load_hf_config(path) or {}
+
+    wte = sd["wte.weight"]
+    wpe = sd["wpe.weight"]
+    vocab, d = wte.shape
+    n_layer = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("h.") and k.split(".")[1].isdigit()
+    )
+    n_head = int(hf_cfg.get("n_head", config_overrides.get("n_head", 12)))
+    cfg_kw = dict(
+        vocab_size=vocab,
+        n_layer=n_layer,
+        n_head=n_head,
+        d_model=d,
+        n_ctx=wpe.shape[0],
+    )
+    cfg_kw.update(config_overrides)
+    if param_dtype is not None:
+        cfg_kw["param_dtype"] = param_dtype
+    cfg = GPT2Config(**cfg_kw)
+    dt = cfg.param_dtype
+
+    def ln(prefix):
+        return {"scale": jnp.asarray(sd[f"{prefix}.weight"], dt),
+                "bias": jnp.asarray(sd[f"{prefix}.bias"], dt)}
+
+    params = {
+        "wte": jnp.asarray(wte, dt),
+        "wpe": jnp.asarray(wpe, dt),
+        "ln_f": ln("ln_f"),
+        "blocks": [],
+    }
+    for i in range(n_layer):
+        h = f"h.{i}"
+        # Conv1D weights are [in, out]; c_attn's output dim is q|k|v
+        # contiguous → a straight reshape lands in our stacked [d, 3, d].
+        params["blocks"].append({
+            "ln_1": ln(f"{h}.ln_1"),
+            "attn": {
+                "qkv": jnp.asarray(sd[f"{h}.attn.c_attn.weight"].reshape(d, 3, d), dt),
+                "qkv_b": jnp.asarray(sd[f"{h}.attn.c_attn.bias"].reshape(3, d), dt),
+                "proj": jnp.asarray(sd[f"{h}.attn.c_proj.weight"], dt),
+                "proj_b": jnp.asarray(sd[f"{h}.attn.c_proj.bias"], dt),
+            },
+            "ln_2": ln(f"{h}.ln_2"),
+            "mlp": {
+                "fc": jnp.asarray(sd[f"{h}.mlp.c_fc.weight"], dt),
+                "fc_b": jnp.asarray(sd[f"{h}.mlp.c_fc.bias"], dt),
+                "proj": jnp.asarray(sd[f"{h}.mlp.c_proj.weight"], dt),
+                "proj_b": jnp.asarray(sd[f"{h}.mlp.c_proj.bias"], dt),
+            },
+        })
+    return params, cfg
+
+
+# ----------------------------------------------------------------------- Llama
+
+def _rope_to_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
+    """Permute a [heads*hd, in] q/k projection from HF's half-rotation RoPE
+    layout to this repo's interleaved layout: new[2i] = old[i],
+    new[2i+1] = old[i + hd/2], per head."""
+    out, d_in = w_out_in.shape
+    hd = out // n_heads
+    w = w_out_in.reshape(n_heads, 2, hd // 2, d_in)
+    return np.ascontiguousarray(w.transpose(0, 2, 1, 3)).reshape(out, d_in)
+
+
+def llama_from_hf(path: str, param_dtype: Any = None, **config_overrides):
+    """HF Llama checkpoint → ``(params, LlamaConfig)``.
+
+    Parity target: ``AutoModelForCausalLM.from_pretrained(llama)`` as the
+    reference's SFT/DPO base (sft_llama2.py:141-154). Handles GQA, tied or
+    untied lm_head, and the RoPE layout permutation (module docstring).
+    """
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.llama import LlamaConfig
+
+    sd = load_state_dict(path)
+    hf_cfg = load_hf_config(path) or {}
+
+    wte = sd["model.embed_tokens.weight"]
+    vocab, d = wte.shape
+    n_layer = 1 + max(
+        int(k.split(".")[2]) for k in sd if k.startswith("model.layers.")
+    )
+    d_ff = sd["model.layers.0.mlp.gate_proj.weight"].shape[0]
+    kv_out = sd["model.layers.0.self_attn.k_proj.weight"].shape[0]
+    n_head = int(hf_cfg.get("num_attention_heads",
+                            config_overrides.get("n_head", 32)))
+    hd = d // n_head
+    n_kv_head = kv_out // hd
+    cfg_kw = dict(
+        vocab_size=vocab,
+        n_layer=n_layer,
+        n_head=n_head,
+        n_kv_head=n_kv_head,
+        d_model=d,
+        d_ff=d_ff,
+        n_ctx=int(hf_cfg.get("max_position_embeddings", 4096)),
+        rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rms_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+    )
+    cfg_kw.update(config_overrides)
+    if param_dtype is not None:
+        cfg_kw["param_dtype"] = param_dtype
+    cfg = LlamaConfig(**cfg_kw)
+    dt = cfg.param_dtype
+
+    if "lm_head.weight" in sd and not hf_cfg.get("tie_word_embeddings", False):
+        lm_head = sd["lm_head.weight"].T  # [V, d] -> [d, V]
+    else:
+        lm_head = wte.T  # tied embeddings
+
+    params = {
+        "wte": jnp.asarray(wte, dt),
+        "lm_head": jnp.asarray(lm_head, dt),
+        "ln_f": {"scale": jnp.asarray(sd["model.norm.weight"], dt)},
+        "blocks": [],
+    }
+    for i in range(n_layer):
+        a = f"model.layers.{i}.self_attn"
+        m = f"model.layers.{i}.mlp"
+        params["blocks"].append({
+            "ln_attn": {"scale": jnp.asarray(
+                sd[f"model.layers.{i}.input_layernorm.weight"], dt)},
+            "attn": {
+                # Linear [out, in] → permute rope channels, then T → [in, out]
+                "wq": jnp.asarray(
+                    _rope_to_interleaved(sd[f"{a}.q_proj.weight"], cfg.n_head).T, dt),
+                "wk": jnp.asarray(
+                    _rope_to_interleaved(sd[f"{a}.k_proj.weight"], cfg.n_kv_head).T, dt),
+                "wv": jnp.asarray(sd[f"{a}.v_proj.weight"].T, dt),
+                "wo": jnp.asarray(sd[f"{a}.o_proj.weight"].T, dt),
+            },
+            "ln_mlp": {"scale": jnp.asarray(
+                sd[f"model.layers.{i}.post_attention_layernorm.weight"], dt)},
+            "mlp": {
+                "w_gate": jnp.asarray(sd[f"{m}.gate_proj.weight"].T, dt),
+                "w_up": jnp.asarray(sd[f"{m}.up_proj.weight"].T, dt),
+                "w_down": jnp.asarray(sd[f"{m}.down_proj.weight"].T, dt),
+            },
+        })
+    return params, cfg
+
+
+def detect_family(path: str) -> str:
+    """'gpt2' | 'llama' from config.json (or key shapes as fallback)."""
+    hf_cfg = load_hf_config(path)
+    if hf_cfg:
+        mt = hf_cfg.get("model_type", "")
+        if mt in ("gpt2",):
+            return "gpt2"
+        if mt in ("llama", "mistral"):
+            return "llama"
+    sd_keys = load_state_dict(path).keys()
+    if any("embed_tokens" in k for k in sd_keys):
+        return "llama"
+    if any(k.endswith("wte.weight") for k in sd_keys):
+        return "gpt2"
+    raise ValueError(f"cannot detect model family of checkpoint at {path!r}")

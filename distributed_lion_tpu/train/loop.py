@@ -562,14 +562,19 @@ class Trainer:
 
     # ------------------------------------------------------------- factories
     @staticmethod
-    def for_gpt2(cfg: TrainConfig, mesh, model_cfg: GPT2Config, seed: Optional[int] = None):
+    def for_gpt2(cfg: TrainConfig, mesh, model_cfg: GPT2Config, seed: Optional[int] = None,
+                 initial_params: Any = None):
+        """``initial_params`` (e.g. an HF checkpoint imported via
+        models/hf_import) replaces the random init — the reference's
+        finetune-from-pretrained path (run_clm.py:425-444)."""
         from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
         from distributed_lion_tpu.parallel.tensor_parallel import (
             gpt2_param_specs,
             validate_tp,
         )
 
-        params = gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg)
+        params = (initial_params if initial_params is not None else
+                  gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg))
         n = count_params(params)
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire)
         tp = mesh.shape[TENSOR_AXIS]

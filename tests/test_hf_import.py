@@ -1,0 +1,126 @@
+"""HF-checkpoint ingestion: logit parity against the torch models.
+
+The strongest possible offline check: build a randomly-initialized HF
+GPT2LMHeadModel / LlamaForCausalLM (transformers is baked in; construction
+from a config touches no network), ``save_pretrained`` it locally, import
+with models/hf_import, and demand the JAX model's logits match the torch
+model's on the same tokens. This pins every layout decision — Conv1D vs
+Linear orientation, q|k|v packing, the RoPE half-rotation → interleaved
+permutation, GQA head mapping, tied vs untied heads, eps plumbing.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_lion_tpu.models.hf_import import (  # noqa: E402
+    detect_family,
+    gpt2_from_hf,
+    llama_from_hf,
+    load_state_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt2_dir(tmp_path_factory):
+    cfg = transformers.GPT2Config(
+        vocab_size=256, n_layer=2, n_head=4, n_embd=64, n_positions=128
+    )
+    model = transformers.GPT2LMHeadModel(cfg).eval()
+    d = tmp_path_factory.mktemp("hf_gpt2")
+    model.save_pretrained(d)
+    return str(d), model
+
+
+@pytest.fixture(scope="module")
+def llama_dir(tmp_path_factory):
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, hidden_size=64, intermediate_size=128,
+        max_position_embeddings=128,
+    )
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = tmp_path_factory.mktemp("hf_llama")
+    model.save_pretrained(d)
+    return str(d), model
+
+
+def test_gpt2_logit_parity(gpt2_dir):
+    from distributed_lion_tpu.models.gpt2 import gpt2_apply
+
+    path, hf_model = gpt2_dir
+    params, cfg = gpt2_from_hf(path)
+    assert cfg.n_layer == 2 and cfg.n_head == 4 and cfg.d_model == 64
+    assert cfg.vocab_size == 256 and cfg.n_ctx == 128
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 16), dtype=np.int64)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32, remat=False)
+    got = np.asarray(gpt2_apply(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_logit_parity(llama_dir):
+    from distributed_lion_tpu.models.llama import llama_apply
+
+    path, hf_model = llama_dir
+    params, cfg = llama_from_hf(path)
+    assert cfg.n_layer == 2 and cfg.n_head == 4 and cfg.n_kv_head == 2
+    assert cfg.d_model == 64 and cfg.d_ff == 128 and cfg.vocab_size == 256
+    assert cfg.rms_eps == hf_model.config.rms_norm_eps
+
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 16), dtype=np.int64)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32, remat=False)
+    got = np.asarray(llama_apply(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_detect_family(gpt2_dir, llama_dir):
+    assert detect_family(gpt2_dir[0]) == "gpt2"
+    assert detect_family(llama_dir[0]) == "llama"
+
+
+def test_load_state_dict_formats(tmp_path, gpt2_dir):
+    # safetensors dir already covered; exercise the .npz branch round-trip
+    sd = load_state_dict(gpt2_dir[0])
+    npz = tmp_path / "m.npz"
+    np.savez(npz, **sd)
+    rt = load_state_dict(str(npz))
+    assert set(rt) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(rt[k], sd[k])
+
+
+def test_gpt2_import_trains(gpt2_dir):
+    """The imported checkpoint drops into the Trainer (the reference's
+    finetune-from-pretrained path, run_clm.py:425-444)."""
+    from distributed_lion_tpu.data.sources import batch_iterator, synthetic_lm_dataset
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    path, _ = gpt2_dir
+    params, model_cfg = gpt2_from_hf(path)
+    cfg = TrainConfig(
+        lion=True, async_grad=True, learning_rate=1e-3, warmup_steps=1,
+        max_steps=2, per_device_train_batch_size=1,
+        gradient_accumulation_steps=1, block_size=32, logging_steps=1,
+        output_dir=None,
+    )
+    trainer = Trainer.for_gpt2(cfg, make_mesh(), model_cfg, initial_params=params)
+    blocks = synthetic_lm_dataset(
+        max(64, trainer.global_train_batch()), cfg.block_size, model_cfg.vocab_size
+    )
+    hist = trainer.train(batch_iterator(blocks, trainer.global_train_batch(), seed=0))
+    assert hist and np.isfinite(hist[-1]["loss"])
+    trainer.close()
