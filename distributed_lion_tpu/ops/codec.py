@@ -27,6 +27,14 @@ def packed_size(n: int) -> int:
     return (n + 7) // 8
 
 
+def vote_chunk_elems(n: int, vote_every: int) -> int:
+    """Coordinates refreshed per step under ``vote_every`` lazy refresh
+    (optim.distributed_lion): the ballot vector is padded so every one of the
+    K slots is an equal, byte-aligned chunk. Single source of truth for the
+    optimizer's slicing and the byte accounting below."""
+    return max(8, -(-n // (8 * vote_every)) * 8)
+
+
 def a2a_chunk_bytes(n: int, world_size: int) -> int:
     """uint8 bytes per worker-chunk in the packed_a2a wire: the ballot vector
     is padded so every worker owns an equal ceil(n/8W)-byte chunk. Single
@@ -72,14 +80,28 @@ def unpack_signs(packed: jnp.ndarray, shape: tuple[int, ...]) -> jnp.ndarray:
     return bits.reshape(-1)[:n].reshape(shape).astype(jnp.bool_)
 
 
-def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
-    """Accounting for bytes moved per optimizer step, per worker.
+def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
+                         vote_every: int = 1, accum_steps: int = 1) -> dict:
+    """Accounting for bytes RECEIVED per worker, per optimizer step.
 
     The reference ships int64-packed tensors via all_gather: every worker
     receives ``world * ceil(n/8) * 8`` bytes per step
     (/root/reference/distributed_lion.py:80-81; dtype verified in SURVEY §2.3).
     BASELINE.md's comm budget asks for ≤ 1/32 of a bf16 gradient all-reduce
-    (2 bytes/param).
+    (2 bytes/param → ≤ 0.5 bit/param).
+
+    Two honest ways to judge that budget, both reported:
+
+    - ``bits_per_param`` / ``vs_bf16_allreduce``: per *optimizer step*,
+      against ONE bf16 all-reduce. ``packed_a2a`` is ~2 bits/param here
+      (4x over budget); combining it with ``vote_every >= 4`` lazy refresh
+      divides the wire by K and meets the budget outright.
+    - ``bits_per_param_per_microbatch`` / ``vs_bf16_allreduce_equal_tokens``:
+      amortized over ``accum_steps`` gradient-accumulation microbatches,
+      against the bf16 volume DDP moves for the SAME tokens when it syncs
+      every backward (torch DDP's default without ``no_sync``). Under the
+      reference's canonical config (accum 8, README.md:31) ``packed_a2a``
+      is 0.25 bit/param/microbatch — under budget with no algorithm change.
 
     Args:
         num_params: total parameters voted on.
@@ -87,11 +109,18 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
         wire: 'sign_psum' (int8 on-fabric all-reduce), 'packed_allgather'
             (1-bit uint8 all-gather), or 'packed_a2a' (two-phase 1-bit
             all_to_all + all_gather; ~2 bits/param, W-independent).
+        vote_every: lazy-refresh period K (optim.distributed_lion): each step
+            votes only ceil(n/K) coordinates → wire volume ÷ K.
+        accum_steps: gradient-accumulation microbatches per optimizer step
+            (for the equal-tokens comparison only).
 
     Returns:
-        dict with bytes received per worker per step for this build, the
-        reference, and a bf16 gradient all-reduce, plus bits/param.
+        dict with bytes received per worker per optimizer step for this
+        build, the reference, and a bf16 gradient all-reduce, plus both
+        bits/param views.
     """
+    n_voted = (num_params if vote_every <= 1
+               else min(num_params, vote_chunk_elems(num_params, vote_every)))
     if wire == "sign_psum":
         # Ring all-reduce of the ballot tensor: received payload per worker ≈
         # N bytes at the accumulator width (reduction happens on-fabric,
@@ -99,22 +128,27 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str) -> dict:
         # sums fit (W ≤ 127); larger worlds promote to int32, matching
         # collectives.majority_vote_psum.
         acc_bytes = 1 if world_size <= 127 else 4
-        ours = num_params * acc_bytes
+        ours = n_voted * acc_bytes
     elif wire == "packed_allgather":
-        ours = world_size * packed_size(num_params)
+        ours = world_size * packed_size(n_voted)
     elif wire == "packed_a2a":
         # phase 1: (W-1) peers each send me their packed copy of my chunk;
         # phase 2: (W-1) peers each send me their chunk's packed verdict.
-        ours = 2 * (world_size - 1) * a2a_chunk_bytes(num_params, world_size)
+        ours = 2 * (world_size - 1) * a2a_chunk_bytes(n_voted, world_size)
     else:
         raise ValueError(f"unknown wire format: {wire!r}")
     reference = world_size * packed_size(num_params) * 8  # int64 lanes
     bf16_allreduce = 2 * num_params
+    bits = 8.0 * ours / max(num_params, 1)
     return {
         "wire": wire,
+        "vote_every": vote_every,
         "bytes_per_step": ours,
-        "bits_per_param": 8.0 * ours / max(num_params, 1),
+        "bits_per_param": bits,
+        "bits_per_param_per_microbatch": bits / max(accum_steps, 1),
         "reference_bytes_per_step": reference,
         "bf16_allreduce_bytes_per_step": bf16_allreduce,
         "vs_bf16_allreduce": ours / max(bf16_allreduce, 1),
+        "vs_bf16_allreduce_equal_tokens":
+            ours / max(bf16_allreduce * max(accum_steps, 1), 1),
     }

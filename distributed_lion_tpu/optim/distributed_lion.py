@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_lion_tpu.ops import lion_math
+from distributed_lion_tpu.ops.codec import vote_chunk_elems
 from distributed_lion_tpu.optim.lion import (
     FunctionalOptimizer,
     LionState,
@@ -74,6 +75,7 @@ def distributed_lion(
     axis_name: Optional[str] = "data",
     max_grad_norm: Optional[float] = None,
     wire: str = "sign_psum",
+    vote_every: int = 1,
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
 ) -> FunctionalOptimizer:
@@ -91,6 +93,15 @@ def distributed_lion(
             'packed_allgather' (1-bit uint8 wire; DCN-friendly), or
             'packed_a2a' (two-phase 1-bit vote, ~2 bits/param independent
             of world size; minimum-bandwidth choice for large worlds).
+        vote_every: K > 1 enables *lazy sign refresh*: each step votes on a
+            rotating 1/K slice of coordinates (wire volume ÷ K — e.g.
+            packed_a2a at K=4 is ~0.5 bit/param/step, meeting BASELINE.md's
+            ≤1/32-of-bf16-allreduce budget per optimizer step), while the
+            other coordinates apply their *last elected* sign from a packed
+            1-bit cache in the state. Replicas stay bit-identical because
+            the cache holds voted (shared) results only. Coordinates not yet
+            voted in the first K-1 steps receive no update. Sign staleness
+            ≤ K steps is the accuracy trade — covered by a convergence test.
         mom_dtype: momentum dtype override (default: param dtype, ref :185).
         kernel: 'auto' (fused Pallas kernels on TPU, plain XLA elsewhere),
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
@@ -118,6 +129,8 @@ def distributed_lion(
         return lion(learning_rate, b1, b2, weight_decay, mom_dtype)
 
     _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
+    if vote_every < 1:
+        raise ValueError(f"vote_every must be >= 1, got {vote_every}")
     stochastic = max_grad_norm is not None
     from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
 
@@ -129,7 +142,13 @@ def distributed_lion(
         exp_avg = jax.tree.map(
             lambda p: jnp.zeros_like(p, dtype=mom_dtype or p.dtype), params
         )
-        return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng)
+        elected = None
+        if vote_every > 1:
+            n = sum(p.size for p in jax.tree.leaves(params))
+            chunk = vote_chunk_elems(n, vote_every)
+            elected = jnp.zeros((vote_every * chunk // 8,), jnp.uint8)
+        return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg,
+                         rng=rng, elected=elected)
 
     def _step_pallas(params, grads, state: LionState):
         """Fused-kernel fast path: two VMEM passes + one collective over the
@@ -155,8 +174,31 @@ def distributed_lion(
             LionState(state.count + 1, _split_votes(m_new_flat, state.exp_avg), state.rng),
         )
 
+    def _elect_lazy(flat_votes, state: LionState):
+        """vote_every > 1: vote the rotating slice, refresh the packed sign
+        cache, return (full elected bools, update-validity mask, new cache)."""
+        from distributed_lion_tpu.ops.codec import pack_signs, unpack_signs
+
+        n = flat_votes.shape[0]
+        chunk = vote_chunk_elems(n, vote_every)
+        padded = jnp.concatenate(
+            [flat_votes, jnp.zeros((vote_every * chunk - n,), flat_votes.dtype)]
+        ) if vote_every * chunk > n else flat_votes
+        slot = lax.rem(state.count, jnp.int32(vote_every))
+        sl = lax.dynamic_slice(padded, (slot * chunk,), (chunk,))
+        elected_sl = collectives.majority_vote(sl, axis_name, wire)
+        new_cache = lax.dynamic_update_slice(
+            state.elected, pack_signs(elected_sl), (slot * chunk // 8,)
+        )
+        bits = unpack_signs(new_cache, (vote_every * chunk,))
+        # cold start: slot j is first voted at count == j, so until then its
+        # coordinates get no update (replicas agree — count is shared)
+        slot_idx = jnp.arange(vote_every * chunk, dtype=jnp.int32) // chunk
+        valid = slot_idx <= state.count
+        return bits[:n], valid[:n], new_cache
+
     def step(params, grads, state: LionState):
-        if interpret is not None and not stochastic:
+        if interpret is not None and not stochastic and vote_every == 1:
             p_dtypes = {p.dtype for p in jax.tree.leaves(params)}
             m_dtypes = {m.dtype for m in jax.tree.leaves(state.exp_avg)}
             if len(p_dtypes) == 1 and len(m_dtypes) == 1:
@@ -187,20 +229,30 @@ def distributed_lion(
         # 3) ONE collective for the whole pytree (vs per-tensor all_gather,
         #    ref :81): flatten → vote → split.
         flat = _flatten_votes(votes)
-        elected = collectives.majority_vote(flat, axis_name, wire)
-        elected_tree = _split_votes(elected, votes)
-
-        # 4) apply the elected ±1 update (ref :91-92). The psum output is
-        #    identical on every worker, so replicated params stay replicated.
-        new_params = jax.tree.map(
-            lambda p, v: lion_math.apply_signed_update(p, v, lr), decayed, elected_tree
-        )
+        new_cache = state.elected
+        if vote_every == 1:
+            elected = collectives.majority_vote(flat, axis_name, wire)
+            elected_tree = _split_votes(elected, votes)
+            # 4) apply the elected ±1 update (ref :91-92). The psum output is
+            #    identical on every worker, so replicated params stay replicated.
+            new_params = jax.tree.map(
+                lambda p, v: lion_math.apply_signed_update(p, v, lr),
+                decayed, elected_tree,
+            )
+        else:
+            elected, valid, new_cache = _elect_lazy(flat, state)
+            signs = jnp.where(elected, 1.0, -1.0) * valid
+            signs_tree = _split_votes(signs, votes)
+            new_params = jax.tree.map(
+                lambda p, s: p - jnp.asarray(lr, p.dtype) * s.astype(p.dtype),
+                decayed, signs_tree,
+            )
 
         # 5) momentum with the LOCAL gradient — divergent by design (ref :96).
         new_m = jax.tree.map(
             lambda g, m: lion_math.momentum_update(g, m, b2), grads, state.exp_avg
         )
-        return new_params, LionState(state.count + 1, new_m, state.rng)
+        return new_params, LionState(state.count + 1, new_m, state.rng, new_cache)
 
     return FunctionalOptimizer(init=init, step=step)
 
@@ -222,14 +274,20 @@ def init_global_state(opt: FunctionalOptimizer, params, world: int,
     exp_avg = jax.tree.map(
         lambda m: jnp.zeros((world,) + m.shape, m.dtype), st_shapes.exp_avg
     )
-    return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng)
+    elected = (None if st_shapes.elected is None
+               else jnp.zeros(st_shapes.elected.shape, st_shapes.elected.dtype))
+    return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng,
+                     elected=elected)
 
 
 def squeeze_worker_state(state: LionState) -> LionState:
-    """Inside shard_map: drop this worker's leading [1] momentum axis."""
-    return LionState(state.count, jax.tree.map(lambda m: m[0], state.exp_avg), state.rng)
+    """Inside shard_map: drop this worker's leading [1] momentum axis (the
+    elected-sign cache is replicated and passes through)."""
+    return LionState(state.count, jax.tree.map(lambda m: m[0], state.exp_avg),
+                     state.rng, state.elected)
 
 
 def expand_worker_state(state: LionState) -> LionState:
     """Inside shard_map: restore the leading [1] axis before returning."""
-    return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg), state.rng)
+    return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg),
+                     state.rng, state.elected)

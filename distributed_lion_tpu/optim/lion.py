@@ -33,6 +33,9 @@ class LionState(NamedTuple):
     count: jnp.ndarray          # int32 step counter (replicated)
     exp_avg: Any                # momentum pytree, like params (ref :185-186)
     rng: Optional[jax.Array]    # base PRNG key; None unless stochastic mode
+    elected: Optional[jnp.ndarray] = None  # packed uint8 elected-sign cache
+    # (replicated); present only under vote_every > 1 lazy refresh — holds the
+    # last elected sign for every coordinate, 1 bit/param of state
 
 
 def _validate(lr_init: float, b1: float, b2: float) -> None:

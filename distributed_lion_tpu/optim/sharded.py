@@ -22,12 +22,14 @@ from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
 from distributed_lion_tpu.parallel.mesh import DATA_AXIS
 
 
-def state_specs() -> LionState:
-    """PartitionSpec pytree-prefix for a stacked-momentum LionState."""
-    return LionState(count=P(), exp_avg=P(DATA_AXIS), rng=P())
+def state_specs(has_elected: bool = False) -> LionState:
+    """PartitionSpec pytree-prefix for a stacked-momentum LionState. The
+    elected-sign cache (``vote_every > 1``) is replicated when present."""
+    return LionState(count=P(), exp_avg=P(DATA_AXIS), rng=P(),
+                     elected=P() if has_elected else None)
 
 
-def make_sharded_step(opt: FunctionalOptimizer, mesh):
+def make_sharded_step(opt: FunctionalOptimizer, mesh, has_elected: bool = False):
     """Build a jitted step over ``mesh``:
 
     ``(params, stacked_grads, state) -> (new_params, new_state)``
@@ -39,13 +41,15 @@ def make_sharded_step(opt: FunctionalOptimizer, mesh):
       reference's no_sync contract: gradients are never averaged,
       async_trainer.py:15).
     - ``state``: from ``init_global_state``, exp_avg sharded over data.
+    - ``has_elected``: True when the optimizer was built with
+      ``vote_every > 1`` (the state then carries the packed sign cache).
     """
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), state_specs()),
-        out_specs=(P(), state_specs()),
+        in_specs=(P(), P(DATA_AXIS), state_specs(has_elected)),
+        out_specs=(P(), state_specs(has_elected)),
         check_vma=False,
     )
     def _step(params, stacked_grads, state):
@@ -66,4 +70,6 @@ def shard_state(state: LionState, mesh) -> LionState:
             state.exp_avg,
         ),
         rng=None if state.rng is None else jax.device_put(state.rng, NamedSharding(mesh, P())),
+        elected=None if state.elected is None
+        else jax.device_put(state.elected, NamedSharding(mesh, P())),
     )

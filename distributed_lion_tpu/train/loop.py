@@ -76,6 +76,10 @@ class TrainConfig:
     # (ZeRO-1, optim/zero.py) — 2N/W floats of optimizer state per device
     # instead of 2N, updated chunks re-assembled with one all_gather.
     wire: str = "sign_psum"
+    vote_every: int = 1  # K > 1: lazy sign refresh — each step votes a 1/K
+    # coordinate slice (wire volume ÷ K; packed_a2a at K=4 ≈ 0.5 bit/param/
+    # step, the BASELINE.md comm budget), stale elected signs applied
+    # elsewhere (optim.distributed_lion).
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
                               # when building the mesh; net-new vs reference)
@@ -150,6 +154,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             axis_name=DATA_AXIS,
             max_grad_norm=cfg.max_grad_norm,
             wire=cfg.wire,
+            vote_every=cfg.vote_every,
             kernel=cfg.kernel,
         )
     if cfg.async_grad:
@@ -170,8 +175,10 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
 def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
     if cfg.lion:
         # stacked per-worker momentum: [world, ...] over 'data' (+ any
-        # tensor-parallel dims the param itself carries)
-        return LionState(count=P(), exp_avg=exp_avg_specs, rng=P())
+        # tensor-parallel dims the param itself carries); the elected-sign
+        # cache (vote_every > 1) is replicated
+        return LionState(count=P(), exp_avg=exp_avg_specs, rng=P(),
+                         elected=P() if cfg.vote_every > 1 else None)
     if cfg.zero1:
         # [world, chunk] m/v sharded over 'data': ZeRO-1 state partitioning
         return Zero1State(count=P(), m=P(DATA_AXIS), v=P(DATA_AXIS))
@@ -225,6 +232,24 @@ class Trainer:
         elif not cfg.lion:
             raise NotImplementedError("tensor-parallel param_specs require the Lion path")
         self.param_specs = param_specs
+        if cfg.lion and cfg.vote_every > 1:
+            sharded_axes = {
+                ax for s in jax.tree.leaves(
+                    param_specs, is_leaf=lambda x: isinstance(x, P))
+                for dim in s for ax in
+                (dim if isinstance(dim, (tuple, list)) else (dim,))
+                if ax is not None
+            }
+            if sharded_axes:
+                raise ValueError(
+                    f"--vote_every > 1 is incompatible with params sharded "
+                    f"over {sorted(sharded_axes)}: each rank's ballot covers "
+                    "its own local param shards, so the elected-sign caches "
+                    "differ across ranks while the P() spec declares them "
+                    "replicated — one rank's cache would silently win and "
+                    "stale signs would land on the wrong coordinates. Use "
+                    "lazy vote refresh with replicated params (dp / dp x sp)."
+                )
 
         self.params = jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, param_specs
@@ -246,6 +271,7 @@ class Trainer:
                         lambda s: NamedSharding(mesh, s), self._exp_avg_specs
                     ),
                     rng=None if state.rng is None else NamedSharding(mesh, P()),
+                    elected=None if state.elected is None else NamedSharding(mesh, P()),
                 ),
             )
         elif cfg.zero1:
@@ -292,7 +318,9 @@ class Trainer:
         the AdamW path, which has no optimizer collective)."""
         if not self.cfg.lion:
             return {}
-        return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec)
+        return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec,
+                           vote_every=self.cfg.vote_every,
+                           accum_steps=self.cfg.gradient_accumulation_steps)
 
     # ------------------------------------------------------------------ steps
     def _build_train_step_core(self):
@@ -576,12 +604,17 @@ class Trainer:
         params = (initial_params if initial_params is not None else
                   gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg))
         n = count_params(params)
-        acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire)
+        acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
+                                    vote_every=cfg.vote_every,
+                                    accum_steps=cfg.gradient_accumulation_steps)
         tp = mesh.shape[TENSOR_AXIS]
         print(
             f"[trainer] GPT-2 {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
-            f"tp={tp} | vote wire={cfg.wire}: {acct['bits_per_param']:.2f} "
-            f"bits/param/step ({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce)"
+            f"tp={tp} | vote wire={cfg.wire}"
+            + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
+            + f": {acct['bits_per_param']:.2f} bits/param/step "
+            f"({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce; "
+            f"{acct['bits_per_param_per_microbatch']:.2f} bits/param/microbatch)"
         )
         param_specs = None
         tp_axis = None

@@ -124,13 +124,16 @@ class StepTimer:
 
 
 def comm_report(num_params: int, world: int, wire: str,
-                steps_per_sec: Optional[float] = None) -> dict:
+                steps_per_sec: Optional[float] = None,
+                vote_every: int = 1, accum_steps: int = 1) -> dict:
     """Vote-collective wire accounting (+ bandwidth when a rate is known)."""
-    acct = wire_bytes_per_param(num_params, world, wire)
+    acct = wire_bytes_per_param(num_params, world, wire,
+                                vote_every=vote_every, accum_steps=accum_steps)
     out = {
         "wire": acct["wire"],
         "comm_bytes_per_step": acct["bytes_per_step"],
         "comm_bits_per_param": acct["bits_per_param"],
+        "comm_bits_per_param_per_microbatch": acct["bits_per_param_per_microbatch"],
         "vs_bf16_allreduce": acct["vs_bf16_allreduce"],
         "vs_reference_wire": acct["bytes_per_step"]
         / max(acct["reference_bytes_per_step"], 1),
