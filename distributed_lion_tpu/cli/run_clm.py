@@ -60,7 +60,8 @@ class DataArguments:
     bin_dtype: str = "uint16"  # token width of bin: shards (uint16 | uint32)
 
 
-def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1):
+def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
+               pipeline_parallel: int = 1):
     import jax
 
     from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
@@ -70,7 +71,8 @@ def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1):
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     enable_compilation_cache()
     multihost_initialize()
-    return make_mesh(tensor=tensor_parallel, seq=seq_parallel)
+    return make_mesh(tensor=tensor_parallel, seq=seq_parallel,
+                     pipe=pipeline_parallel)
 
 
 def enable_compilation_cache() -> None:
@@ -222,7 +224,8 @@ def main(argv=None):
     from distributed_lion_tpu.models.gpt2 import GPT2Config
     from distributed_lion_tpu.train.loop import Trainer
 
-    mesh = build_mesh(train_cfg.tensor_parallel, train_cfg.seq_parallel)
+    mesh = build_mesh(train_cfg.tensor_parallel, train_cfg.seq_parallel,
+                      train_cfg.pipeline_parallel)
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
     common = dict(
         dropout=model_args.dropout,
@@ -291,7 +294,12 @@ def main(argv=None):
             # consumed by cli/run_generate
             from distributed_lion_tpu.utils.serialization import save_pytree
 
-            save_pytree(f"{train_cfg.output_dir}/model.npz", trainer.params)
+            export = trainer.params
+            if train_cfg.pipeline_parallel > 1:
+                from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
+
+                export = unpipeline_params(export, model_cfg.n_layer)
+            save_pytree(f"{train_cfg.output_dir}/model.npz", export)
     finally:
         trainer.close()
 

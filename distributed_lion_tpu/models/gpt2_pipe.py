@@ -1,0 +1,147 @@
+"""Pipeline-parallel GPT-2: blocks as stages, trainable end-to-end.
+
+Net-new vs the reference (data-parallel only, SURVEY §2.7). This wires the
+generic GPipe schedule (parallel/pipeline.py: stacked stage params sharded
+over the ``pipe`` mesh axis, activations rotating via ``ppermute``, one
+``lax.scan``) to the real GPT-2 of models/gpt2.py so ``run_clm
+--pipeline_parallel N`` trains with blocks split into N stages.
+
+SPMD layout inside the train-step ``shard_map`` (axes data × pipe):
+
+- params = {wte, wpe, ln_f, stages} — ``stages`` leaves are
+  ``[pp, n_layer/pp, ...]`` sharded ``P('pipe', ...)``; the embedding/final
+  norm stay replicated.
+- every stage runs the same program: embed (only stage 0's result is
+  ingested), pipeline over the stages, ln_f + tied-logits + CLM loss (only
+  the LAST stage's is real — selected with a masked ``psum``); the backward
+  through the other stages' garbage compute receives zero cotangent.
+- replicated-leaf gradients (wte/wpe/ln_f) are per-stage partials over
+  disjoint contributions (stage 0: embedding; last stage: logits tie) —
+  the train loop ``psum``s them over the pipe axis (train/loop.py), exactly
+  like the seq-parallel gradient reduction.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_lion_tpu.models.gpt2 import GPT2Config, _block, _layer_norm
+from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+from distributed_lion_tpu.parallel.mesh import PIPE_AXIS
+from distributed_lion_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    unstack_stage_params,
+)
+
+
+def pipeline_params(params: dict, pp: int) -> dict:
+    """Standard gpt2_init layout → pipeline layout with stacked stages."""
+    return {
+        "wte": params["wte"],
+        "wpe": params["wpe"],
+        "ln_f": params["ln_f"],
+        "stages": stack_stage_params(params["blocks"], pp),
+    }
+
+
+def unpipeline_params(pparams: dict, n_layer: int) -> dict:
+    """Inverse of :func:`pipeline_params` (export / generation)."""
+    return {
+        "wte": pparams["wte"],
+        "wpe": pparams["wpe"],
+        "ln_f": pparams["ln_f"],
+        "blocks": unstack_stage_params(pparams["stages"], n_layer),
+    }
+
+
+def pipeline_param_specs(cfg: GPT2Config, pp: int) -> dict:
+    """Replicated embeddings/norm; stage leaves sharded over ``pipe``."""
+    rep = P()
+    ln = {"scale": rep, "bias": rep}
+    stage_ln = {"scale": P(PIPE_AXIS), "bias": P(PIPE_AXIS)}
+    stages = {
+        "ln_1": stage_ln,
+        "attn": {k: P(PIPE_AXIS) for k in ("qkv", "qkv_b", "proj", "proj_b")},
+        "ln_2": stage_ln,
+        "mlp": {k: P(PIPE_AXIS) for k in ("fc", "fc_b", "proj", "proj_b")},
+    }
+    return {"wte": rep, "wpe": rep, "ln_f": ln, "stages": stages}
+
+
+def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
+                       axis_name: str = PIPE_AXIS):
+    """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
+    the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
+    ``tokens`` [B_local, T] with B_local divisible by ``n_micro``. Dropout is
+    unsupported under pipelining (guarded at config time)."""
+
+    def layer_fn(p_layer, h):
+        f = (partial(jax.checkpoint, static_argnums=(3, 4, 5))(_block)
+             if model_cfg.remat else _block)
+        return f(h, p_layer, None, model_cfg, None, None)
+
+    def loss_fn(params, tokens, dropout_key):
+        del dropout_key  # dropout unsupported under pipelining
+        B, T = tokens.shape
+        if T > model_cfg.n_ctx:
+            raise ValueError(f"sequence length {T} exceeds n_ctx {model_cfg.n_ctx}")
+        x = params["wte"][tokens].astype(model_cfg.compute_dtype)
+        x = x + params["wpe"][:T].astype(model_cfg.compute_dtype)
+        xm = x.reshape((n_micro, B // n_micro, T, x.shape[-1]))
+        # local stage view inside shard_map keeps a leading [1] shard axis
+        stage_local = jax.tree.map(lambda a: a[0], params["stages"])
+        acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
+
+        def head_loss(acc):
+            h = acc.reshape((B, T, x.shape[-1]))
+            h = _layer_norm(h, params["ln_f"])
+            logits = jnp.einsum(
+                "btd,vd->btv", h, params["wte"].astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return clm_loss_and_metrics(logits, tokens)
+
+        def skip_loss(acc):
+            z = jnp.float32(0)
+            return z, {"loss": z, "accuracy": z, "n_tokens": z}
+
+        # only the last stage saw real activations; lax.cond skips the
+        # (expensive) vocab projection + loss on the other stages entirely —
+        # XLA executes just the taken branch — and the psum then both
+        # broadcasts the value and routes zero cotangent to the skip branch
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
+        loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
+        loss = lax.psum(loss_local, axis_name)
+        metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}
+        return loss, metrics
+
+    return loss_fn
+
+
+def validate_pipeline(model_cfg: GPT2Config, cfg, pp: int, n_micro: int) -> None:
+    """Config-time guards for ``--pipeline_parallel``."""
+    if model_cfg.n_layer % pp:
+        raise ValueError(f"n_layer {model_cfg.n_layer} not divisible by "
+                         f"pipeline stages {pp}")
+    if model_cfg.dropout > 0.0:
+        raise ValueError("dropout is unsupported under pipeline parallelism "
+                         "(per-microbatch keys would need schedule-aware "
+                         "plumbing); set --dropout 0")
+    if cfg.per_device_train_batch_size % n_micro:
+        raise ValueError(
+            f"per_device_train_batch_size {cfg.per_device_train_batch_size} "
+            f"not divisible by pipeline_microbatches {n_micro}"
+        )
+    if cfg.per_device_eval_batch_size % n_micro:
+        raise ValueError(
+            f"per_device_eval_batch_size {cfg.per_device_eval_batch_size} "
+            f"not divisible by pipeline_microbatches {n_micro}"
+        )

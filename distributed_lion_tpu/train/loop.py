@@ -49,6 +49,7 @@ from distributed_lion_tpu.optim.zero import (
 )
 from distributed_lion_tpu.parallel.mesh import (
     DATA_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
     data_axis_size,
@@ -86,6 +87,11 @@ class TrainConfig:
     seq_parallel: int = 1  # sequence/context mesh axis size: batches are
                            # sharded over tokens, attention rings over the
                            # 'seq' axis (parallel.ring_attention); net-new
+    pipeline_parallel: int = 1  # pipeline stages over the 'pipe' mesh axis
+    # (blocks stacked [pp, L/pp], GPipe microbatch schedule — models/gpt2_pipe
+    # + parallel/pipeline); net-new
+    pipeline_microbatches: int = 0  # GPipe microbatches per accum step
+    # (0 → pipeline_parallel; bubble fraction = (S-1)/(M+S-1))
     max_grad_norm: Optional[float] = None  # set → stochastic binarization
     grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
     # (HF Trainer, which the reference sits on, clips at 1.0 by default —
@@ -334,6 +340,7 @@ class Trainer:
         st_specs = _opt_state_specs(cfg, self._exp_avg_specs if cfg.lion else None)
 
         sp = dict(self.mesh.shape).get(SEQ_AXIS, 1)
+        pp = dict(self.mesh.shape).get(PIPE_AXIS, 1)
 
         @partial(
             jax.shard_map,
@@ -367,6 +374,23 @@ class Trainer:
                 # ITS tokens' loss term (normalized by the global token
                 # count) — the full gradient is their sum.
                 grads = lax.psum(grads, SEQ_AXIS)
+            if pp > 1:
+                # pipeline parallelism: stage-sharded leaves carry their own
+                # (complete) local gradients; replicated leaves (embeddings,
+                # final norm) got disjoint per-stage partials — stage 0 the
+                # embedding path, the last stage the tied-logits path — whose
+                # sum is the full gradient.
+                from distributed_lion_tpu.parallel.tensor_parallel import (
+                    spec_uses_axis,
+                )
+
+                flat_g, gdef = jax.tree.flatten(grads)
+                flat_s = gdef.flatten_up_to(param_specs)
+                flat_g = [
+                    g if spec_uses_axis(s, PIPE_AXIS) else lax.psum(g, PIPE_AXIS)
+                    for g, s in zip(flat_g, flat_s)
+                ]
+                grads = jax.tree.unflatten(gdef, flat_g)
             if not cfg.async_grad:
                 # classic DDP all-reduce; the reference's non-async path.
                 grads = lax.pmean(grads, DATA_AXIS)
@@ -378,11 +402,14 @@ class Trainer:
             if clip:
                 # per-worker clip (grads are local in async mode; in DDP mode
                 # this runs on the already-averaged grads, matching HF Trainer
-                # clipping after the all-reduce). Under TP the grads are
-                # sharded over the tensor axis → norm psum'd across it so all
-                # shards of one gradient scale uniformly.
+                # clipping after the all-reduce). Under TP/PP the grads of
+                # sharded leaves get their norms psum'd across that axis so
+                # every rank derives the same scale.
+                shard_axes = tuple(a for a, flag in
+                                   ((TENSOR_AXIS, tp_axis is not None),
+                                    (PIPE_AXIS, pp > 1)) if flag)
                 grads = clip_by_global_norm(grads, clip, specs=param_specs,
-                                            tp_axis=tp_axis)
+                                            shard_axes=shard_axes)
             if cfg.lion:
                 st = squeeze_worker_state(state)
             elif cfg.zero1:
@@ -525,10 +552,15 @@ class Trainer:
         cfg = self.cfg
         n_examples = len(jax.tree.leaves(eval_blocks)[0])
         per_dev = cfg.per_device_eval_batch_size
+        # under pipelining the local batch must split into GPipe microbatches
+        # (pp from the mesh, like the train step — cfg.pipeline_parallel is
+        # only the CLI's mesh-building input)
+        pp = dict(self.mesh.shape).get(PIPE_AXIS, 1)
+        div = (cfg.pipeline_microbatches or pp) if pp > 1 else 1
         if n_examples < self.world * per_dev:
             # shrink rather than silently skipping eval on small validation
             # splits (jit re-specializes on the new shape)
-            per_dev = max(1, n_examples // self.world)
+            per_dev = max(div, n_examples // self.world // div * div)
         bs = self.world * per_dev
         if n_examples < bs:
             print(f"[trainer] eval skipped: {n_examples} examples < world {self.world}")
@@ -616,6 +648,30 @@ class Trainer:
             f"({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce; "
             f"{acct['bits_per_param_per_microbatch']:.2f} bits/param/microbatch)"
         )
+        pp = dict(mesh.shape).get(PIPE_AXIS, 1)
+        if pp > 1:
+            from distributed_lion_tpu.models.gpt2_pipe import (
+                make_pipeline_loss,
+                pipeline_param_specs,
+                pipeline_params,
+                validate_pipeline,
+            )
+
+            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+                raise NotImplementedError(
+                    "pipeline parallelism composes with data parallelism "
+                    "(dp x pp); tensor/seq axes alongside pipe are not wired"
+                )
+            n_micro = cfg.pipeline_microbatches or pp
+            validate_pipeline(model_cfg, cfg, pp, n_micro)
+            return Trainer(
+                cfg, mesh,
+                apply_fn=None,
+                params=pipeline_params(params, pp),
+                param_specs=pipeline_param_specs(model_cfg, pp),
+                loss_fn=make_pipeline_loss(model_cfg, n_micro),
+            )
+
         param_specs = None
         tp_axis = None
         if tp > 1:
@@ -668,37 +724,41 @@ def _count_of(state) -> jnp.ndarray:
     return state.count
 
 
-def clip_by_global_norm(grads, clip: float, specs=None, tp_axis: Optional[str] = None):
+def clip_by_global_norm(grads, clip: float, specs=None,
+                        shard_axes: tuple = ()):
     """Scale the whole pytree so its global L2 norm is ≤ ``clip`` — the
     torch.nn.utils.clip_grad_norm_ semantics HF Trainer applies before every
     optimizer step (default max_grad_norm=1.0), which the reference inherits.
 
-    Inside shard_map under tensor parallelism (``tp_axis`` + ``specs``), the
-    squared norm of tensor-SHARDED leaves is psum'd across the tensor axis
-    (each rank holds one shard of those gradients) while tensor-replicated
-    leaves — whose grads are already complete and identical on every rank,
-    thanks to the copy_to_tp_region boundary — are counted once. Every rank
-    then applies the same scale. The data axis is deliberately never summed:
-    per-worker grads get per-worker norms (they are different gradients, not
-    shards of one)."""
+    Inside shard_map under tensor/pipeline parallelism (``shard_axes`` +
+    ``specs``), the squared norm of each leaf SHARDED over one of those axes
+    is psum'd across that axis (each rank holds one shard of that gradient)
+    while replicated leaves — whose grads are complete and identical on every
+    rank, via the copy_to_tp_region boundary / the pipe-axis grad psum — are
+    counted once. Every rank then applies the same scale. The data axis is
+    deliberately never summed: per-worker grads get per-worker norms (they
+    are different gradients, not shards of one)."""
     def _sq(g):
         return jnp.sum(jnp.square(g.astype(jnp.float32)))
 
-    if tp_axis is None:
+    if not shard_axes:
         sq = sum(_sq(g) for g in jax.tree.leaves(grads))
     else:
         from distributed_lion_tpu.parallel.tensor_parallel import spec_uses_axis
 
-        flat_g = jax.tree.leaves(grads)
-        flat_s = jax.tree.leaves(specs)  # P leaves; same structure as grads
-        sq_sharded = sum(
-            (_sq(g) for g, s in zip(flat_g, flat_s) if spec_uses_axis(s, tp_axis)),
-            start=jnp.float32(0),
-        )
-        sq_rep = sum(
-            (_sq(g) for g, s in zip(flat_g, flat_s) if not spec_uses_axis(s, tp_axis)),
-            start=jnp.float32(0),
-        )
-        sq = lax.psum(sq_sharded, tp_axis) + sq_rep
+        flat_g, gdef = jax.tree.flatten(grads)
+        flat_s = gdef.flatten_up_to(specs)  # P leaves; same structure as grads
+        # accumulate per axis-subset: a leaf sharded over axis A contributes
+        # its local sq, psum'd over A; leaves sharded over several axes are
+        # psum'd over each in turn
+        sq = jnp.float32(0)
+        by_axes: dict = {}
+        for g, s in zip(flat_g, flat_s):
+            axes = tuple(a for a in shard_axes if spec_uses_axis(s, a))
+            by_axes[axes] = by_axes.get(axes, jnp.float32(0)) + _sq(g)
+        for axes, part in by_axes.items():
+            for a in axes:
+                part = lax.psum(part, a)
+            sq = sq + part
     scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
