@@ -44,6 +44,9 @@ class ModelArguments:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = True  # per-block activation remat (off = faster when HBM allows)
+    moe_experts: int = 0  # > 0: Switch-MoE FFN every moe_every-th block
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 @dataclasses.dataclass
@@ -61,7 +64,7 @@ class DataArguments:
 
 
 def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
-               pipeline_parallel: int = 1):
+               pipeline_parallel: int = 1, expert_parallel: int = 1):
     import jax
 
     from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
@@ -72,7 +75,7 @@ def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
     enable_compilation_cache()
     multihost_initialize()
     return make_mesh(tensor=tensor_parallel, seq=seq_parallel,
-                     pipe=pipeline_parallel)
+                     pipe=pipeline_parallel, expert=expert_parallel)
 
 
 def enable_compilation_cache() -> None:
@@ -225,13 +228,16 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
 
     mesh = build_mesh(train_cfg.tensor_parallel, train_cfg.seq_parallel,
-                      train_cfg.pipeline_parallel)
+                      train_cfg.pipeline_parallel, train_cfg.expert_parallel)
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
     common = dict(
         dropout=model_args.dropout,
         param_dtype=dtypes[model_args.param_dtype],
         compute_dtype=dtypes[model_args.compute_dtype],
         remat=model_args.remat,
+        moe_experts=model_args.moe_experts,
+        moe_every=model_args.moe_every,
+        moe_capacity_factor=model_args.moe_capacity_factor,
     )
     initial_params = None
     if model_args.model_path:

@@ -44,6 +44,11 @@ class GPT2Config:
                         # activations fit — backward skips the fwd recompute
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
+    moe_experts: int = 0  # > 0: Switch-MoE FFN (parallel/expert.py) replaces
+                          # the dense MLP in every ``moe_every``-th block;
+                          # net-new vs the reference (data-parallel only)
+    moe_every: int = 2    # MoE in blocks with index % moe_every == moe_every-1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -66,13 +71,20 @@ def _normal(key, shape, std, dtype):
     return (jax.random.normal(key, shape) * std).astype(dtype)
 
 
+def is_moe_block(cfg: GPT2Config, i: int) -> bool:
+    return cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
+
+
 def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
     """Initialize parameters (GPT-2 init: N(0, 0.02), residual projections
-    scaled by 1/sqrt(2*n_layer) as in the original OpenAI scheme)."""
+    scaled by 1/sqrt(2*n_layer) as in the original OpenAI scheme). With
+    ``cfg.moe_experts``, every ``moe_every``-th block carries a Switch-MoE
+    FFN (``"moe"`` entry, parallel/expert.moe_init) instead of the dense
+    ``"mlp"``."""
     d, dt = cfg.d_model, cfg.param_dtype
     std = 0.02
     resid_std = std / math.sqrt(2 * cfg.n_layer)
-    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layer))
+    keys = iter(jax.random.split(key, 4 + 7 * cfg.n_layer))
 
     params: dict = {
         "wte": _normal(next(keys), (cfg.vocab_size, d), std, dt),
@@ -80,7 +92,7 @@ def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
         "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
         "blocks": [],
     }
-    for _ in range(cfg.n_layer):
+    for i in range(cfg.n_layer):
         block = {
             "ln_1": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
             "attn": {
@@ -92,13 +104,18 @@ def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
                 "proj_b": jnp.zeros((d,), dt),
             },
             "ln_2": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
-            "mlp": {
+        }
+        if is_moe_block(cfg, i):
+            from distributed_lion_tpu.parallel.expert import moe_init
+
+            block["moe"] = moe_init(next(keys), cfg.moe_experts, d, 4 * d, dt)
+        else:
+            block["mlp"] = {
                 "fc": _normal(next(keys), (d, 4 * d), std, dt),
                 "fc_b": jnp.zeros((4 * d,), dt),
                 "proj": _normal(next(keys), (4 * d, d), resid_std, dt),
                 "proj_b": jnp.zeros((d,), dt),
-            },
-        }
+            }
         params["blocks"].append(block)
     return params
 
@@ -199,6 +216,29 @@ def _block(x, p, key, cfg: GPT2Config, tp_axis=None, seq_axis=None):
 _block_remat = partial(jax.checkpoint, static_argnums=(3, 4, 5))(_block)
 
 
+def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
+    """Pre-LN block whose FFN is the Switch-MoE layer: tokens flattened to
+    [B*T, D], routed/dispatched by parallel/expert.moe_ffn (two all_to_all
+    hops when ``expert_axis`` is bound), combined back. Returns
+    ``(x, aux_loss)`` — the load-balance auxiliary to add to the train loss."""
+    from distributed_lion_tpu.parallel.expert import moe_ffn
+
+    k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
+    x = x + _dropout(
+        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, None, None),
+        cfg.dropout, k2,
+    )
+    B, T, D = x.shape
+    h = _layer_norm(x, p["ln_2"]).reshape(B * T, D)
+    y, aux = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
+                     axis_name=expert_axis)
+    x = x + _dropout(y.reshape(B, T, D), cfg.dropout, k3)
+    return x, aux
+
+
+_moe_block_remat = partial(jax.checkpoint, static_argnums=(3, 4))(_moe_block)
+
+
 def gpt2_apply(
     params: dict,
     tokens: jnp.ndarray,
@@ -207,6 +247,8 @@ def gpt2_apply(
     dropout_key: Optional[jax.Array] = None,
     tp_axis: Optional[str] = None,
     seq_axis: Optional[str] = None,
+    expert_axis: Optional[str] = None,
+    return_aux: bool = False,
 ) -> jnp.ndarray:
     """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
 
@@ -238,18 +280,52 @@ def gpt2_apply(
     )
     x = _dropout(x, cfg.dropout, keys[-1])
     block = _block_remat if cfg.remat else _block
+    moe_block = _moe_block_remat if cfg.remat else _moe_block
+    aux_total = jnp.float32(0)
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
-        x = block(x, p, k, cfg, tp_axis, seq_axis)
+        if "moe" in p:  # static pytree-structure branch, resolved at trace
+            x, aux = moe_block(x, p, k, cfg, expert_axis)
+            aux_total = aux_total + aux
+        else:
+            x = block(x, p, k, cfg, tp_axis, seq_axis)
     x = _layer_norm(x, params["ln_f"])
     logits = jnp.einsum(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
+    if return_aux:
+        return logits, aux_total
     return logits
 
 
 def count_params(params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
+
+
+def gpt2_moe_param_specs(cfg: GPT2Config) -> dict:
+    """PartitionSpec tree for a MoE config: expert FFN banks sharded over the
+    'expert' mesh axis (parallel/expert.moe_param_specs); everything else
+    replicated. Valid for ep == 1 too (a P('expert') dim over a size-1 axis
+    is replication)."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_lion_tpu.parallel.expert import moe_param_specs
+
+    rep = P()
+    ln = {"scale": rep, "bias": rep}
+    blocks = []
+    for i in range(cfg.n_layer):
+        block = {
+            "ln_1": ln,
+            "attn": {k: rep for k in ("qkv", "qkv_b", "proj", "proj_b")},
+            "ln_2": ln,
+        }
+        if is_moe_block(cfg, i):
+            block["moe"] = moe_param_specs()
+        else:
+            block["mlp"] = {k: rep for k in ("fc", "fc_b", "proj", "proj_b")}
+        blocks.append(block)
+    return {"wte": rep, "wpe": rep, "ln_f": ln, "blocks": blocks}
 
 
 # ------------------------------------------------------------------ decoding
@@ -303,7 +379,16 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
     for p, c in zip(params["blocks"], cache):
         a, c = _decode_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, c, pos)
         x = x + a
-        x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+        if "moe" in p:  # MoE checkpoint: single-device routing, no collectives
+            from distributed_lion_tpu.parallel.expert import moe_ffn
+
+            B2, S2, D2 = x.shape
+            h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
+            y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
+                           axis_name=None)
+            x = x + y.reshape(B2, S2, D2)
+        else:
+            x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
         new_cache.append(c)
     x = _layer_norm(x, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype),

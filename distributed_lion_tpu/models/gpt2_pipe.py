@@ -61,8 +61,10 @@ def unpipeline_params(pparams: dict, n_layer: int) -> dict:
     }
 
 
-def pipeline_param_specs(cfg: GPT2Config, pp: int) -> dict:
-    """Replicated embeddings/norm; stage leaves sharded over ``pipe``."""
+def pipeline_param_specs() -> dict:
+    """Replicated embeddings/norm; stage leaves sharded over ``pipe`` (the
+    stacked-stage leading dim is implied by ``P(PIPE_AXIS)`` alone — no
+    config dependence)."""
     rep = P()
     ln = {"scale": rep, "bias": rep}
     stage_ln = {"scale": P(PIPE_AXIS), "bias": P(PIPE_AXIS)}

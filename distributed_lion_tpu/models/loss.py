@@ -47,6 +47,48 @@ def clm_loss_and_metrics(
     return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}
 
 
+def clm_loss_sharded_rows(
+    logits: jnp.ndarray,
+    tokens: jnp.ndarray,
+    axis_name: str,
+    aux: jnp.ndarray | None = None,
+    aux_weight: float = 0.01,
+) -> tuple[jnp.ndarray, dict]:
+    """CLM loss when batch ROWS are sharded over ``axis_name`` but params are
+    replicated along it (expert parallelism's token sharding — the 'expert'
+    axis doubles as extra data parallelism for the dense layers).
+
+    Returns ``local_row_nll_sum / global_token_count`` (+ the MoE aux loss,
+    averaged over shards) so that a ``psum`` of its GRADIENT over
+    ``axis_name`` equals the full-batch gradient — the train loop reduces
+    replicated-leaf grads exactly that way (train/loop.py). Expert-SHARDED
+    leaves need no such reduction: every path from them to any shard's loss
+    crosses the dispatch/return all_to_all, whose transpose routes the
+    cross-shard cotangents home. Metrics are globally reduced.
+    """
+    shift_logits = logits[:, :-1]
+    shift_labels = tokens[:, 1:]
+    logp = jax.nn.log_softmax(shift_logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, shift_labels[..., None], axis=-1)[..., 0]
+    n_local = jnp.float32(nll.size)
+    shards = jax.lax.psum(1, axis_name)
+    n_global = jnp.maximum(jax.lax.psum(n_local, axis_name), 1.0)
+    ce_local = nll.sum() / n_global
+    loss_local = ce_local
+    pred = shift_logits.argmax(-1)
+    acc = jax.lax.psum((pred == shift_labels).sum().astype(jnp.float32),
+                       axis_name) / n_global
+    metrics = {
+        "loss": jax.lax.psum(ce_local, axis_name),  # CE only, aux reported apart
+        "accuracy": acc,
+        "n_tokens": n_global / shards,  # per-shard average (logging parity)
+    }
+    if aux is not None:
+        loss_local = loss_local + aux_weight * aux / shards
+        metrics["aux_loss"] = jax.lax.psum(aux / shards, axis_name)
+    return loss_local, metrics
+
+
 def clm_loss_seq_parallel(
     logits: jnp.ndarray,
     tokens: jnp.ndarray,

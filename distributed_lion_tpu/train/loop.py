@@ -49,6 +49,7 @@ from distributed_lion_tpu.optim.zero import (
 )
 from distributed_lion_tpu.parallel.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     PIPE_AXIS,
     SEQ_AXIS,
     TENSOR_AXIS,
@@ -92,6 +93,9 @@ class TrainConfig:
     # + parallel/pipeline); net-new
     pipeline_microbatches: int = 0  # GPipe microbatches per accum step
     # (0 → pipeline_parallel; bubble fraction = (S-1)/(M+S-1))
+    expert_parallel: int = 1  # expert mesh axis size: MoE FFN banks sharded
+    # over 'expert', tokens ride dispatch/return all_to_all; the axis doubles
+    # as extra data parallelism for dense layers (parallel/expert); net-new
     max_grad_norm: Optional[float] = None  # set → stochastic binarization
     grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
     # (HF Trainer, which the reference sits on, clips at 1.0 by default —
@@ -231,6 +235,14 @@ class Trainer:
                         "win. Use pure data parallelism with ZeRO-1."
                     )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
+        # number of ways batch ROWS (dim 0) are sharded: data alone normally;
+        # data x expert under expert parallelism (tokens ride both axes)
+        dim0 = self.batch_spec[0] if len(self.batch_spec) else None
+        dim0_axes = (tuple(dim0) if isinstance(dim0, (tuple, list))
+                     else (dim0,) if dim0 else ())
+        self.batch_shards = 1
+        for a in dim0_axes:
+            self.batch_shards *= dict(mesh.shape).get(a, 1)
         self.apply_fn = apply_fn
         self.opt = make_optimizer(cfg)
         if param_specs is None:
@@ -341,6 +353,7 @@ class Trainer:
 
         sp = dict(self.mesh.shape).get(SEQ_AXIS, 1)
         pp = dict(self.mesh.shape).get(PIPE_AXIS, 1)
+        ep = dict(self.mesh.shape).get(EXPERT_AXIS, 1)
 
         @partial(
             jax.shard_map,
@@ -356,6 +369,9 @@ class Trainer:
             )
             widx = lax.axis_index(DATA_AXIS)
             key = jax.random.fold_in(jax.random.fold_in(base_key, widx), _count_of(state))
+            if ep > 1:
+                # expert ranks hold different batch rows → distinct dropout keys
+                key = jax.random.fold_in(key, lax.axis_index(EXPERT_AXIS))
 
             def micro(gsum, inp):
                 microbatch, i = inp
@@ -374,12 +390,16 @@ class Trainer:
                 # ITS tokens' loss term (normalized by the global token
                 # count) — the full gradient is their sum.
                 grads = lax.psum(grads, SEQ_AXIS)
-            if pp > 1:
-                # pipeline parallelism: stage-sharded leaves carry their own
-                # (complete) local gradients; replicated leaves (embeddings,
-                # final norm) got disjoint per-stage partials — stage 0 the
-                # embedding path, the last stage the tied-logits path — whose
-                # sum is the full gradient.
+            for ax, deg in ((PIPE_AXIS, pp), (EXPERT_AXIS, ep)):
+                if deg <= 1:
+                    continue
+                # Leaves SHARDED over this axis carry complete local grads
+                # (pipe: each stage owns its blocks; expert: the all_to_all
+                # transpose already routed cross-shard cotangents home).
+                # REPLICATED leaves carry per-shard partials — pipe: disjoint
+                # stage contributions (stage-0 embedding, last-stage logits
+                # tie); expert: per-row loss terms normalized by the global
+                # token count — whose psum is the full gradient.
                 from distributed_lion_tpu.parallel.tensor_parallel import (
                     spec_uses_axis,
                 )
@@ -387,7 +407,7 @@ class Trainer:
                 flat_g, gdef = jax.tree.flatten(grads)
                 flat_s = gdef.flatten_up_to(param_specs)
                 flat_g = [
-                    g if spec_uses_axis(s, PIPE_AXIS) else lax.psum(g, PIPE_AXIS)
+                    g if spec_uses_axis(s, ax) else lax.psum(g, ax)
                     for g, s in zip(flat_g, flat_s)
                 ]
                 grads = jax.tree.unflatten(gdef, flat_g)
@@ -407,7 +427,8 @@ class Trainer:
                 # every rank derives the same scale.
                 shard_axes = tuple(a for a, flag in
                                    ((TENSOR_AXIS, tp_axis is not None),
-                                    (PIPE_AXIS, pp > 1)) if flag)
+                                    (PIPE_AXIS, pp > 1),
+                                    (EXPERT_AXIS, ep > 1)) if flag)
                 grads = clip_by_global_norm(grads, clip, specs=param_specs,
                                             shard_axes=shard_axes)
             if cfg.lion:
@@ -466,7 +487,8 @@ class Trainer:
 
     # ------------------------------------------------------------- train/eval
     def global_train_batch(self) -> int:
-        return self.world * self.cfg.per_device_train_batch_size * self.cfg.gradient_accumulation_steps
+        return (self.batch_shards * self.cfg.per_device_train_batch_size
+                * self.cfg.gradient_accumulation_steps)
 
     def train(
         self,
@@ -557,13 +579,14 @@ class Trainer:
         # only the CLI's mesh-building input)
         pp = dict(self.mesh.shape).get(PIPE_AXIS, 1)
         div = (cfg.pipeline_microbatches or pp) if pp > 1 else 1
-        if n_examples < self.world * per_dev:
+        if n_examples < self.batch_shards * per_dev:
             # shrink rather than silently skipping eval on small validation
             # splits (jit re-specializes on the new shape)
-            per_dev = max(div, n_examples // self.world // div * div)
-        bs = self.world * per_dev
+            per_dev = max(div, n_examples // self.batch_shards // div * div)
+        bs = self.batch_shards * per_dev
         if n_examples < bs:
-            print(f"[trainer] eval skipped: {n_examples} examples < world {self.world}")
+            print(f"[trainer] eval skipped: {n_examples} examples < "
+                  f"{self.batch_shards} batch shards")
             return {"eval/loss": float("nan"), "eval/accuracy": float("nan"),
                     "eval/perplexity": float("nan")}
         data_spec = NamedSharding(self.mesh, self.batch_spec)
@@ -657,10 +680,17 @@ class Trainer:
                 validate_pipeline,
             )
 
-            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+            if (tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1
+                    or dict(mesh.shape).get(EXPERT_AXIS, 1) > 1):
                 raise NotImplementedError(
                     "pipeline parallelism composes with data parallelism "
-                    "(dp x pp); tensor/seq axes alongside pipe are not wired"
+                    "(dp x pp); tensor/seq/expert axes alongside pipe are "
+                    "not wired"
+                )
+            if model_cfg.moe_experts > 0:
+                raise NotImplementedError(
+                    "MoE blocks under pipeline parallelism are not wired "
+                    "(mixed dense/MoE stage structures); drop one of the two"
                 )
             n_micro = cfg.pipeline_microbatches or pp
             validate_pipeline(model_cfg, cfg, pp, n_micro)
@@ -668,9 +698,67 @@ class Trainer:
                 cfg, mesh,
                 apply_fn=None,
                 params=pipeline_params(params, pp),
-                param_specs=pipeline_param_specs(model_cfg, pp),
+                param_specs=pipeline_param_specs(),
                 loss_fn=make_pipeline_loss(model_cfg, n_micro),
             )
+
+        ep = dict(mesh.shape).get(EXPERT_AXIS, 1)
+        if ep > 1 and model_cfg.moe_experts == 0:
+            raise ValueError(
+                f"an 'expert' mesh axis of size {ep} needs MoE blocks "
+                "(--moe_experts); a dense model would silently duplicate all "
+                "compute across the axis"
+            )
+        if model_cfg.moe_experts > 0:
+            from distributed_lion_tpu.models.gpt2 import gpt2_moe_param_specs
+            from distributed_lion_tpu.models.loss import (
+                clm_loss_and_metrics,
+                clm_loss_sharded_rows,
+            )
+
+            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+                raise NotImplementedError(
+                    "MoE composes with data + expert parallelism (dp x ep); "
+                    "tensor/seq axes alongside MoE are not wired"
+                )
+            if model_cfg.moe_experts % ep:
+                raise ValueError(
+                    f"moe_experts {model_cfg.moe_experts} not divisible by "
+                    f"expert axis {ep}"
+                )
+            expert_axis = EXPERT_AXIS if ep > 1 else None
+            moe_specs = gpt2_moe_param_specs(model_cfg) if ep > 1 else None
+
+            def moe_apply(params, tokens, dropout_key):
+                return gpt2_apply(params, tokens, model_cfg,
+                                  dropout_key=dropout_key,
+                                  expert_axis=expert_axis, return_aux=True)
+
+            if ep > 1:
+                def moe_loss(params, batch, dropout_key):
+                    logits, aux = moe_apply(params, batch, dropout_key)
+                    return clm_loss_sharded_rows(logits, batch, EXPERT_AXIS,
+                                                 aux=aux)
+
+                moe_batch_spec = P((DATA_AXIS, EXPERT_AXIS))
+            else:
+                def moe_loss(params, batch, dropout_key):
+                    logits, aux = moe_apply(params, batch, dropout_key)
+                    loss, metrics = clm_loss_and_metrics(logits, batch)
+                    metrics["aux_loss"] = aux
+                    return loss + 0.01 * aux, metrics
+
+                moe_batch_spec = None
+            n_active = count_params(params) - sum(
+                p.size for b in params["blocks"] if "moe" in b
+                for p in jax.tree.leaves(b["moe"])
+            )
+            print(f"[trainer] GPT-2-MoE: {count_params(params)/1e6:.1f}M total "
+                  f"({n_active/1e6:.1f}M dense) | {model_cfg.moe_experts} "
+                  f"experts every {model_cfg.moe_every} blocks | ep={ep}")
+            return Trainer(cfg, mesh, apply_fn=None, params=params,
+                           param_specs=moe_specs, loss_fn=moe_loss,
+                           batch_spec=moe_batch_spec)
 
         param_specs = None
         tp_axis = None

@@ -72,7 +72,7 @@ def test_pp_forward_matches_sequential():
 
     loss_fn = make_pipeline_loss(MODEL, n_micro=2)
     pparams = pipeline_params(params, pp)
-    pspecs = pipeline_param_specs(MODEL, pp)
+    pspecs = pipeline_param_specs()
 
     @jax.jit
     def run(pparams, tokens):
