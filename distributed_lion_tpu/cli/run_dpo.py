@@ -63,13 +63,11 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import load_pytree, save_pytree
 
-    if train_cfg.tensor_parallel > 1:
-        # see run_sft.py: frozen-base sharding over the tensor axis is not
-        # wired into the LoRA Trainer path yet; fail fast instead of
-        # silently disabling data parallelism.
+    if train_cfg.tensor_parallel > 1 and script_args.quant_ref != "none":
         raise NotImplementedError(
-            "--tensor_parallel > 1 is not yet wired into the SFT/DPO LoRA "
-            "path; use run_clm for tensor parallelism"
+            "--tensor_parallel with a quantized reference model is not "
+            "wired (QuantizedTensor leaves cannot shard along weight dims); "
+            "use a bf16/f32 ref with TP or quantize under data parallelism."
         )
     mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
@@ -124,14 +122,44 @@ def main(argv=None):
     )
     adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
 
-    policy_apply_lora = lora_apply_fn(
-        lambda p, t: llama_apply(p, t, model_cfg), base_params, lora_cfg
-    )
-    loss_fn = make_dpo_loss_fn(
-        policy_apply=policy_apply_lora,
-        ref_apply=lambda t: llama_apply(ref_params, t, model_cfg),
-        beta=script_args.beta,
-    )
+    tp = train_cfg.tensor_parallel
+    frozen_params = frozen_specs = None
+    if tp > 1:
+        from distributed_lion_tpu.models.lora import apply_adapters, lora_adapter_specs
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            llama_param_specs,
+            validate_tp,
+        )
+        from distributed_lion_tpu.train.dpo import make_dpo_loss_fn_frozen
+
+        validate_tp(model_cfg, tp, "llama")
+        base_specs = llama_param_specs(model_cfg)
+        frozen_params = {"base": base_params, "ref": ref_params}
+        frozen_specs = {"base": base_specs, "ref": base_specs}
+
+        def policy_apply(params, frozen, tokens):
+            effective = apply_adapters(frozen["base"], params, lora_cfg,
+                                       tp_axis=TENSOR_AXIS, base_specs=base_specs)
+            return llama_apply(effective, tokens, model_cfg, tp_axis=TENSOR_AXIS)
+
+        loss_fn = make_dpo_loss_fn_frozen(
+            policy_apply=policy_apply,
+            ref_apply=lambda frozen, t: llama_apply(frozen["ref"], t, model_cfg,
+                                                    tp_axis=TENSOR_AXIS),
+            beta=script_args.beta,
+        )
+        adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
+    else:
+        policy_apply_lora = lora_apply_fn(
+            lambda p, t: llama_apply(p, t, model_cfg), base_params, lora_cfg
+        )
+        loss_fn = make_dpo_loss_fn(
+            policy_apply=policy_apply_lora,
+            ref_apply=lambda t: llama_apply(ref_params, t, model_cfg),
+            beta=script_args.beta,
+        )
+        adapter_specs = None
 
     if script_args.dataset == "synthetic":
         records = synthetic_qa_pairs(script_args.num_train_samples + script_args.size_valid_set)
@@ -154,7 +182,9 @@ def main(argv=None):
     print(f"[run_dpo] {len(train_data['chosen'])} train / {n_valid} eval pairs "
           f"(after length filtering)")
 
-    trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters, loss_fn=loss_fn)
+    trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                      loss_fn=loss_fn, param_specs=adapter_specs,
+                      frozen_params=frozen_params, frozen_specs=frozen_specs)
     it = dpo_batch_iterator(train_data, trainer.global_train_batch(), seed=train_cfg.seed)
     try:
         trainer.train(it, eval_blocks=eval_data)

@@ -82,14 +82,12 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import save_pytree
 
-    if train_cfg.tensor_parallel > 1:
-        # LoRA training closes over the frozen base inside the train step;
-        # sharding it over the tensor axis needs frozen-param specs in the
-        # Trainer. Until then, accepting the flag would silently shrink the
-        # data axis while every tensor-axis device redoes identical work.
+    if train_cfg.tensor_parallel > 1 and script_args.quant != "none":
         raise NotImplementedError(
-            "--tensor_parallel > 1 is not yet wired into the SFT/DPO LoRA "
-            "path; use run_clm for tensor parallelism"
+            "--tensor_parallel with a quantized base is not wired: "
+            "QuantizedTensor packs codes flat, so its leaves cannot be "
+            "sharded along the original weight dims. Use a bf16/f32 frozen "
+            "base with TP, or quantize under pure data parallelism."
         )
     mesh = build_mesh(train_cfg.tensor_parallel)
     tok = load_tokenizer(script_args.tokenizer_name)
@@ -141,10 +139,38 @@ def main(argv=None):
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
     print(f"[run_sft] LoRA adapters: {len(adapters)} sites, {n_adapter/1e3:.1f}k trainable params")
 
-    apply_fn = lora_apply_fn(
-        lambda p, t, key=None: llama_apply(p, t, model_cfg), base_params, lora_cfg
-    )
-    trainer = Trainer(train_cfg, mesh, lambda p, t, key: apply_fn(p, t), adapters)
+    tp = train_cfg.tensor_parallel
+    if tp > 1:
+        # frozen base sharded over the tensor axis, threaded through the
+        # train step as a live argument; adapters shard with their targets
+        # (models/lora.lora_adapter_specs), replicated factors get the
+        # copy_to_tp_region gradient boundary inside apply_adapters.
+        from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+        from distributed_lion_tpu.models.lora import apply_adapters, lora_adapter_specs
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            llama_param_specs,
+            validate_tp,
+        )
+
+        validate_tp(model_cfg, tp, "llama")
+        base_specs = llama_param_specs(model_cfg)
+        adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
+
+        def loss_fn(params, frozen, batch, dropout_key):
+            effective = apply_adapters(frozen, params, lora_cfg,
+                                       tp_axis=TENSOR_AXIS, base_specs=base_specs)
+            logits = llama_apply(effective, batch, model_cfg, tp_axis=TENSOR_AXIS)
+            return clm_loss_and_metrics(logits, batch)
+
+        trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                          param_specs=adapter_specs, loss_fn=loss_fn,
+                          frozen_params=base_params, frozen_specs=base_specs)
+    else:
+        apply_fn = lora_apply_fn(
+            lambda p, t, key=None: llama_apply(p, t, model_cfg), base_params, lora_cfg
+        )
+        trainer = Trainer(train_cfg, mesh, lambda p, t, key: apply_fn(p, t), adapters)
 
     def batches():
         gen = constant_length_batches(

@@ -50,6 +50,13 @@ class GPT2Config:
     moe_every: int = 2    # MoE in blocks with index % moe_every == moe_every-1
     moe_capacity_factor: float = 1.25
 
+    def __post_init__(self):
+        if self.moe_experts > 0 and self.moe_every < 1:
+            raise ValueError(
+                f"moe_every must be >= 1 when moe_experts is set, got "
+                f"{self.moe_every}"
+            )
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_head == 0
@@ -135,6 +142,23 @@ def _dropout(x, rate, key):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
+def _qkv_project(x, w):
+    """[B,T,d] @ [d,3,d] stacked qkv — dense or LoRA-adapted (factored)."""
+    from distributed_lion_tpu.models.lora import LoraTensor
+    from distributed_lion_tpu.ops.quant import maybe_dequant
+
+    if isinstance(w, LoraTensor):
+        base = jnp.einsum("btd,dce->btce", x,
+                          maybe_dequant(w.base, x.dtype).astype(x.dtype),
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        xa = x @ w.A.astype(x.dtype)
+        delta = jnp.einsum("btr,rce->btce", xa, w.B.astype(x.dtype),
+                           preferred_element_type=jnp.float32).astype(x.dtype)
+        return base + w.scaling * delta
+    return jnp.einsum("btd,dce->btce", x, w.astype(x.dtype),
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
 def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
     """Causal multi-head attention; f32 softmax for stability.
 
@@ -152,10 +176,7 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
         # ranks so upstream (LN/embedding) grads are complete, not partials
         x = copy_to_tp_region(x, tp_axis)
     H, hd = cfg.n_head // tp, cfg.head_dim
-    qkv = jnp.einsum(
-        "btd,dce->btce", x, p["qkv"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+    qkv = _qkv_project(x, p["qkv"]) + p["qkv_b"].astype(x.dtype)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
@@ -385,7 +406,7 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
             B2, S2, D2 = x.shape
             h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
             y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                           axis_name=None)
+                           axis_name=None, capacity_override=B2 * S2)
             x = x + y.reshape(B2, S2, D2)
         else:
             x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])

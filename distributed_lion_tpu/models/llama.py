@@ -128,8 +128,11 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
 
 
 def _matmul(x, w):
-    w = maybe_dequant(w, x.dtype)
-    return x @ w.astype(x.dtype)
+    # dense / QuantizedTensor / LoraTensor (factored x@W + s·(x@A)@B) —
+    # models.lora.lora_matmul is the single dispatch point
+    from distributed_lion_tpu.models.lora import lora_matmul
+
+    return lora_matmul(x, w)
 
 
 def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):

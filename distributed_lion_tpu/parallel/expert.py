@@ -61,6 +61,7 @@ def moe_ffn(
     *,
     capacity_factor: float = 1.25,
     axis_name: Optional[str] = EXPERT_AXIS,
+    capacity_override: Optional[int] = None,
 ):
     """Apply the MoE FFN to local tokens ``x [N, D]``.
 
@@ -77,7 +78,12 @@ def moe_ffn(
     ep = 1 if axis_name is None else lax.psum(1, axis_name)
     e_local = params["w_in"].shape[0]
     n_experts = e_local * ep
-    cap = capacity(n, n_experts, capacity_factor)
+    # capacity_override: incremental decode calls with tiny per-step token
+    # counts (n = batch) would otherwise compute cap ≈ 1 and systematically
+    # drop colliding tokens that training/prefill (n = B*T) never drops —
+    # decode passes cap = n so no token is ever dropped at generation time.
+    cap = (capacity_override if capacity_override is not None
+           else capacity(n, n_experts, capacity_factor))
 
     # --- route (every device scores the full expert set) ---
     logits = x @ params["gate"]  # [N, E]

@@ -65,3 +65,25 @@ def make_dpo_loss_fn(
         return loss, metrics
 
     return loss_fn
+
+
+def make_dpo_loss_fn_frozen(
+    policy_apply: Callable,
+    ref_apply: Callable,
+    beta: float = 0.1,
+) -> Callable:
+    """Frozen-as-argument variant for the Trainer's ``frozen_params`` path
+    (tensor parallelism: the base/ref trees arrive as live sharded args, not
+    closures). ``policy_apply(params, frozen, tokens)``,
+    ``ref_apply(frozen, tokens)``; returns
+    ``loss_fn(params, frozen, batch, dropout_key)``."""
+
+    def loss_fn(params, frozen, batch, dropout_key):
+        inner = make_dpo_loss_fn(
+            lambda p, t: policy_apply(p, frozen, t),
+            lambda t: ref_apply(frozen, t),
+            beta,
+        )
+        return inner(params, batch, dropout_key)
+
+    return loss_fn

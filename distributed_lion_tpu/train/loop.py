@@ -212,13 +212,23 @@ class Trainer:
         loss_fn: Optional[Callable] = None,
         param_specs: Any = None,
         batch_spec: Optional[P] = None,
+        frozen_params: Any = None,
+        frozen_specs: Any = None,
     ):
         """``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` may
         replace the default CLM loss; ``batch`` is then any pytree whose
         leaves carry a leading global-batch axis (e.g. DPO's
         chosen/rejected pairs). ``param_specs`` is an optional PartitionSpec
         pytree (parallel.tensor_parallel) for tensor-parallel params;
-        default replicated."""
+        default replicated.
+
+        ``frozen_params`` is an optional NON-trained pytree (LoRA bases, DPO
+        reference models) threaded through the train/eval shard_maps as a
+        live sharded argument — required whenever the frozen tree must be
+        sharded over a non-data mesh axis (a closure capture would be
+        replicated). When set, ``loss_fn`` takes
+        ``(params, frozen, batch, dropout_key)`` and ``frozen_specs`` gives
+        its PartitionSpecs (default replicated)."""
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
@@ -272,6 +282,16 @@ class Trainer:
         self.params = jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, param_specs
         )
+        self.frozen = None
+        self.frozen_specs = None
+        if frozen_params is not None:
+            if frozen_specs is None:
+                frozen_specs = jax.tree.map(lambda _: P(), frozen_params)
+            self.frozen_specs = frozen_specs
+            self.frozen = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                frozen_params, frozen_specs,
+            )
         rng = jax.random.key(cfg.seed)
         self._exp_avg_specs = jax.tree.map(
             lambda s: P(*((DATA_AXIS,) + tuple(s))), param_specs
@@ -331,6 +351,11 @@ class Trainer:
         self.n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(self.params))
         self._maybe_resume()
 
+    def _frozen_arg(self):
+        """The frozen pytree as passed to the jitted steps ({} when unused —
+        an empty pytree keeps the shard_map arity fixed)."""
+        return self.frozen if self.frozen is not None else {}
+
     def comm_stats(self, steps_per_sec: Optional[float] = None) -> dict:
         """Analytic bytes-on-wire report for the vote collective (empty for
         the AdamW path, which has no optimizer collective)."""
@@ -354,15 +379,20 @@ class Trainer:
         sp = dict(self.mesh.shape).get(SEQ_AXIS, 1)
         pp = dict(self.mesh.shape).get(PIPE_AXIS, 1)
         ep = dict(self.mesh.shape).get(EXPERT_AXIS, 1)
+        has_frozen = self.frozen is not None
+        frozen_specs = self.frozen_specs if has_frozen else {}
 
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(self.param_specs, st_specs, self.batch_spec, P()),
+            in_specs=(self.param_specs, st_specs, frozen_specs,
+                      self.batch_spec, P()),
             out_specs=(self.param_specs, st_specs, P()),
             check_vma=False,
         )
-        def step(params, state, batch, base_key):
+        def step(params, state, frozen, batch, base_key):
+            call_loss = ((lambda p, b, k: loss_fn(p, frozen, b, k))
+                         if has_frozen else loss_fn)
             # each batch leaf: [accum * local_bs, ...] → [accum, local_bs, ...]
             local = jax.tree.map(
                 lambda b: b.reshape((accum, -1) + b.shape[1:]), batch
@@ -376,7 +406,7 @@ class Trainer:
             def micro(gsum, inp):
                 microbatch, i = inp
                 (loss, metrics), g = jax.value_and_grad(
-                    loss_fn, has_aux=True
+                    call_loss, has_aux=True
                 )(params, microbatch, jax.random.fold_in(key, i))
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return gsum, metrics
@@ -456,10 +486,10 @@ class Trainer:
         host→device round trip per K steps instead of per step."""
         step = self._train_step_core
 
-        def chunk(params, state, batches, base_key):
+        def chunk(params, state, frozen, batches, base_key):
             def body(carry, batch):
                 p, s = carry
-                p, s, m = step(p, s, batch, base_key)
+                p, s, m = step(p, s, frozen, batch, base_key)
                 return (p, s), m
 
             (params, state), ms = lax.scan(body, (params, state), batches)
@@ -471,16 +501,19 @@ class Trainer:
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
+        has_frozen = self.frozen is not None
+        frozen_specs = self.frozen_specs if has_frozen else {}
 
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(self.param_specs, self.batch_spec),
+            in_specs=(self.param_specs, frozen_specs, self.batch_spec),
             out_specs=P(),
             check_vma=False,
         )
-        def step(params, batch):
-            loss, metrics = loss_fn(params, batch, None)
+        def step(params, frozen, batch):
+            loss, metrics = (loss_fn(params, frozen, batch, None) if has_frozen
+                             else loss_fn(params, batch, None))
             return {k: lax.pmean(v, DATA_AXIS) for k, v in metrics.items()}
 
         return jax.jit(step)
@@ -528,7 +561,8 @@ class Trainer:
                 )
                 with self.profiler.annotate(self.step_count):
                     self.params, self.state, metrics = self._train_chunk(
-                        self.params, self.state, batches, base_key
+                        self.params, self.state, self._frozen_arg(), batches,
+                        base_key
                     )
                 self.step_count += k
                 self.timer.tick(k)
@@ -536,7 +570,8 @@ class Trainer:
                 batch = jax.device_put(next(train_iter), data_spec)
                 with self.profiler.annotate(self.step_count):
                     self.params, self.state, metrics = self._train_step(
-                        self.params, self.state, batch, base_key
+                        self.params, self.state, self._frozen_arg(), batch,
+                        base_key
                     )
                 self.step_count += 1
                 self.timer.tick()
@@ -599,7 +634,7 @@ class Trainer:
                 ),
                 data_spec,
             )
-            m = self._eval_step(self.params, batch)
+            m = self._eval_step(self.params, self._frozen_arg(), batch)
             for k, v in m.items():
                 per_key.setdefault(k, []).append(float(v))
         # aggregate EVERY metric the loss_fn reports (CLM: loss/accuracy/

@@ -123,14 +123,18 @@ def test_moe_guards():
 
 def test_moe_decode_matches_apply():
     """The export->generate cycle works for MoE checkpoints: cached decode
-    logits match the full forward position-for-position."""
+    logits match the full forward position-for-position. Decode never drops
+    tokens (capacity_override = per-call token count), so compare against a
+    capacity_factor high enough that the full forward doesn't drop either —
+    where both paths keep every token, they must agree."""
     from distributed_lion_tpu.models.gpt2 import gpt2_decode, gpt2_init_cache
 
-    params = gpt2_init(jax.random.key(2), MODEL)
+    model = GPT2Config.tiny(n_layer=4, moe_experts=4, moe_capacity_factor=4.0)
+    params = gpt2_init(jax.random.key(2), model)
     tokens = np.random.default_rng(1).integers(
-        0, MODEL.vocab_size, size=(2, 12)).astype(np.int32)
-    full = gpt2_apply(params, tokens, MODEL, return_aux=True)[0]
-    cache = gpt2_init_cache(MODEL, 2, 16)
-    dec, _ = gpt2_decode(params, tokens, MODEL, cache, 0)
+        0, model.vocab_size, size=(2, 12)).astype(np.int32)
+    full = gpt2_apply(params, tokens, model, return_aux=True)[0]
+    cache = gpt2_init_cache(model, 2, 16)
+    dec, _ = gpt2_decode(params, tokens, model, cache, 0)
     np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
                                rtol=2e-2, atol=2e-2)
