@@ -1,0 +1,55 @@
+"""Train a GPT-2-format byte-level BPE tokenizer on local text.
+
+Zero-egress stand-in for downloading GPT-2's tokenizer from HF hub
+(/root/reference/run_clm.py:398-423): learns vocab.json + merges.txt in the
+exact published format (loadable by this framework via
+``--tokenizer_name bpe:<dir>`` AND by ``transformers.GPT2Tokenizer``), so a
+corpus-specific vocabulary — or, when the real GPT-2 files are available
+locally, the true 50257-token vocabulary — drives the ``text:`` data path.
+
+    python -m distributed_lion_tpu.cli.train_bpe \
+        --text 'corpus/*.txt' --output_dir tok/ --vocab_size 8192
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+
+
+@dataclasses.dataclass
+class BPEArguments:
+    text: str = ""            # glob of local text files
+    output_dir: str = "bpe_tok"
+    vocab_size: int = 8192
+    max_chars: int = 50_000_000  # training-corpus cap (BPE training is
+    # quadratic-ish in merges x corpus; cap keeps it tractable)
+
+
+def main(argv=None):
+    from distributed_lion_tpu.data.bpe import train_bpe
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    (args,) = parse_dataclasses((BPEArguments,), argv)
+    paths = sorted(glob.glob(args.text))
+    if not paths:
+        raise FileNotFoundError(f"no files match {args.text!r}")
+
+    def texts():
+        budget = args.max_chars
+        for p in paths:
+            with open(p, encoding="utf-8", errors="replace") as f:
+                chunk = f.read(budget)
+            yield chunk
+            budget -= len(chunk)
+            if budget <= 0:
+                return
+
+    tok = train_bpe(texts(), args.vocab_size)
+    tok.save(args.output_dir)
+    print(f"[train_bpe] {tok.vocab_size}-token vocabulary "
+          f"({len(tok.ranks)} merges) saved to {args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""GPT-2 byte-level BPE: the exact algorithm and file format, offline.
+
+The reference tokenizes with GPT-2's BPE pulled from HF hub
+(/root/reference/run_clm.py:398-423). This environment is zero-egress, so the
+tokenizer itself is implemented here — the same byte↔unicode table,
+pre-tokenization regex, and merge procedure GPT-2 published — reading the
+standard ``vocab.json`` + ``merges.txt`` files:
+
+- drop in the real GPT-2 files (from any HF checkout) and ``encode`` matches
+  ``GPT2Tokenizer`` token-for-token (pinned by tests/test_bpe.py against
+  ``transformers``' implementation on locally-trained files);
+- or learn a corpus-specific vocabulary with :func:`train_bpe`
+  (``cli.train_bpe``) — same format, loadable by HF tooling too.
+
+No network, no transformers dependency at runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import Iterable, List, Optional
+
+try:  # \p{L}/\p{N} classes need the `regex` module (baked in)
+    import regex as _re
+except ImportError:  # pragma: no cover
+    _re = None
+
+# GPT-2's pre-tokenization pattern (contractions, letter runs, number runs,
+# punctuation runs, whitespace) — the published pattern, verbatim.
+_PAT = (r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+        r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+
+END_OF_TEXT = "<|endoftext|>"
+
+
+@lru_cache(maxsize=1)
+def bytes_to_unicode() -> dict:
+    """GPT-2's reversible byte → printable-unicode map: the 188 'visible'
+    bytes map to themselves; the rest shift up by 256. Keeps merges.txt
+    printable while covering all 256 byte values."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+@lru_cache(maxsize=1)
+def unicode_to_bytes() -> dict:
+    return {v: k for k, v in bytes_to_unicode().items()}
+
+
+def _get_pairs(word: tuple) -> set:
+    return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+
+
+class BPETokenizer:
+    """Byte-level BPE over a ``vocab.json`` (token → id) + ranked
+    ``merges.txt``. API-compatible with data.tokenizer.ByteTokenizer."""
+
+    def __init__(self, vocab: dict, merges: List[tuple],
+                 specials: Optional[List[str]] = None):
+        if _re is None:
+            raise RuntimeError("the `regex` module is required for GPT-2 BPE")
+        self.vocab = dict(vocab)
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        for s in (specials or [END_OF_TEXT]):
+            if s not in self.vocab:
+                self.vocab[s] = len(self.vocab)
+        self._special_ids = {self.vocab[s] for s in (specials or [END_OF_TEXT])
+                             if s in self.vocab}
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self._pat = _re.compile(_PAT)
+        self._cache: dict = {}
+        self.eos_id = self.vocab.get(END_OF_TEXT, len(self.vocab) - 1)
+        self.bos_id = self.eos_id  # GPT-2 convention: <|endoftext|> is both
+        self.pad_id = self.eos_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab)
+
+    # ------------------------------------------------------------------ codec
+    def _bpe(self, token: str) -> List[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = _get_pairs(word)
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            first, second = best
+            out: List[str] = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    out.append(first + second)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            word = tuple(out)
+        result = list(word)
+        if len(self._cache) < 65536:
+            self._cache[token] = result
+        return result
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> List[int]:
+        b2u = bytes_to_unicode()
+        ids: List[int] = []
+        if add_bos:
+            ids.append(self.bos_id)
+        for tok in self._pat.findall(text):
+            mapped = "".join(b2u[b] for b in tok.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                ids.append(self.vocab[piece])
+        if add_eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        u2b = unicode_to_bytes()
+        text = "".join(self.inv_vocab[int(i)] for i in ids
+                       if int(i) in self.inv_vocab
+                       and int(i) not in self._special_ids)
+        data = bytes(u2b[c] for c in text if c in u2b)
+        return data.decode("utf-8", errors="replace")
+
+    # --------------------------------------------------------------------- io
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        """Load HF-format ``vocab.json`` + ``merges.txt`` from a directory
+        (the files ``GPT2Tokenizer`` ships/consumes)."""
+        with open(os.path.join(path, "vocab.json"), encoding="utf-8") as f:
+            vocab = json.load(f)
+        merges = []
+        with open(os.path.join(path, "merges.txt"), encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#version"):
+                    continue
+                a, b = line.split(" ")
+                merges.append((a, b))
+        return cls(vocab, merges)
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "vocab.json"), "w", encoding="utf-8") as f:
+            json.dump(self.vocab, f, ensure_ascii=False)
+        ordered = sorted(self.ranks.items(), key=lambda kv: kv[1])
+        with open(os.path.join(path, "merges.txt"), "w", encoding="utf-8") as f:
+            f.write("#version: 0.2\n")
+            for (a, b), _ in ordered:
+                f.write(f"{a} {b}\n")
+
+
+def train_bpe(texts: Iterable[str], vocab_size: int,
+              specials: Optional[List[str]] = None) -> BPETokenizer:
+    """Learn a byte-level BPE vocabulary (GPT-2 procedure): start from the
+    256 byte symbols, repeatedly merge the most frequent adjacent pair
+    within pre-tokenized words until ``vocab_size`` (minus specials) is
+    reached. Same format as GPT-2's published tokenizer — the real
+    vocab/merges files are a drop-in replacement."""
+    if _re is None:
+        raise RuntimeError("the `regex` module is required for BPE training")
+    pat = _re.compile(_PAT)
+    b2u = bytes_to_unicode()
+
+    # word frequency table over pre-tokens (mapped to the unicode alphabet)
+    word_freq: dict = {}
+    for text in texts:
+        for tok in pat.findall(text):
+            mapped = tuple(b2u[b] for b in tok.encode("utf-8"))
+            if mapped:
+                word_freq[mapped] = word_freq.get(mapped, 0) + 1
+
+    vocab = {ch: i for i, ch in enumerate(sorted(bytes_to_unicode().values()))}
+    specials = list(specials or [END_OF_TEXT])
+    target_merges = max(0, vocab_size - len(vocab) - len(specials))
+    merges: List[tuple] = []
+
+    words = list(word_freq.items())
+    for _ in range(target_merges):
+        pair_freq: dict = {}
+        for word, freq in words:
+            for i in range(len(word) - 1):
+                p = (word[i], word[i + 1])
+                pair_freq[p] = pair_freq.get(p, 0) + freq
+        if not pair_freq:
+            break
+        best = max(pair_freq.items(), key=lambda kv: (kv[1], kv[0]))[0]
+        if pair_freq[best] < 2:
+            break
+        merges.append(best)
+        merged = best[0] + best[1]
+        vocab[merged] = len(vocab)
+        new_words = []
+        for word, freq in words:
+            if len(word) > 1:
+                out = []
+                i = 0
+                while i < len(word):
+                    if (i < len(word) - 1 and word[i] == best[0]
+                            and word[i + 1] == best[1]):
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(word[i])
+                        i += 1
+                word = tuple(out)
+            new_words.append((word, freq))
+        words = new_words
+    return BPETokenizer(vocab, merges, specials)

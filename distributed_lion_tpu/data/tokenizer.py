@@ -43,8 +43,25 @@ class ByteTokenizer:
 
 
 def load_tokenizer(name_or_path: str | None):
-    """Best-effort HF tokenizer from local cache; ByteTokenizer otherwise."""
+    """Resolve a tokenizer, zero-egress:
+
+    - ``bpe:<dir>`` or a directory containing ``vocab.json`` + ``merges.txt``
+      → the native GPT-2 byte-level BPE (data.bpe — drop the real GPT-2
+      files in and get the real 50257 vocab);
+    - otherwise a locally cached HF tokenizer when one exists;
+    - :class:`ByteTokenizer` as the dependency-free fallback.
+    """
+    import os
+
     if name_or_path:
+        from distributed_lion_tpu.data.bpe import BPETokenizer
+
+        if name_or_path.startswith("bpe:"):
+            return BPETokenizer.load(name_or_path[len("bpe:"):])
+        if (os.path.isdir(name_or_path)
+                and os.path.exists(os.path.join(name_or_path, "vocab.json"))
+                and os.path.exists(os.path.join(name_or_path, "merges.txt"))):
+            return BPETokenizer.load(name_or_path)
         try:
             from transformers import AutoTokenizer
 

@@ -202,18 +202,25 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
     else:
         out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
-    out = out @ p["proj"].astype(x.dtype)
+    out = _proj(out, p["proj"])
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)  # row-parallel reduction
     return out + p["proj_b"].astype(x.dtype)
 
 
+def _proj(x, w):
+    """2-D projection through the dense/quant/LoRA dispatch."""
+    from distributed_lion_tpu.models.lora import lora_matmul
+
+    return lora_matmul(x, w)
+
+
 def _mlp(x, p, tp_axis=None):
     if tp_axis is not None:
         x = copy_to_tp_region(x, tp_axis)
-    h = x @ p["fc"].astype(x.dtype) + p["fc_b"].astype(x.dtype)
+    h = _proj(x, p["fc"]) + p["fc_b"].astype(x.dtype)
     h = jax.nn.gelu(h, approximate=True)
-    out = h @ p["proj"].astype(x.dtype)
+    out = _proj(h, p["proj"])
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out + p["proj_b"].astype(x.dtype)
@@ -367,10 +374,7 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos):
     the whole (masked) cache."""
     B, S, _ = x.shape
     H, hd = cfg.n_head, cfg.head_dim
-    qkv = jnp.einsum(
-        "btd,dce->btce", x, p["qkv"].astype(x.dtype),
-        preferred_element_type=jnp.float32,
-    ).astype(x.dtype) + p["qkv_b"].astype(x.dtype)
+    qkv = _qkv_project(x, p["qkv"]) + p["qkv_b"].astype(x.dtype)
     q, k, v = (qkv[:, :, i].reshape(B, S, H, hd).transpose(0, 2, 1, 3) for i in range(3))
     k_cache = lax.dynamic_update_slice_in_dim(c["k"], k.astype(c["k"].dtype), pos, axis=2)
     v_cache = lax.dynamic_update_slice_in_dim(c["v"], v.astype(c["v"].dtype), pos, axis=2)
@@ -383,7 +387,7 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos):
     out = jnp.einsum("bhst,bhtd->bhsd", probs, v_cache,
                      preferred_element_type=jnp.float32).astype(x.dtype)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
-    out = out @ p["proj"].astype(x.dtype) + p["proj_b"].astype(x.dtype)
+    out = _proj(out, p["proj"]) + p["proj_b"].astype(x.dtype)
     return out, {"k": k_cache, "v": v_cache}
 
 
@@ -405,8 +409,13 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
 
             B2, S2, D2 = x.shape
             h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
+            # single-token decode steps (S=1) get no-drop capacity (a cap of
+            # ~B*1.25/E would drop colliding tokens systematically); prefill
+            # keeps the training capacity bound — cap=n there would size
+            # every expert's buffer to the full prompt (E x the memory)
             y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                           axis_name=None, capacity_override=B2 * S2)
+                           axis_name=None,
+                           capacity_override=B2 * S2 if S2 == 1 else None)
             x = x + y.reshape(B2, S2, D2)
         else:
             x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])

@@ -1,0 +1,102 @@
+"""GPT-2 byte-level BPE (VERDICT r1 item 7).
+
+The strongest offline compatibility check available: train a vocabulary
+with our trainer, save it in the published vocab.json/merges.txt format,
+load THE SAME FILES with ``transformers.GPT2Tokenizer`` (the reference's
+tokenizer class, run_clm.py:398-423), and demand token-for-token identical
+encodings. That pins the byte↔unicode table, the pre-tokenization regex,
+and the merge procedure — so the real GPT-2 files are a drop-in for the
+true 50257 vocabulary.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.data.bpe import (
+    BPETokenizer,
+    bytes_to_unicode,
+    train_bpe,
+    unicode_to_bytes,
+)
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog. " * 20,
+    "Distributed Lion votes with one bit per parameter, per worker. " * 20,
+    "Pack my box with five dozen liquor jugs — naturally! " * 20,
+    "números, façade, naïve, 北京, emoji 🦁 and tabs\tand\nnewlines. " * 10,
+]
+HELD_OUT = (
+    "A naïve fox votes 42 times\nwith one-bit ballots — quick! 北京 🦁 "
+    "jugs over the lazy parameter."
+)
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return train_bpe(CORPUS, vocab_size=600)
+
+
+def test_byte_unicode_table_bijection():
+    b2u = bytes_to_unicode()
+    assert len(b2u) == 256
+    assert len(set(b2u.values())) == 256
+    u2b = unicode_to_bytes()
+    assert all(u2b[v] == k for k, v in b2u.items())
+
+
+def test_roundtrip(tok):
+    for text in CORPUS + [HELD_OUT]:
+        ids = tok.encode(text)
+        assert tok.decode(ids) == text
+    assert tok.decode(tok.encode(HELD_OUT, add_bos=True, add_eos=True)) == HELD_OUT
+
+
+def test_compression(tok):
+    ids = tok.encode(CORPUS[0])
+    assert len(ids) < len(CORPUS[0].encode("utf-8")) * 0.6  # beats bytes
+
+
+def test_save_load_identical(tok, tmp_path):
+    tok.save(str(tmp_path))
+    rt = BPETokenizer.load(str(tmp_path))
+    assert rt.vocab == tok.vocab
+    assert rt.encode(HELD_OUT) == tok.encode(HELD_OUT)
+
+
+def test_matches_hf_gpt2_tokenizer(tok, tmp_path):
+    """Our files + our encoder == transformers' GPT2Tokenizer on the same
+    files: exact algorithm/format compatibility."""
+    transformers = pytest.importorskip("transformers")
+    tok.save(str(tmp_path))
+    hf = transformers.GPT2Tokenizer(
+        vocab_file=str(tmp_path / "vocab.json"),
+        merges_file=str(tmp_path / "merges.txt"),
+    )
+    for text in [HELD_OUT] + CORPUS:
+        ours = tok.encode(text)
+        theirs = hf.encode(text)
+        assert ours == theirs, (text[:40], ours[:10], theirs[:10])
+
+
+def test_load_tokenizer_dispatch(tok, tmp_path):
+    from distributed_lion_tpu.data.tokenizer import load_tokenizer
+
+    tok.save(str(tmp_path))
+    t1 = load_tokenizer(f"bpe:{tmp_path}")
+    t2 = load_tokenizer(str(tmp_path))  # auto-detect vocab.json+merges.txt
+    assert t1.encode(HELD_OUT) == t2.encode(HELD_OUT) == tok.encode(HELD_OUT)
+    fallback = load_tokenizer(None)
+    assert fallback.vocab_size == 259
+
+
+def test_text_pipeline_with_bpe(tok, tmp_path):
+    """run_clm's text: data path tokenizes with the trained BPE."""
+    from distributed_lion_tpu.data.sources import tokens_from_text_files
+
+    tok.save(str(tmp_path / "tok"))
+    corpus_file = tmp_path / "corpus.txt"
+    corpus_file.write_text(" ".join(CORPUS), encoding="utf-8")
+    blocks = tokens_from_text_files([str(corpus_file)], block_size=32,
+                                    tokenizer_name=f"bpe:{tmp_path / 'tok'}")
+    assert len(blocks) > 0 and blocks.dtype == np.int32 or blocks.dtype == np.uint16
+    assert int(np.asarray(blocks).max()) < tok.vocab_size
