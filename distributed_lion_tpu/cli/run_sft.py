@@ -62,8 +62,6 @@ def main(argv=None):
             "reference (sft_llama2.py:56-59); note this framework remats every "
             "block regardless, so the memory benefit is already in place"
         )
-    if not script_args.packing:
-        raise NotImplementedError("only packed SFT is implemented (the reference's default path)")
 
     import jax
     import jax.numpy as jnp
@@ -139,13 +137,20 @@ def main(argv=None):
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
     print(f"[run_sft] LoRA adapters: {len(adapters)} sites, {n_adapter/1e3:.1f}k trainable params")
 
+    from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+
+    def _split_batch(batch):
+        # packed: plain [B, T] token array; non-packed: {"tokens", "mask"}
+        if isinstance(batch, dict):
+            return batch["tokens"], batch["mask"]
+        return batch, None
+
     tp = train_cfg.tensor_parallel
     if tp > 1:
         # frozen base sharded over the tensor axis, threaded through the
         # train step as a live argument; adapters shard with their targets
         # (models/lora.lora_adapter_specs), replicated factors get the
         # copy_to_tp_region gradient boundary inside apply_adapters.
-        from distributed_lion_tpu.models.loss import clm_loss_and_metrics
         from distributed_lion_tpu.models.lora import apply_adapters, lora_adapter_specs
         from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
         from distributed_lion_tpu.parallel.tensor_parallel import (
@@ -158,10 +163,11 @@ def main(argv=None):
         adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
 
         def loss_fn(params, frozen, batch, dropout_key):
+            tokens, mask = _split_batch(batch)
             effective = apply_adapters(frozen, params, lora_cfg,
                                        tp_axis=TENSOR_AXIS, base_specs=base_specs)
-            logits = llama_apply(effective, batch, model_cfg, tp_axis=TENSOR_AXIS)
-            return clm_loss_and_metrics(logits, batch)
+            logits = llama_apply(effective, tokens, model_cfg, tp_axis=TENSOR_AXIS)
+            return clm_loss_and_metrics(logits, tokens, mask)
 
         trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                           param_specs=adapter_specs, loss_fn=loss_fn,
@@ -170,27 +176,55 @@ def main(argv=None):
         apply_fn = lora_apply_fn(
             lambda p, t, key=None: llama_apply(p, t, model_cfg), base_params, lora_cfg
         )
-        trainer = Trainer(train_cfg, mesh, lambda p, t, key: apply_fn(p, t), adapters)
 
-    def batches():
-        gen = constant_length_batches(
-            train, tok, script_args.seq_length, infinite=True, chars_per_token=ratio
-        )
-        gb = trainer.global_train_batch()
-        while True:
-            yield np.stack([next(gen) for _ in range(gb)])
+        def loss_fn(params, batch, dropout_key):
+            tokens, mask = _split_batch(batch)
+            return clm_loss_and_metrics(apply_fn(params, tokens), tokens, mask)
 
-    eval_blocks = None
-    if valid:
-        ev = constant_length_batches(
-            valid, tok, script_args.seq_length, infinite=False, chars_per_token=ratio
+        trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                          loss_fn=loss_fn)
+
+    if script_args.packing:
+        def batches():
+            gen = constant_length_batches(
+                train, tok, script_args.seq_length, infinite=True,
+                chars_per_token=ratio,
+            )
+            gb = trainer.global_train_batch()
+            while True:
+                yield np.stack([next(gen) for _ in range(gb)])
+
+        train_iter = batches()
+        eval_blocks = None
+        if valid:
+            rows = list(constant_length_batches(
+                valid, tok, script_args.seq_length, infinite=False,
+                chars_per_token=ratio,
+            ))
+            if rows:
+                eval_blocks = np.stack(rows)
+    else:
+        # non-packed: one example per row, padded + loss-masked, optionally
+        # length-grouped (the reference base trainer's alternative to
+        # ConstantLengthDataset, sft_llama2.py:53-54)
+        from distributed_lion_tpu.data.sft import padded_batch_iterator, padded_examples
+
+        tr_tokens, tr_mask = padded_examples(
+            train, tok, script_args.seq_length,
+            group_by_length=script_args.group_by_length,
         )
-        rows = list(ev)
-        if rows:
-            eval_blocks = np.stack(rows)
+        train_iter = padded_batch_iterator(
+            tr_tokens, tr_mask, trainer.global_train_batch(),
+            seed=train_cfg.seed,
+            length_grouped=script_args.group_by_length,
+        )
+        eval_blocks = None
+        if valid:
+            ev_tokens, ev_mask = padded_examples(valid, tok, script_args.seq_length)
+            eval_blocks = {"tokens": ev_tokens, "mask": ev_mask}
 
     try:
-        trainer.train(batches(), eval_blocks=eval_blocks)
+        trainer.train(train_iter, eval_blocks=eval_blocks)
         if eval_blocks is not None:
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:

@@ -67,24 +67,44 @@ class NativeTokenLoader:
         prefetch_depth: int = 4,
         epochs: int | None = None,
         block_range: tuple[int, int] | None = None,
-    ) -> Iterator[np.ndarray]:
-        """Start the prefetch thread and yield [global_batch, block] int32
-        batches. ``epochs=None`` cycles forever (step-based training);
+    ) -> "_NativeBatches":
+        """Return a deferred-start batch iterator ([global_batch, block]
+        int32). The C++ prefetch thread launches on the first ``next()``, so
+        a ``skip(n)`` call before that (checkpoint-resume seek) is forwarded
+        to the native sampler — skipped epochs never draw their shuffle and
+        skipped batches never read data. ``epochs=None`` cycles forever;
         ``block_range=(lo, hi)`` samples only that half-open block range
         (validation hold-out)."""
-        if self._batch is not None:
-            raise RuntimeError("loader already started")
+        # eager validation (dl_start itself is deferred to the first next(),
+        # and only a successful dl_start marks the loader started — an
+        # unconsumed/failed iterator never wedges it)
+        lo, hi = block_range if block_range is not None else (0, 0)
+        if hi <= 0:
+            hi = len(self)
+        if lo < 0 or lo >= hi or hi > len(self):
+            raise RuntimeError(f"invalid sample range [{lo}, {hi})")
+        if global_batch <= 0 or global_batch > hi - lo:
+            raise RuntimeError(
+                f"global_batch {global_batch} must be in [1, {hi - lo}]")
+        return _NativeBatches(
+            self, global_batch, seed=seed, shuffle=shuffle,
+            prefetch_depth=prefetch_depth, epochs=epochs,
+            block_range=block_range,
+        )
+
+    def _start(self, global_batch: int, *, seed, shuffle, prefetch_depth,
+               epochs, block_range, skip_batches: int) -> Iterator[np.ndarray]:
         lo, hi = block_range if block_range is not None else (0, 0)
         ok = self._lib.dl_start(
             self._h, global_batch, seed, int(shuffle), prefetch_depth,
-            0 if epochs is None else int(epochs), lo, hi,
+            0 if epochs is None else int(epochs), lo, hi, int(skip_batches),
         )
         if not ok:
             raise RuntimeError(self._lib.dl_last_error().decode())
         self._batch = int(global_batch)
 
         def gen():
-            out = np.empty((self._batch, self.block_size), np.int32)
+            out = np.empty((global_batch, self.block_size), np.int32)
             ptr = out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             while self._h and self._lib.dl_next(self._h, ptr):
                 yield out.copy()
@@ -101,6 +121,33 @@ class NativeTokenLoader:
             self.close()
         except Exception:
             pass
+
+
+class _NativeBatches:
+    """Deferred-start iterator over a :class:`NativeTokenLoader`: records
+    ``skip(n)`` calls until the first ``next()``, then starts the C++
+    prefetch thread with the accumulated offset."""
+
+    def __init__(self, loader: NativeTokenLoader, global_batch: int, **kwargs):
+        self._loader = loader
+        self._gb = global_batch
+        self._kwargs = kwargs
+        self._skip = 0
+        self._gen = None
+
+    def skip(self, n: int) -> None:
+        if self._gen is not None:
+            raise RuntimeError("cannot skip after iteration started")
+        self._skip += int(n)
+
+    def __iter__(self) -> "_NativeBatches":
+        return self
+
+    def __next__(self) -> np.ndarray:
+        if self._gen is None:
+            self._gen = self._loader._start(self._gb, skip_batches=self._skip,
+                                            **self._kwargs)
+        return next(self._gen)
 
 
 def native_available() -> bool:

@@ -72,6 +72,79 @@ def synthetic_qa_pairs(n: int, seed: int = 0) -> List[dict]:
     return out
 
 
+def padded_examples(
+    samples: Sequence[dict],
+    tokenizer,
+    seq_length: int,
+    *,
+    format_fn=prepare_sample_text,
+    group_by_length: bool = False,
+) -> tuple:
+    """Non-packed SFT rows: one example per row, truncated/EOS-terminated/
+    padded to ``seq_length`` — the reference base-trainer's alternative to
+    ConstantLengthDataset packing (sft_llama2.py:53-54 implies it via the
+    packing×group_by_length exclusivity guard). Returns
+    ``(tokens [n, seq] int32, mask [n, seq] f32)`` with the mask covering
+    real tokens only, so padding never contributes loss.
+
+    ``group_by_length`` sorts rows by true token length (HF Trainer's
+    ``group_by_length``: neighbors in a batch have similar lengths →
+    minimal padding waste)."""
+    eos = getattr(tokenizer, "eos_id", 0)
+    pad = getattr(tokenizer, "pad_id", eos)
+    rows = []
+    for s in samples:
+        ids = tokenizer.encode(format_fn(s)) + [eos]
+        rows.append(ids[:seq_length])
+    if not rows:
+        raise ValueError("no SFT samples")
+    if group_by_length:
+        rows.sort(key=len)
+    tokens = np.full((len(rows), seq_length), pad, np.int32)
+    mask = np.zeros((len(rows), seq_length), np.float32)
+    for i, ids in enumerate(rows):
+        tokens[i, : len(ids)] = ids
+        mask[i, : len(ids)] = 1.0
+    return tokens, mask
+
+
+def padded_batch_iterator(
+    tokens: np.ndarray,
+    mask: np.ndarray,
+    global_batch: int,
+    *,
+    seed: int = 0,
+    shuffle: bool = True,
+    length_grouped: bool = False,
+) -> Iterator[dict]:
+    """Cycle {"tokens", "mask"} batches forever, reshuffled per epoch.
+
+    ``length_grouped=False`` permutes EXAMPLES each epoch (HF RandomSampler:
+    fresh batch composition every epoch); ``length_grouped=True`` keeps rows
+    in their length-sorted order and permutes whole BATCHES (HF's
+    LengthGroupedSampler: neighbors stay similar-length, padding waste stays
+    minimal)."""
+    n = len(tokens)
+    if n < global_batch:
+        raise ValueError(f"{n} examples < global batch {global_batch}")
+    rng = np.random.default_rng(seed)
+    n_batches = n // global_batch
+    while True:
+        if length_grouped:
+            starts = (rng.permutation(n_batches) if shuffle
+                      else np.arange(n_batches)) * global_batch
+            idx_batches = [np.arange(s, s + global_batch) for s in starts]
+        else:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            idx_batches = [order[i * global_batch : (i + 1) * global_batch]
+                           for i in range(n_batches)]
+        for idx in idx_batches:
+            yield {
+                "tokens": np.ascontiguousarray(tokens[idx]),
+                "mask": np.ascontiguousarray(mask[idx]),
+            }
+
+
 def constant_length_batches(
     samples: Iterable[dict],
     tokenizer,

@@ -81,7 +81,7 @@ def load() -> ctypes.CDLL:
         lib.dl_start.argtypes = [
             ctypes.c_void_p, ctypes.c_longlong, ctypes.c_ulonglong,
             ctypes.c_int, ctypes.c_int, ctypes.c_longlong,
-            ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
         ]
         lib.dl_next.restype = ctypes.c_int
         lib.dl_next.argtypes = [ctypes.c_void_p, c_i32p]

@@ -55,6 +55,9 @@ struct Loader {
   bool shuffle = true;
   int64_t epochs = 0;  // <=0: infinite
   int64_t lo = 0, hi = 0;  // half-open sample range [lo, hi)
+  int64_t skip0 = 0;       // batches to fast-forward at start (resume seek:
+                           // skipped epochs never even draw their shuffle,
+                           // skipped batches never read data)
   size_t depth = 4;
   std::thread worker;
   std::mutex mu;
@@ -103,15 +106,23 @@ struct Loader {
 
   void producer() {
     const int64_t n = hi - lo;
+    const int64_t bpe = n / batch;  // batches per epoch (drop-last)
+    int64_t skip = skip0;
     std::vector<int64_t> order(static_cast<size_t>(n));
     for (int64_t e = 0; epochs <= 0 || e < epochs; ++e) {
+      if (skip >= bpe && bpe > 0) {
+        skip -= bpe;  // whole epoch skipped: no shuffle draw, no reads
+        continue;
+      }
       for (int64_t i = 0; i < n; ++i) order[i] = lo + i;
       if (shuffle) {
         std::mt19937_64 rng(seed + 0x9e3779b97f4a7c15ULL * (uint64_t)(e + 1));
         std::shuffle(order.begin(), order.end(), rng);
       }
+      const int64_t i0 = skip * batch;
+      skip = 0;
       // drop-last batching, matching sources.batch_iterator
-      for (int64_t i = 0; i + batch <= n; i += batch) {
+      for (int64_t i = i0; i + batch <= n; i += batch) {
         std::vector<int32_t> buf(static_cast<size_t>(batch * block));
         for (int64_t b = 0; b < batch; ++b)
           read_block(order[i + b], buf.data() + b * block);
@@ -203,10 +214,11 @@ int dl_read_block(void* h, long long idx, int32_t* out) {
 // Start the prefetch thread: [global_batch, block] int32 batches, shuffled
 // per epoch with `seed`, drop-last; epochs<=0 cycles forever. Sampling is
 // restricted to blocks [lo, hi) (hi<=0 → num_blocks), so callers can hold
-// out a validation range from the same shards.
+// out a validation range from the same shards. skip_batches fast-forwards
+// the deterministic stream by index arithmetic (checkpoint-resume seek).
 int dl_start(void* h, long long global_batch, unsigned long long seed,
              int shuffle, int prefetch_depth, long long epochs,
-             long long lo, long long hi) {
+             long long lo, long long hi, long long skip_batches) {
   auto* L = static_cast<Loader*>(h);
   if (L->started) {
     set_error("loader already started");
@@ -228,6 +240,7 @@ int dl_start(void* h, long long global_batch, unsigned long long seed,
   L->shuffle = shuffle != 0;
   L->depth = prefetch_depth > 0 ? static_cast<size_t>(prefetch_depth) : 1;
   L->epochs = epochs;
+  L->skip0 = skip_batches > 0 ? skip_batches : 0;
   L->stop.store(false);
   L->finished = false;
   L->started = true;

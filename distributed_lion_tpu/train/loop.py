@@ -540,10 +540,15 @@ class Trainer:
         tokens_per_step = self.global_train_batch() * cfg.block_size
         # After resume, fast-forward the (deterministically seeded) data
         # iterator past the batches the checkpointed run consumed, so a
-        # resumed run sees the same data a continuous run would.
+        # resumed run sees the same data a continuous run would. Seekable
+        # iterators (data.sources.BatchIterator, the native loader) skip by
+        # index arithmetic — no data reads; plain generators are replayed.
         if self._resume_skip_batches:
-            for _ in range(self._resume_skip_batches):
-                next(train_iter)
+            if hasattr(train_iter, "skip"):
+                train_iter.skip(self._resume_skip_batches)
+            else:
+                for _ in range(self._resume_skip_batches):
+                    next(train_iter)
             self._resume_skip_batches = 0
         t_last, s_last = time.time(), self.step_count
         chunk_spec = NamedSharding(self.mesh, P(None, *self.batch_spec))

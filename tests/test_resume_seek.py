@@ -1,0 +1,111 @@
+"""Checkpoint-resume data seek (VERDICT r1 weak #7): fast-forward by index
+arithmetic, not by replaying every consumed batch through memory.
+
+Invariant: skip(k) then next() on a fresh iterator yields exactly what the
+(k+1)-th next() yields — across epoch boundaries, for both the Python
+BatchIterator and the C++ native loader."""
+
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.data.sources import BatchIterator, batch_iterator
+
+
+def _blocks(n=23, t=8):
+    return (np.arange(n * t).reshape(n, t) % 251).astype(np.int32)
+
+
+@pytest.mark.parametrize("k", [0, 1, 3, 5, 11, 30])
+def test_python_skip_matches_replay(k):
+    blocks = _blocks()
+    ref = batch_iterator(blocks, 4, seed=9)
+    for _ in range(k):
+        next(ref)
+    want = next(ref)
+
+    it = batch_iterator(blocks, 4, seed=9)
+    it.skip(k)
+    np.testing.assert_array_equal(next(it), want)
+
+
+def test_python_skip_past_finite_epochs():
+    it = BatchIterator(_blocks(), 4, seed=0, epochs=2)
+    it.skip(10_000)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_trainer_uses_seek(tmp_path, monkeypatch):
+    """Resume goes through skip() (no data replay) and continues the same
+    data stream: train 4 steps continuously vs 2 + resume + 2."""
+    import jax
+
+    from distributed_lion_tpu.data.sources import synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    mesh = make_mesh(data=8)
+    model = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
+
+    def cfg(outdir, steps):
+        return TrainConfig(
+            lion=True, async_grad=True, learning_rate=1e-3, warmup_steps=1,
+            max_steps=steps, per_device_train_batch_size=1,
+            gradient_accumulation_steps=1, block_size=32, logging_steps=1,
+            save_steps=2, output_dir=outdir, seed=5,
+        )
+
+    # continuous 4-step run
+    t0 = Trainer.for_gpt2(cfg(None, 4), mesh, model, seed=3)
+    h0 = t0.train(batch_iterator(blocks, t0.global_train_batch(), seed=5))
+    ref_losses = [h["loss"] for h in h0 if "loss" in h]
+    t0.close()
+
+    # 2 steps, checkpoint, then resume for 2 more — with replay forbidden
+    out = str(tmp_path / "run")
+    t1 = Trainer.for_gpt2(cfg(out, 2), mesh, model, seed=3)
+    t1.train(batch_iterator(blocks, t1.global_train_batch(), seed=5))
+    t1.save()
+    t1.close()
+
+    t2 = Trainer.for_gpt2(cfg(out, 4), mesh, model, seed=3)
+    assert t2.step_count == 2
+    it = batch_iterator(blocks, t2.global_train_batch(), seed=5)
+    orig_next = type(it).__next__
+    reads = {"n": 0}
+
+    def counting_next(self):
+        reads["n"] += 1
+        return orig_next(self)
+
+    monkeypatch.setattr(type(it), "__next__", counting_next)
+    h2 = t2.train(it)
+    resumed_losses = [h["loss"] for h in h2 if "loss" in h]
+    t2.close()
+    assert reads["n"] == 2  # ONLY the 2 live batches; skip() read nothing
+    np.testing.assert_allclose(resumed_losses, ref_losses[2:], rtol=1e-5, atol=1e-6)
+
+
+def test_native_skip_matches_replay(tmp_path):
+    from distributed_lion_tpu.data.native_loader import NativeTokenLoader, native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 60000, size=23 * 8, dtype=np.uint16)
+    shard = tmp_path / "s.bin"
+    tokens.tofile(shard)
+
+    ref_loader = NativeTokenLoader([shard], block_size=8)
+    ref = ref_loader.batches(4, seed=9)
+    batches = [next(ref) for _ in range(8)]  # crosses the 5-batch epoch edge
+    ref_loader.close()
+
+    for k in (0, 1, 4, 7):
+        loader = NativeTokenLoader([shard], block_size=8)
+        it = loader.batches(4, seed=9)
+        it.skip(k)
+        np.testing.assert_array_equal(next(it), batches[k], err_msg=f"k={k}")
+        loader.close()
