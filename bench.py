@@ -122,14 +122,14 @@ def run_inner() -> None:
 
     # warmup/compile + honest sync
     trainer.params, trainer.state, m = trainer._train_chunk(
-        trainer.params, trainer.state, batches, base_key
+        trainer.params, trainer.state, trainer._frozen_arg(), batches, base_key
     )
     _ = float(np.asarray(jax.device_get(m["loss"])))
 
     t0 = time.perf_counter()
     for _ in range(TIMED_CALLS):
         trainer.params, trainer.state, m = trainer._train_chunk(
-            trainer.params, trainer.state, batches, base_key
+            trainer.params, trainer.state, trainer._frozen_arg(), batches, base_key
         )
     _ = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
@@ -141,7 +141,7 @@ def run_inner() -> None:
     # Model FLOPs per token: 6N (fwd+bwd matmuls) + attention 12*L*d*T.
     flops_per_token = (
         6.0 * n_params
-        + 12.0 * model_cfg.n_layer * model_cfg.n_embd * cfg.block_size
+        + 12.0 * model_cfg.n_layer * model_cfg.d_model * cfg.block_size
     )
     peak = _peak_flops_per_chip(device_kind) if backend == "tpu" else None
     mfu = (per_chip * flops_per_token / peak) if peak else None

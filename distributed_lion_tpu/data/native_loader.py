@@ -41,7 +41,6 @@ class NativeTokenLoader:
         self._h = self._lib.dl_open(arr, len(enc), dtype_bytes, self.block_size)
         if not self._h:
             raise OSError(self._lib.dl_last_error().decode())
-        self._batch = None
 
     def __len__(self) -> int:
         return int(self._lib.dl_num_blocks(self._h))
@@ -101,7 +100,6 @@ class NativeTokenLoader:
         )
         if not ok:
             raise RuntimeError(self._lib.dl_last_error().decode())
-        self._batch = int(global_batch)
 
         def gen():
             out = np.empty((global_batch, self.block_size), np.int32)

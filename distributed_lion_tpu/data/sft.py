@@ -131,8 +131,13 @@ def padded_batch_iterator(
     n_batches = n // global_batch
     while True:
         if length_grouped:
+            # per-epoch random offset slides the drop-last residue window, so
+            # with n % global_batch != 0 the longest rows are not permanently
+            # excluded (HF's LengthGroupedSampler re-forms groups per epoch)
+            resid = n - n_batches * global_batch
+            off = int(rng.integers(0, resid + 1)) if (shuffle and resid) else 0
             starts = (rng.permutation(n_batches) if shuffle
-                      else np.arange(n_batches)) * global_batch
+                      else np.arange(n_batches)) * global_batch + off
             idx_batches = [np.arange(s, s + global_batch) for s in starts]
         else:
             order = rng.permutation(n) if shuffle else np.arange(n)

@@ -42,7 +42,7 @@ def main(batch_per_dev=8, remat=True):
                            NamedSharding(mesh, P("data")))
     key = jax.random.key(0)
     trainer.params, trainer.state, m = trainer._train_step(
-        trainer.params, trainer.state, batch, key)
+        trainer.params, trainer.state, trainer._frozen_arg(), batch, key)
     print("warmup loss:", float(np.asarray(jax.device_get(m["loss"]))), flush=True)
 
     for steps, sync in [(5, "get"), (20, "get"), (50, "get"), (20, "block"),
@@ -50,7 +50,7 @@ def main(batch_per_dev=8, remat=True):
         t0 = time.perf_counter()
         for _ in range(steps):
             trainer.params, trainer.state, m = trainer._train_step(
-                trainer.params, trainer.state, batch, key)
+                trainer.params, trainer.state, trainer._frozen_arg(), batch, key)
             if sync == "get_each":
                 _ = float(np.asarray(jax.device_get(m["loss"])))
         if sync == "block":

@@ -57,13 +57,13 @@ def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
     )
     key = jax.random.key(0)
     trainer.params, trainer.state, m = trainer._train_chunk(
-        trainer.params, trainer.state, batches, key
+        trainer.params, trainer.state, trainer._frozen_arg(), batches, key
     )
     _ = float(np.asarray(jax.device_get(m["loss"])))  # warmup + honest sync
     t0 = time.perf_counter()
     for _ in range(N_CHUNKS):
         trainer.params, trainer.state, m = trainer._train_chunk(
-            trainer.params, trainer.state, batches, key
+            trainer.params, trainer.state, trainer._frozen_arg(), batches, key
         )
     final_loss = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
