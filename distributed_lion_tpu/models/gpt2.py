@@ -267,7 +267,7 @@ def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
 _moe_block_remat = partial(jax.checkpoint, static_argnums=(3, 4))(_moe_block)
 
 
-def gpt2_apply(
+def gpt2_hidden(
     params: dict,
     tokens: jnp.ndarray,
     cfg: GPT2Config,
@@ -276,17 +276,11 @@ def gpt2_apply(
     tp_axis: Optional[str] = None,
     seq_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
-    return_aux: bool = False,
-) -> jnp.ndarray:
-    """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
-
-    Output projection is tied to the input embedding (GPT-2 weight tying).
-    With ``tp_axis`` (inside shard_map), attention/MLP weights are expected
-    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``. With
-    ``seq_axis`` (sequence parallelism), ``tokens`` is this device's
-    contiguous chunk of the full sequence: positions offset by the shard
-    index, attention rings over the axis, per-shard dropout keys.
-    """
+) -> tuple:
+    """Backbone forward: tokens [B, T] → (final hidden [B, T, d] after ln_f,
+    MoE aux loss scalar). The tied-logits head is applied by
+    :func:`gpt2_apply`, or streamed chunk-wise by ops/xent for the
+    memory-lean loss path."""
     B, T = tokens.shape
     if seq_axis is None:
         if T > cfg.n_ctx:
@@ -316,7 +310,33 @@ def gpt2_apply(
             aux_total = aux_total + aux
         else:
             x = block(x, p, k, cfg, tp_axis, seq_axis)
-    x = _layer_norm(x, params["ln_f"])
+    return _layer_norm(x, params["ln_f"]), aux_total
+
+
+def gpt2_apply(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: GPT2Config,
+    *,
+    dropout_key: Optional[jax.Array] = None,
+    tp_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
+    expert_axis: Optional[str] = None,
+    return_aux: bool = False,
+) -> jnp.ndarray:
+    """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
+
+    Output projection is tied to the input embedding (GPT-2 weight tying).
+    With ``tp_axis`` (inside shard_map), attention/MLP weights are expected
+    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``. With
+    ``seq_axis`` (sequence parallelism), ``tokens`` is this device's
+    contiguous chunk of the full sequence: positions offset by the shard
+    index, attention rings over the axis, per-shard dropout keys.
+    """
+    x, aux_total = gpt2_hidden(
+        params, tokens, cfg, dropout_key=dropout_key, tp_axis=tp_axis,
+        seq_axis=seq_axis, expert_axis=expert_axis,
+    )
     logits = jnp.einsum(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),
         preferred_element_type=jnp.float32,

@@ -1,0 +1,126 @@
+"""Chunked-vocab softmax cross entropy: CLM loss without materializing the
+full [B, T, V] f32 logits.
+
+At GPT-2 124M flagship shapes the logits tensor is the single largest
+activation — microbatch 4 × T 1024 × V 50257 in f32 is ~823 MB, written to
+and re-read from HBM around the softmax (and again in backward). Here the
+tied-embedding projection, the streaming logsumexp, the label gather, and
+the argmax (for the accuracy metric) run per vocab CHUNK inside one
+``lax.scan`` whose body is ``jax.checkpoint``-ed: forward keeps only the
+running (max, sumexp, label-logit, argmax) carries — peak logits memory
+drops to [N, V/chunks] — and backward recomputes each chunk's logits from
+(hidden, emb_chunk) instead of loading stored ones.
+
+Exact same math as ``log_softmax`` + gather (pinned to the dense path by
+tests/test_xent.py, gradients included); only the schedule differs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def chunked_softmax_xent(
+    hidden: jnp.ndarray,
+    emb: jnp.ndarray,
+    labels: jnp.ndarray,
+    n_chunks: int = 8,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Streaming cross entropy against a tied embedding.
+
+    Args:
+        hidden: [N, d] final hidden states (any float dtype; matmul f32-acc).
+        emb: [V, d] tied embedding / LM head (rows are vocab entries).
+        labels: [N] int32 target ids.
+        n_chunks: vocab chunks; V is zero-padded up to a multiple (padded
+            rows score -inf-ish via masking, never win argmax or the lse).
+
+    Returns:
+        (nll [N] f32, correct [N] bool) — per-position negative log
+        likelihood and argmax-equals-label (for the accuracy metric).
+    """
+    n, d = hidden.shape
+    v = emb.shape[0]
+    vc = -(-v // n_chunks)
+    pad = n_chunks * vc - v
+    if pad:
+        emb = jnp.concatenate([emb, jnp.zeros((pad, d), emb.dtype)], axis=0)
+    emb_chunks = emb.reshape(n_chunks, vc, d)
+    valid_tail = v - (n_chunks - 1) * vc  # valid rows in the LAST chunk
+
+    @partial(jax.checkpoint, prevent_cse=False)
+    def body(carry, inp):
+        m, s, lab, best, besti = carry
+        ec, cidx = inp
+        logits = jnp.einsum("nd,vd->nv", hidden, ec.astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        # mask the zero-pad rows of the final chunk out of everything
+        n_valid = jnp.where(cidx == n_chunks - 1, valid_tail, vc)
+        col_ok = jnp.arange(vc) < n_valid
+        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+
+        cm = logits.max(-1)
+        new_m = jnp.maximum(m, cm)
+        # exp(-inf - finite) == 0 handles the all-masked-column case; the
+        # m carry starts at -inf so scale 0**... guard with where:
+        scale = jnp.where(jnp.isfinite(m), jnp.exp(m - new_m), 0.0)
+        add = jnp.exp(logits - new_m[:, None]).sum(-1)
+        s = s * scale + add
+
+        local = labels - cidx * vc
+        in_range = (local >= 0) & (local < n_valid)
+        gathered = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, vc - 1)[:, None], axis=-1
+        )[:, 0]
+        lab = lab + jnp.where(in_range, gathered, 0.0)
+
+        ci = logits.argmax(-1)
+        cv = logits.max(-1)
+        upd = cv > best
+        best = jnp.where(upd, cv, best)
+        besti = jnp.where(upd, ci + cidx * vc, besti)
+        return (new_m, s, lab, best, besti), None
+
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.int32),
+    )
+    (m, s, lab, _, besti), _ = lax.scan(
+        body, init, (emb_chunks, jnp.arange(n_chunks, dtype=jnp.int32))
+    )
+    lse = m + jnp.log(s)
+    nll = lse - lab
+    return nll, besti == labels
+
+
+def chunked_clm_loss_and_metrics(
+    hidden: jnp.ndarray,
+    emb: jnp.ndarray,
+    tokens: jnp.ndarray,
+    n_chunks: int = 8,
+    loss_mask: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Shift-by-one CLM loss from FINAL HIDDEN STATES (not logits) — the
+    chunked twin of models/loss.clm_loss_and_metrics, same return contract.
+
+    ``hidden`` [B, T, d]; positions 0..T-2 predict tokens 1..T-1.
+    """
+    b, t, d = hidden.shape
+    h = hidden[:, :-1].reshape(b * (t - 1), d)
+    labels = tokens[:, 1:].reshape(-1).astype(jnp.int32)
+    nll, correct = chunked_softmax_xent(h, emb, labels, n_chunks)
+    if loss_mask is None:
+        mask = jnp.ones_like(nll)
+    else:
+        mask = loss_mask[:, 1:].reshape(-1).astype(jnp.float32)
+    nmask = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / nmask
+    acc = (correct.astype(jnp.float32) * mask).sum() / nmask
+    return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}

@@ -83,6 +83,10 @@ class TrainConfig:
     # step, the BASELINE.md comm budget), stale elected signs applied
     # elsewhere (optim.distributed_lion).
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
+    vocab_chunks: int = 0  # > 0: chunked-vocab cross entropy (ops/xent) —
+    # the [B,T,V] f32 logits (the largest activation at GPT-2 124M: ~823MB
+    # per microbatch) are never materialized; streaming logsumexp over V/N
+    # chunks, chunk logits rematerialized in backward. Same math, less HBM.
     tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
                               # when building the mesh; net-new vs reference)
     seq_parallel: int = 1  # sequence/context mesh axis size: batches are
@@ -244,6 +248,16 @@ class Trainer:
                         f"{ax}-replication — one rank's moments would silently "
                         "win. Use pure data parallelism with ZeRO-1."
                     )
+        if (cfg.vocab_chunks > 0 and loss_fn is not None
+                and not getattr(loss_fn, "_vocab_chunked", False)):
+            # vocab_chunks is only consumed when THIS class builds the loss
+            # (for_gpt2's dense path); a caller-supplied loss would silently
+            # ignore it — e.g. run_sft/run_dpo, whose CLIs auto-expose the
+            # flag via TrainConfig.
+            raise NotImplementedError(
+                "--vocab_chunks is not wired into this entry point's loss "
+                "function (supported: run_clm's dense dp/tp path)"
+            )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         # number of ways batch ROWS (dim 0) are sharded: data alone normally;
         # data x expert under expert parallelism (tokens ride both axes)
@@ -712,6 +726,14 @@ class Trainer:
             f"{acct['bits_per_param_per_microbatch']:.2f} bits/param/microbatch)"
         )
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
+        if cfg.vocab_chunks > 0 and (
+            pp > 1 or model_cfg.moe_experts > 0
+            or dict(mesh.shape).get(SEQ_AXIS, 1) > 1
+        ):
+            raise NotImplementedError(
+                "--vocab_chunks is wired for the dense dp/tp path (those "
+                "branches carry their own loss functions); drop one"
+            )
         if pp > 1:
             from distributed_lion_tpu.models.gpt2_pipe import (
                 make_pipeline_loss,
@@ -843,6 +865,18 @@ class Trainer:
         def apply_fn(params, tokens, dropout_key):
             return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key,
                               tp_axis=tp_axis, seq_axis=seq_axis)
+
+        if cfg.vocab_chunks > 0 and loss_fn is None:
+            from distributed_lion_tpu.models.gpt2 import gpt2_hidden
+            from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
+
+            def loss_fn(params, batch, dropout_key):
+                hidden, _ = gpt2_hidden(params, batch, model_cfg,
+                                        dropout_key=dropout_key, tp_axis=tp_axis)
+                return chunked_clm_loss_and_metrics(
+                    hidden, params["wte"], batch, cfg.vocab_chunks)
+
+            loss_fn._vocab_chunked = True  # consumed; don't trip the guard
 
         return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs,
                        loss_fn=loss_fn, batch_spec=batch_spec)
