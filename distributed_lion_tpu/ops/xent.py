@@ -50,7 +50,6 @@ def chunked_softmax_xent(
     if pad:
         emb = jnp.concatenate([emb, jnp.zeros((pad, d), emb.dtype)], axis=0)
     emb_chunks = emb.reshape(n_chunks, vc, d)
-    valid_tail = v - (n_chunks - 1) * vc  # valid rows in the LAST chunk
 
     @partial(jax.checkpoint, prevent_cse=False)
     def body(carry, inp):
@@ -58,31 +57,31 @@ def chunked_softmax_xent(
         ec, cidx = inp
         logits = jnp.einsum("nd,vd->nv", hidden, ec.astype(hidden.dtype),
                             preferred_element_type=jnp.float32)
-        # mask the zero-pad rows of the final chunk out of everything
-        n_valid = jnp.where(cidx == n_chunks - 1, valid_tail, vc)
-        col_ok = jnp.arange(vc) < n_valid
+        # mask zero-pad vocab rows by GLOBAL index (padding can spill across
+        # several chunks when vc*n_chunks >> v), so phantom logit-0 columns
+        # never enter the lse, the label gather, or the argmax
+        col_ok = (cidx * vc + jnp.arange(vc)) < v
         logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
 
         cm = logits.max(-1)
         new_m = jnp.maximum(m, cm)
         # exp(-inf - finite) == 0 handles the all-masked-column case; the
-        # m carry starts at -inf so scale 0**... guard with where:
+        # m carry starts at -inf so guard its rescale with where:
         scale = jnp.where(jnp.isfinite(m), jnp.exp(m - new_m), 0.0)
-        add = jnp.exp(logits - new_m[:, None]).sum(-1)
+        add = jnp.where(jnp.isfinite(cm),
+                        jnp.exp(logits - new_m[:, None]).sum(-1), 0.0)
         s = s * scale + add
 
         local = labels - cidx * vc
-        in_range = (local >= 0) & (local < n_valid)
+        in_range = (local >= 0) & (local < vc)  # labels < v by contract
         gathered = jnp.take_along_axis(
             logits, jnp.clip(local, 0, vc - 1)[:, None], axis=-1
         )[:, 0]
         lab = lab + jnp.where(in_range, gathered, 0.0)
 
-        ci = logits.argmax(-1)
-        cv = logits.max(-1)
-        upd = cv > best
-        best = jnp.where(upd, cv, best)
-        besti = jnp.where(upd, ci + cidx * vc, besti)
+        upd = cm > best
+        best = jnp.where(upd, cm, best)
+        besti = jnp.where(upd, logits.argmax(-1) + cidx * vc, besti)
         return (new_m, s, lab, best, besti), None
 
     init = (

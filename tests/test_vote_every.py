@@ -149,3 +149,47 @@ def test_vote_every_trainer_converges(mesh8):
     losses = [h["loss"] for h in hist if "loss" in h]
     assert losses[-1] < losses[0] - 0.3, losses
     trainer.close()
+
+
+def test_vote_every_checkpoint_resume(tmp_path, mesh8):
+    """The packed elected-sign cache survives checkpoint/resume: a 2+2-step
+    resumed run equals a continuous 4-step run (same data stream)."""
+    from distributed_lion_tpu.data.sources import batch_iterator, synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    model = GPT2Config.tiny(compute_dtype=jnp.float32)
+    blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
+
+    def cfg(outdir, steps):
+        return TrainConfig(
+            lion=True, async_grad=True, wire="packed_a2a", vote_every=4,
+            learning_rate=1e-3, warmup_steps=1, max_steps=steps,
+            per_device_train_batch_size=1, gradient_accumulation_steps=1,
+            block_size=32, logging_steps=1, save_steps=2,
+            output_dir=outdir, seed=5,
+        )
+
+    t0 = Trainer.for_gpt2(cfg(None, 4), mesh8, model, seed=3)
+    h0 = t0.train(batch_iterator(blocks, t0.global_train_batch(), seed=5))
+    ref = [h["loss"] for h in h0 if "loss" in h]
+    params_ref = jax.tree.map(np.asarray, jax.device_get(t0.params))
+    t0.close()
+
+    out = str(tmp_path / "run")
+    t1 = Trainer.for_gpt2(cfg(out, 2), mesh8, model, seed=3)
+    t1.train(batch_iterator(blocks, t1.global_train_batch(), seed=5))
+    t1.save()
+    t1.close()
+
+    t2 = Trainer.for_gpt2(cfg(out, 4), mesh8, model, seed=3)
+    assert t2.step_count == 2
+    assert t2.state.elected is not None  # cache restored, not re-zeroed
+    h2 = t2.train(batch_iterator(blocks, t2.global_train_batch(), seed=5))
+    resumed = [h["loss"] for h in h2 if "loss" in h]
+    params_res = jax.tree.map(np.asarray, jax.device_get(t2.params))
+    t2.close()
+
+    np.testing.assert_allclose(resumed, ref[2:], rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(params_ref), jax.tree.leaves(params_res)):
+        np.testing.assert_array_equal(a, b)

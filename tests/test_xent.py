@@ -15,10 +15,14 @@ from distributed_lion_tpu.ops.xent import (
 )
 
 
-@pytest.mark.parametrize("n_chunks", [1, 3, 8])  # 3 → uneven chunks + pad
-def test_xent_matches_dense(n_chunks):
+@pytest.mark.parametrize("n_chunks,v", [
+    (1, 101), (3, 101), (8, 101),
+    (7, 10),   # padding spills across several chunks; some chunks all-pad
+    (16, 17),  # nearly every chunk is padding
+])
+def test_xent_matches_dense(n_chunks, v):
     rng = np.random.default_rng(0)
-    n, d, v = 17, 16, 101
+    n, d = 17, 16
     hidden = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
     emb = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
     labels = jnp.asarray(rng.integers(0, v, n), jnp.int32)
