@@ -75,7 +75,12 @@ def main(argv=None):
     )
     from distributed_lion_tpu.data.tokenizer import load_tokenizer
     from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
-    from distributed_lion_tpu.models.lora import LoraConfig, lora_apply_fn, lora_init, merge_lora
+    from distributed_lion_tpu.models.lora import (
+        LoraConfig,
+        apply_adapters,
+        lora_init,
+        merge_lora,
+    )
     from distributed_lion_tpu.ops.quant import quantize_tree
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import save_pytree
@@ -145,13 +150,33 @@ def main(argv=None):
             return batch["tokens"], batch["mask"]
         return batch, None
 
+    def _head_loss(effective, tokens, mask, tp_axis=None):
+        """Dense or chunked-vocab CLM loss over the (possibly adapted)
+        effective params — --vocab_chunks streams the lm_head projection
+        (ops/xent) so the [B,T,V] logits are never materialized (V is 32k
+        for Llama-2, 128k for Llama-3-class configs)."""
+        if train_cfg.vocab_chunks > 0:
+            from distributed_lion_tpu.models.llama import llama_hidden
+            from distributed_lion_tpu.ops.quant import maybe_dequant
+            from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
+
+            hidden = llama_hidden(effective, tokens, model_cfg, tp_axis=tp_axis)
+            # lm_head stays in its [d, V] matmul layout — ops/xent slices
+            # columns per chunk, no transposed copy of the head
+            emb = maybe_dequant(effective["lm_head"], model_cfg.compute_dtype)
+            return chunked_clm_loss_and_metrics(
+                hidden, emb, tokens, train_cfg.vocab_chunks, mask,
+                emb_layout="dv")
+        logits = llama_apply(effective, tokens, model_cfg, tp_axis=tp_axis)
+        return clm_loss_and_metrics(logits, tokens, mask)
+
     tp = train_cfg.tensor_parallel
     if tp > 1:
         # frozen base sharded over the tensor axis, threaded through the
         # train step as a live argument; adapters shard with their targets
         # (models/lora.lora_adapter_specs), replicated factors get the
         # copy_to_tp_region gradient boundary inside apply_adapters.
-        from distributed_lion_tpu.models.lora import apply_adapters, lora_adapter_specs
+        from distributed_lion_tpu.models.lora import lora_adapter_specs
         from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
         from distributed_lion_tpu.parallel.tensor_parallel import (
             llama_param_specs,
@@ -166,21 +191,19 @@ def main(argv=None):
             tokens, mask = _split_batch(batch)
             effective = apply_adapters(frozen, params, lora_cfg,
                                        tp_axis=TENSOR_AXIS, base_specs=base_specs)
-            logits = llama_apply(effective, tokens, model_cfg, tp_axis=TENSOR_AXIS)
-            return clm_loss_and_metrics(logits, tokens, mask)
+            return _head_loss(effective, tokens, mask, tp_axis=TENSOR_AXIS)
 
+        loss_fn._vocab_chunked = True
         trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                           param_specs=adapter_specs, loss_fn=loss_fn,
                           frozen_params=base_params, frozen_specs=base_specs)
     else:
-        apply_fn = lora_apply_fn(
-            lambda p, t, key=None: llama_apply(p, t, model_cfg), base_params, lora_cfg
-        )
-
         def loss_fn(params, batch, dropout_key):
             tokens, mask = _split_batch(batch)
-            return clm_loss_and_metrics(apply_fn(params, tokens), tokens, mask)
+            effective = apply_adapters(base_params, params, lora_cfg)
+            return _head_loss(effective, tokens, mask)
 
+        loss_fn._vocab_chunked = True
         trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                           loss_fn=loss_fn)
 

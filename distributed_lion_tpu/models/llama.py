@@ -249,6 +249,32 @@ def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: lis
     return logits, new_cache
 
 
+def llama_hidden(
+    params: dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    *,
+    tp_axis: Optional[str] = None,
+    seq_axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Backbone forward: tokens [B, T] → final hidden [B, T, d] after the
+    last RMSNorm. The lm_head is applied by :func:`llama_apply`, or streamed
+    chunk-wise by ops/xent (vocab 32k/128k logits never materialized)."""
+    B, T = tokens.shape
+    if seq_axis is None:
+        if T > cfg.n_ctx:
+            raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
+        offset = 0
+    else:
+        offset = jax.lax.axis_index(seq_axis) * T
+    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
+    cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta, offset=offset)
+    block = _block_remat if cfg.remat else _block
+    for p in params["blocks"]:
+        x = block(x, p, cfg, cos, sin, tp_axis, seq_axis)
+    return _rms_norm(x, params["ln_f"], cfg.rms_eps)
+
+
 def llama_apply(
     params: dict,
     tokens: jnp.ndarray,
@@ -265,19 +291,7 @@ def llama_apply(
     ``tokens`` is this device's contiguous chunk: rotary angles are offset by
     the shard index and attention rings over the axis.
     """
-    B, T = tokens.shape
-    if seq_axis is None:
-        if T > cfg.n_ctx:
-            raise ValueError(f"sequence length {T} exceeds n_ctx {cfg.n_ctx}")
-        offset = 0
-    else:
-        offset = jax.lax.axis_index(seq_axis) * T
-    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
-    cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta, offset=offset)
-    block = _block_remat if cfg.remat else _block
-    for p in params["blocks"]:
-        x = block(x, p, cfg, cos, sin, tp_axis, seq_axis)
-    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    x = llama_hidden(params, tokens, cfg, tp_axis=tp_axis, seq_axis=seq_axis)
     return jnp.einsum(
         "btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),
         preferred_element_type=jnp.float32,

@@ -29,39 +29,50 @@ def chunked_softmax_xent(
     emb: jnp.ndarray,
     labels: jnp.ndarray,
     n_chunks: int = 8,
+    emb_layout: str = "vd",
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Streaming cross entropy against a tied embedding.
+    """Streaming cross entropy against a tied embedding / LM head.
+
+    Each chunk is carved out of the ORIGINAL head array with a
+    ``dynamic_slice`` — no padded or transposed copy of the (possibly
+    V=128k × d) head is ever materialized; when V doesn't divide evenly the
+    final chunk overlaps the previous one and the already-counted columns
+    are masked out of the lse/gather/argmax.
 
     Args:
         hidden: [N, d] final hidden states (any float dtype; matmul f32-acc).
-        emb: [V, d] tied embedding / LM head (rows are vocab entries).
-        labels: [N] int32 target ids.
-        n_chunks: vocab chunks; V is zero-padded up to a multiple (padded
-            rows score -inf-ish via masking, never win argmax or the lse).
+        emb: the head — [V, d] with ``emb_layout="vd"`` (tied embedding,
+            rows are vocab entries) or [d, V] with ``"dv"`` (untied lm_head
+            in matmul orientation, e.g. Llama).
+        labels: [N] int32 target ids (< V by contract).
+        n_chunks: number of vocab chunks.
 
     Returns:
         (nll [N] f32, correct [N] bool) — per-position negative log
         likelihood and argmax-equals-label (for the accuracy metric).
     """
+    if emb_layout not in ("vd", "dv"):
+        raise ValueError(f"emb_layout must be 'vd' or 'dv', got {emb_layout!r}")
     n, d = hidden.shape
-    v = emb.shape[0]
-    vc = -(-v // n_chunks)
-    pad = n_chunks * vc - v
-    if pad:
-        emb = jnp.concatenate([emb, jnp.zeros((pad, d), emb.dtype)], axis=0)
-    emb_chunks = emb.reshape(n_chunks, vc, d)
+    v = emb.shape[0] if emb_layout == "vd" else emb.shape[1]
+    vc = -(-v // n_chunks)  # ceil; vc <= v always
 
     @partial(jax.checkpoint, prevent_cse=False)
-    def body(carry, inp):
+    def body(carry, cidx):
         m, s, lab, best, besti = carry
-        ec, cidx = inp
-        logits = jnp.einsum("nd,vd->nv", hidden, ec.astype(hidden.dtype),
-                            preferred_element_type=jnp.float32)
-        # mask zero-pad vocab rows by GLOBAL index (padding can spill across
-        # several chunks when vc*n_chunks >> v), so phantom logit-0 columns
-        # never enter the lse, the label gather, or the argmax
-        col_ok = (cidx * vc + jnp.arange(vc)) < v
-        logits = jnp.where(col_ok[None, :], logits, -jnp.inf)
+        # the tail chunk starts early enough to stay in-bounds; columns it
+        # shares with the previous chunk are masked as already-counted
+        start = jnp.minimum(cidx * vc, v - vc)
+        if emb_layout == "vd":
+            ec = lax.dynamic_slice_in_dim(emb, start, vc, axis=0)
+            logits = jnp.einsum("nd,vd->nv", hidden, ec.astype(hidden.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            ec = lax.dynamic_slice_in_dim(emb, start, vc, axis=1)
+            logits = jnp.einsum("nd,dv->nv", hidden, ec.astype(hidden.dtype),
+                                preferred_element_type=jnp.float32)
+        fresh = (start + jnp.arange(vc)) >= cidx * vc
+        logits = jnp.where(fresh[None, :], logits, -jnp.inf)
 
         cm = logits.max(-1)
         new_m = jnp.maximum(m, cm)
@@ -72,8 +83,8 @@ def chunked_softmax_xent(
                         jnp.exp(logits - new_m[:, None]).sum(-1), 0.0)
         s = s * scale + add
 
-        local = labels - cidx * vc
-        in_range = (local >= 0) & (local < vc)  # labels < v by contract
+        local = labels - start
+        in_range = (labels >= cidx * vc) & (local < vc)
         gathered = jnp.take_along_axis(
             logits, jnp.clip(local, 0, vc - 1)[:, None], axis=-1
         )[:, 0]
@@ -81,7 +92,7 @@ def chunked_softmax_xent(
 
         upd = cm > best
         best = jnp.where(upd, cm, best)
-        besti = jnp.where(upd, logits.argmax(-1) + cidx * vc, besti)
+        besti = jnp.where(upd, logits.argmax(-1) + start, besti)
         return (new_m, s, lab, best, besti), None
 
     init = (
@@ -92,7 +103,7 @@ def chunked_softmax_xent(
         jnp.zeros((n,), jnp.int32),
     )
     (m, s, lab, _, besti), _ = lax.scan(
-        body, init, (emb_chunks, jnp.arange(n_chunks, dtype=jnp.int32))
+        body, init, jnp.arange(n_chunks, dtype=jnp.int32)
     )
     lse = m + jnp.log(s)
     nll = lse - lab
@@ -105,16 +116,18 @@ def chunked_clm_loss_and_metrics(
     tokens: jnp.ndarray,
     n_chunks: int = 8,
     loss_mask: jnp.ndarray | None = None,
+    emb_layout: str = "vd",
 ) -> tuple[jnp.ndarray, dict]:
     """Shift-by-one CLM loss from FINAL HIDDEN STATES (not logits) — the
     chunked twin of models/loss.clm_loss_and_metrics, same return contract.
 
-    ``hidden`` [B, T, d]; positions 0..T-2 predict tokens 1..T-1.
+    ``hidden`` [B, T, d]; positions 0..T-2 predict tokens 1..T-1. ``emb``
+    is the head in either layout (see :func:`chunked_softmax_xent`).
     """
     b, t, d = hidden.shape
     h = hidden[:, :-1].reshape(b * (t - 1), d)
     labels = tokens[:, 1:].reshape(-1).astype(jnp.int32)
-    nll, correct = chunked_softmax_xent(h, emb, labels, n_chunks)
+    nll, correct = chunked_softmax_xent(h, emb, labels, n_chunks, emb_layout)
     if loss_mask is None:
         mask = jnp.ones_like(nll)
     else:

@@ -250,13 +250,13 @@ class Trainer:
                     )
         if (cfg.vocab_chunks > 0 and loss_fn is not None
                 and not getattr(loss_fn, "_vocab_chunked", False)):
-            # vocab_chunks is only consumed when THIS class builds the loss
-            # (for_gpt2's dense path); a caller-supplied loss would silently
-            # ignore it — e.g. run_sft/run_dpo, whose CLIs auto-expose the
-            # flag via TrainConfig.
+            # vocab_chunks is consumed by losses that opt in (for_gpt2's
+            # dense path, run_sft's SFT losses — marked _vocab_chunked); any
+            # other caller-supplied loss would silently ignore the flag,
+            # e.g. run_dpo, whose CLI auto-exposes it via TrainConfig.
             raise NotImplementedError(
                 "--vocab_chunks is not wired into this entry point's loss "
-                "function (supported: run_clm's dense dp/tp path)"
+                "function (supported: run_clm's dense dp/tp path, run_sft)"
             )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         # number of ways batch ROWS (dim 0) are sharded: data alone normally;

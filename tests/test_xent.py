@@ -98,3 +98,23 @@ def test_trainer_vocab_chunks_matches_dense():
     np.testing.assert_allclose(losses_c, losses_d, rtol=1e-4, atol=1e-4)
     for a, b in zip(jax.tree.leaves(params_d), jax.tree.leaves(params_c)):
         assert np.abs(a - b).max() <= 2 * 1e-3 * 5 + 1e-6  # ballot-flip envelope
+
+
+def test_llama_chunked_matches_dense():
+    """llama_hidden + chunked xent == llama_apply + dense loss (untied head,
+    lm_head [d, V] transposed into the emb contract)."""
+    from distributed_lion_tpu.models.llama import (
+        LlamaConfig, llama_apply, llama_hidden, llama_init,
+    )
+
+    model = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    params = llama_init(jax.random.key(0), model)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, model.vocab_size, (2, 24)), jnp.int32)
+    hidden = llama_hidden(params, tokens, model)
+    loss_c, m_c = chunked_clm_loss_and_metrics(
+        hidden, params["lm_head"], tokens, 4, emb_layout="dv")
+    loss_d, m_d = clm_loss_and_metrics(llama_apply(params, tokens, model), tokens)
+    np.testing.assert_allclose(float(loss_c), float(loss_d), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(m_c["accuracy"]), float(m_d["accuracy"]),
+                               rtol=1e-6, atol=1e-6)
