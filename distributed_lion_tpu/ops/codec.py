@@ -27,6 +27,30 @@ def packed_size(n: int) -> int:
     return (n + 7) // 8
 
 
+def parse_wire(wire: str) -> tuple[str, int | None]:
+    """Parse a wire-format string into ``(kind, group_size)``.
+
+    Plain formats — ``sign_psum`` / ``packed_allgather`` / ``packed_a2a`` —
+    parse to ``(wire, None)``. The hierarchical format ``"hier:<g>"`` parses
+    to ``("hier", g)``: g consecutive workers form an ICI subgroup that
+    reduce-scatters ±1 ballots on-fabric (each member owns 1/g of the
+    coordinates), and only the owners' bit-packed 1-bit verdict chunks cross
+    the (DCN) boundary between groups. Raises ValueError on anything else —
+    single source of truth for wire validation (optimizer, trainer, byte
+    accounting)."""
+    if wire.startswith("hier:"):
+        try:
+            g = int(wire.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(f"bad hier wire spec {wire!r}: expected 'hier:<int>'")
+        if g < 1:
+            raise ValueError(f"hier group size must be >= 1, got {g}")
+        return "hier", g
+    if wire in ("sign_psum", "packed_allgather", "packed_a2a"):
+        return wire, None
+    raise ValueError(f"unknown wire format: {wire!r}")
+
+
 def vote_chunk_elems(n: int, vote_every: int) -> int:
     """Coordinates refreshed per step under ``vote_every`` lazy refresh
     (optim.distributed_lion): the ballot vector is padded so every one of the
@@ -107,8 +131,14 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
         num_params: total parameters voted on.
         world_size: number of data-parallel voters.
         wire: 'sign_psum' (int8 on-fabric all-reduce), 'packed_allgather'
-            (1-bit uint8 all-gather), or 'packed_a2a' (two-phase 1-bit
-            all_to_all + all_gather; ~2 bits/param, W-independent).
+            (1-bit uint8 all-gather), 'packed_a2a' (two-phase 1-bit
+            all_to_all + all_gather; ~2 bits/param, W-independent), or
+            'hier:<g>' (two-level chunked vote: ballot reduce-scatter inside
+            g-worker ICI subgroups, cross-group ring of the owners' packed
+            1-bit verdict chunks, intra-group all-gather of the elected
+            bits — the ``dcn_bytes_per_step`` extra key reports the
+            cross-group leg alone, (W/g − 1)/g bits/param, the volume that
+            actually rides the slow fabric on a multi-host mesh).
         vote_every: lazy-refresh period K (optim.distributed_lion): each step
             votes only ceil(n/K) coordinates → wire volume ÷ K.
         accum_steps: gradient-accumulation microbatches per optimizer step
@@ -119,9 +149,30 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
         build, the reference, and a bf16 gradient all-reduce, plus both
         bits/param views.
     """
+    kind, group = parse_wire(wire)
     n_voted = (num_params if vote_every <= 1
                else min(num_params, vote_chunk_elems(num_params, vote_every)))
-    if wire == "sign_psum":
+    extras: dict = {}
+    if kind == "hier":
+        if world_size % group:
+            raise ValueError(
+                f"hier group size {group} does not divide world {world_size}"
+            )
+        n_groups = world_size // group
+        # Mirrors collectives._hier_elect's three chunked ppermute rings:
+        #   ICI leg 1 (reduce-scatter of ballots): (g−1) hops × chunk bytes
+        #   ICI leg 3 (all-gather of packed elected): (g−1) hops × chunk/8
+        #   DCN leg 2 (cross-group packed verdicts): (G−1) hops × chunk/8 —
+        #     the flat packed vote's cross-boundary volume divided by g,
+        #     because only each member's OWNED 1/g chunk crosses groups.
+        acc_bytes = 1 if group <= 127 else 4
+        chunk = 8 * a2a_chunk_bytes(n_voted, group)  # same rule as _hier_elect
+        dcn = (n_groups - 1) * (chunk // 8)
+        ici = (group - 1) * (chunk * acc_bytes + chunk // 8)
+        ours = ici + dcn
+        extras = {"hier_groups": n_groups, "dcn_bytes_per_step": dcn,
+                  "dcn_bits_per_param": 8.0 * dcn / max(num_params, 1)}
+    elif wire == "sign_psum":
         # Ring all-reduce of the ballot tensor: received payload per worker ≈
         # N bytes at the accumulator width (reduction happens on-fabric,
         # receive volume independent of W). int8 is exact only while partial
@@ -140,7 +191,7 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
     reference = world_size * packed_size(num_params) * 8  # int64 lanes
     bf16_allreduce = 2 * num_params
     bits = 8.0 * ours / max(num_params, 1)
-    return {
+    return extras | {
         "wire": wire,
         "vote_every": vote_every,
         "bytes_per_step": ours,

@@ -90,9 +90,14 @@ def distributed_lion(
             ``r = (1 + 1/b1) * max_grad_norm`` (ref :106-108). Requires an
             ``rng`` key at ``init``.
         wire: 'sign_psum' (int8 on-fabric reduce; ICI default),
-            'packed_allgather' (1-bit uint8 wire; DCN-friendly), or
+            'packed_allgather' (1-bit uint8 wire; DCN-friendly),
             'packed_a2a' (two-phase 1-bit vote, ~2 bits/param independent
-            of world size; minimum-bandwidth choice for large worlds).
+            of world size; minimum-bandwidth choice for large worlds), or
+            'hier:<g>' (two-level chunked vote for ICI+DCN meshes: ballot
+            reduce-scatter inside g-worker ICI subgroups, cross-group ring
+            of the owners' packed 1-bit verdict chunks — (W/g − 1)/g
+            bits/param on the slow fabric; majority-of-majorities,
+            collectives.majority_vote_hier).
         vote_every: K > 1 enables *lazy sign refresh*: each step votes on a
             rotating 1/K slice of coordinates (wire volume ÷ K — e.g.
             packed_a2a at K=4 is ~0.5 bit/param/step, meeting BASELINE.md's
@@ -114,8 +119,9 @@ def distributed_lion(
         None). Params in/out are replicated; ``state.exp_avg`` is this
         worker's momentum shard (see :func:`init_global_state`).
     """
-    if wire not in collectives.WIRE_FORMATS:
-        raise ValueError(f"unknown wire format: {wire!r}")
+    from distributed_lion_tpu.ops.codec import parse_wire
+
+    parse_wire(wire)  # raises on unknown formats; accepts "hier:<g>" too
     if axis_name is None:
         # The reference's uninitialized-process-group fallback is plain local
         # Lion (distributed_lion.py:165-166). Refuse to silently drop an

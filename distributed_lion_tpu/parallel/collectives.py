@@ -19,6 +19,12 @@ TPU-native replacement for the reference's only two collective calls —
   **independent of world size** — the minimum-bandwidth path, and the wire
   to use when W is large enough that ``packed_allgather``'s W bits/param
   hurts.
+- :func:`majority_vote_hier` (wire ``"hier:<g>"``) — two-level chunked vote
+  for multi-host meshes: ballots reduce-scattered *inside* g-worker ICI
+  subgroups (each member owns 1/g of the coordinates), then only the
+  owners' bit-packed 1-bit verdict chunks cross the group boundary (the
+  DCN leg: (W/g − 1)/g bits/param). Majority-of-majorities semantics;
+  degenerates to the flat vote at g=1 and g=W.
 
 Both must be called inside ``jax.shard_map`` (or any context where
 ``axis_name`` is bound). Tie rule: ties vote −1, matching ``torch.mode``'s
@@ -31,7 +37,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from distributed_lion_tpu.ops.codec import a2a_chunk_bytes, pack_signs, unpack_signs
+from distributed_lion_tpu.ops.codec import (
+    a2a_chunk_bytes,
+    pack_signs,
+    parse_wire,
+    unpack_signs,
+)
 
 
 def axis_size(axis_name: str) -> int:
@@ -53,13 +64,14 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
     views.
     """
     w = axis_size(axis_name)
-    if wire == "sign_psum":
+    kind, group = parse_wire(wire)  # raises on unknown formats
+    if kind == "sign_psum":
         # ±1 in int8 keeps the wire at 1 byte/param; XLA accumulates int8
         # exactly for |sum| ≤ 127, so promote only for large worlds.
         acc = jnp.int8 if w <= 127 else jnp.int32
         ballots = jnp.where(vote_pos, 1, -1).astype(acc)
         return lax.psum(ballots, axis_name)
-    if wire == "packed_allgather":
+    if kind == "packed_allgather":
         # The reference's pack → all_gather → unpack → vote pipeline
         # (distributed_lion.py:71-91) with a true-uint8 wire format;
         # vote_pos must be 1-D (callers vote on a flattened pytree).
@@ -68,13 +80,15 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
         bits = unpack_signs(gathered.reshape(-1), (w, gathered.shape[1] * 8))
         count = bits.astype(jnp.int32).sum(0)[: vote_pos.shape[0]]
         return count * 2 - w
-    if wire == "packed_a2a":
+    if kind == "packed_a2a":
         # Two-phase vote. The verdict (not the tally) crosses the wire in
         # phase 2, so the returned "total" is the ±1 proxy of the elected
         # sign — every caller only tests ``total > 0``, and the tie rule
         # (tie → −1) is applied at the tallying worker in phase 1.
         return jnp.where(_packed_a2a_elect(vote_pos, axis_name, w), 1, -1)
-    raise ValueError(f"unknown wire format: {wire!r}")
+    # kind == "hier": per-worker tallies never leave the ICI subgroup, so
+    # (like packed_a2a) only a ±1 proxy of the elected sign is available.
+    return jnp.where(_hier_elect(vote_pos, axis_name, w, group), 1, -1)
 
 
 def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndarray:
@@ -95,6 +109,105 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndar
     return unpack_signs(gathered.reshape(-1), (n,))
 
 
+def _hier_elect(
+    vote_pos: jnp.ndarray, axis_name: str, w: int, group_size: int
+) -> jnp.ndarray:
+    """Hierarchical majority-of-majorities vote over a two-level fabric.
+
+    Workers [k*group_size, (k+1)*group_size) form subgroup k — on a
+    multi-host mesh, construct the data axis so that a subgroup is one
+    ICI-connected host/slice (jax orders devices process-major, so
+    consecutive axis indices share a host by default). Member i of each
+    subgroup *owns* 1/g of the coordinates: ballots are reduce-scattered
+    inside the subgroup, only the owners' bit-packed verdict chunks ride the
+    cross-group (DCN) ring, and the elected bits are re-assembled by an
+    intra-group all-gather — see the leg-by-leg comment below and the
+    mirrored byte accounting in ops/codec.wire_bytes_per_param.
+
+    Tie rule at BOTH levels: ties elect −1 (torch.mode's smaller-value
+    behavior, SURVEY §2.3 step 6). Majority-of-majorities can differ from
+    the flat majority (e.g. W=8 g=4, ballots [+,+,−,−][+,+,+,+] → group
+    verdicts [tie→−, +] → group-level tie → −1, where the flat 6−2 vote
+    elects +1); it degenerates to the flat vote at g=1 and g=W. Every worker
+    applies the same elected bits, so replicas stay bit-identical.
+    """
+    if w % group_size:
+        raise ValueError(
+            f"hier wire: group size {group_size} does not divide world {w}"
+        )
+    g = group_size
+    n_groups = w // g
+    n = vote_pos.shape[0]
+    # All three legs run as ppermute rings (subgrouped psum/all_gather via
+    # axis_index_groups is not supported under shard_map), chunked so no leg
+    # ever moves the full ballot vector more than once:
+    #   1. intra-group reduce-scatter — after g−1 hops member i holds the
+    #      exact group tally for its OWNED 1/g chunk of coordinates
+    #      (received: (g−1)·n/g ballot bytes, ICI);
+    #   2. cross-group ring of the owners' bit-packed verdict chunks — the
+    #      only traffic that crosses the group boundary (DCN leg:
+    #      (W/g − 1)·n/(8g) bytes — the flat vote's DCN volume ÷ g);
+    #   3. intra-group ring all-gather of the packed ELECTED chunks to
+    #      reassemble the full vector (received: (g−1)·n/(8g) ≈ n/8 bytes).
+    # Byte accounting in ops/codec.wire_bytes_per_param mirrors exactly this.
+    acc = jnp.int8 if g <= 127 else jnp.int32
+    chunk = 8 * a2a_chunk_bytes(n, g)  # byte-aligned coords per member —
+    # the same pad-to-equal-byte-chunks rule as the a2a wire, shared with
+    # codec.wire_bytes_per_param's hier branch so accounting can't drift
+    pad = g * chunk - n
+    flat = (jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)])
+            if pad else vote_pos)
+    buf = jnp.where(flat, 1, -1).astype(acc).reshape(g, chunk)
+    idx = lax.axis_index(axis_name) % g  # my position within the group
+    intra_perm = [(s, (s // g) * g + ((s % g) + 1) % g) for s in range(w)]
+
+    # phase 1 — reduce-scatter: at hop t I pass on the partial sum of chunk
+    # (idx − t) mod g and fold my ballots into the arriving partial, ending
+    # with the full tally of owned chunk (idx + 1) mod g.
+    own = (idx + 1) % g
+    msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
+    for t in range(g - 1):
+        msg = lax.ppermute(msg, axis_name, intra_perm)
+        recv = (idx - t - 1) % g
+        msg = msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0]
+    verdict_own = msg > 0  # subgroup tie → −1, for my owned coords
+
+    # phase 2 — cross-group ring of packed verdicts: member i of every group
+    # owns the SAME chunk id, so a ring over same-position peers accumulates
+    # the group-verdict count coordinate-aligned; arrival order is irrelevant
+    # to a running count.
+    cross_perm = [
+        (s, ((s // g + 1) % n_groups) * g + s % g) for s in range(w)
+    ]
+    count = verdict_own.astype(jnp.int32)
+    rot = pack_signs(verdict_own)
+    for _ in range(n_groups - 1):
+        rot = lax.ppermute(rot, axis_name, cross_perm)
+        count = count + unpack_signs(rot, (chunk,)).astype(jnp.int32)
+    elected_own = count * 2 > n_groups  # group-level tie → −1
+
+    # phase 3 — intra-group all-gather of the packed elected chunks.
+    packed_own = pack_signs(elected_own)  # [chunk/8] uint8
+    out = jnp.zeros((g, chunk // 8), jnp.uint8)
+    out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
+    rot = packed_own
+    for t in range(g - 1):
+        rot = lax.ppermute(rot, axis_name, intra_perm)
+        # the hop-t packet originated at the member t+1 behind me, which
+        # owns chunk (idx − t − 1 + 1) mod g
+        out = lax.dynamic_update_slice(out, rot[None], ((idx - t) % g, 0))
+    return unpack_signs(out.reshape(-1), (g * chunk,))[:n]
+
+
+def majority_vote_hier(
+    vote_pos: jnp.ndarray, axis_name: str, group_size: int
+) -> jnp.ndarray:
+    """Two-level chunked majority vote: ICI-subgroup ballot reduce-scatter,
+    cross-group packed-verdict ring, intra-group elected-bits all-gather;
+    ties → False (−1) at both levels."""
+    return _hier_elect(vote_pos, axis_name, axis_size(axis_name), group_size)
+
+
 def majority_vote_psum(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """Majority vote via an on-fabric sum of ±1 votes; ties → False (−1)."""
     return vote_total(vote_pos, axis_name, "sign_psum") > 0
@@ -110,17 +223,11 @@ def majority_vote_packed_a2a(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarr
     return _packed_a2a_elect(vote_pos, axis_name, axis_size(axis_name))
 
 
-WIRE_FORMATS = ("sign_psum", "packed_allgather", "packed_a2a")
-
-
 def majority_vote(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
-    if wire == "sign_psum":
-        return majority_vote_psum(vote_pos, axis_name)
-    if wire == "packed_allgather":
-        return majority_vote_packed_allgather(vote_pos, axis_name)
-    if wire == "packed_a2a":
-        return majority_vote_packed_a2a(vote_pos, axis_name)
-    raise ValueError(f"unknown wire format: {wire!r}")
+    """Elected bool votes for any wire format (``total > 0`` ⇔ majority True;
+    the ±1-proxy wires compute the election directly — XLA folds the
+    round-trip)."""
+    return vote_total(vote_pos, axis_name, wire) > 0
 
 
 def masked_majority_vote_psum(

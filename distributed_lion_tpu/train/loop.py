@@ -724,6 +724,8 @@ class Trainer:
             + f": {acct['bits_per_param']:.2f} bits/param/step "
             f"({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce; "
             f"{acct['bits_per_param_per_microbatch']:.2f} bits/param/microbatch)"
+            + (f" | DCN leg {acct['dcn_bits_per_param']:.3f} bits/param"
+               if "dcn_bits_per_param" in acct else "")
         )
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
         if cfg.vocab_chunks > 0 and (

@@ -138,6 +138,9 @@ def comm_report(num_params: int, world: int, wire: str,
         "vs_reference_wire": acct["bytes_per_step"]
         / max(acct["reference_bytes_per_step"], 1),
     }
+    if "dcn_bytes_per_step" in acct:  # hier wire: the slow-fabric leg alone
+        out["comm_dcn_bytes_per_step"] = acct["dcn_bytes_per_step"]
+        out["comm_dcn_bits_per_param"] = acct["dcn_bits_per_param"]
     if steps_per_sec:
         out["comm_mbytes_per_sec"] = acct["bytes_per_step"] * steps_per_sec / 1e6
     return out
