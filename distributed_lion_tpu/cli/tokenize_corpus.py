@@ -1,0 +1,170 @@
+"""Tokenize a local text corpus into memory-mapped ``.bin`` token shards.
+
+The reference prepares openwebtext with ``datasets.map(tokenize,
+num_proc=N)`` + ``group_texts`` and caches the result as Arrow files
+(/root/reference/run_clm.py:463-544). The zero-egress, framework-native
+equivalent: parallel worker processes run the byte-level BPE (with the C++
+merge core, native/bpe_core.cc), docs are ``<|endoftext|>``-joined into one
+flat token stream, and the stream is written as fixed-size ``.bin`` shards
+(uint16 when the vocab fits, else uint32) plus a ``meta.json`` — exactly
+what the C++ mmap data loader (``--native_loader``) and
+``data.sources.TokenDataset.from_bin`` consume.
+
+    python -m distributed_lion_tpu.cli.tokenize_corpus \
+        --text 'corpus/**/*.txt' --tokenizer bpe:tok/ --output_dir data/owt
+
+Documents are processed in deterministic input order regardless of worker
+count, so a corpus tokenizes to byte-identical shards at any ``num_proc``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import pathlib
+from typing import Iterator, List
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TokenizeArguments:
+    text: str = ""             # glob of local .txt / .jsonl files
+    jsonl_field: str = "text"  # field holding the document in .jsonl inputs
+    tokenizer: str = ""        # bpe:<dir>, a vocab/merges dir, or '' (byte)
+    output_dir: str = "tokenized"
+    shard_tokens: int = 64_000_000  # tokens per .bin shard
+    num_proc: int = 0          # worker processes; 0 = cpu count (cap 16)
+    doc_sep_eos: bool = True   # append <|endoftext|> after every document
+
+
+def _iter_docs(paths: List[str], jsonl_field: str) -> Iterator[str]:
+    """Yield documents in deterministic path-then-line order."""
+    for p in paths:
+        if p.endswith(".jsonl"):
+            with open(p, encoding="utf-8", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    doc = obj.get(jsonl_field) if isinstance(obj, dict) else None
+                    if isinstance(doc, str) and doc:
+                        yield doc
+        else:
+            text = pathlib.Path(p).read_text(encoding="utf-8", errors="replace")
+            if text:
+                yield text
+
+
+_WORKER_TOK = None
+
+
+def _worker_init(tokenizer_name: str) -> None:
+    global _WORKER_TOK
+    from distributed_lion_tpu.data.tokenizer import load_tokenizer
+
+    _WORKER_TOK = load_tokenizer(tokenizer_name or None)
+
+
+def _worker_encode(args: tuple) -> bytes:
+    """Encode one document; returns raw little-endian uint32 id bytes
+    (cheap to pickle back to the writer process)."""
+    doc, add_eos = args
+    ids = _WORKER_TOK.encode(doc, add_eos=add_eos)
+    return np.asarray(ids, np.uint32).tobytes()
+
+
+class _ShardWriter:
+    """Accumulate a flat token stream into fixed-size .bin shards."""
+
+    def __init__(self, out_dir: str, shard_tokens: int, dtype):
+        self.dir = pathlib.Path(out_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.shard_tokens = shard_tokens
+        self.dtype = dtype
+        self.paths: List[str] = []
+        self.total = 0
+        self._buf: List[np.ndarray] = []
+        self._buffered = 0
+
+    def add(self, ids: np.ndarray) -> None:
+        self._buf.append(ids)
+        self._buffered += ids.size
+        self.total += ids.size
+        while self._buffered >= self.shard_tokens:
+            flat = np.concatenate(self._buf)
+            self._write(flat[: self.shard_tokens])
+            rest = flat[self.shard_tokens:]
+            self._buf = [rest] if rest.size else []
+            self._buffered = rest.size
+
+    def _write(self, chunk: np.ndarray) -> None:
+        path = self.dir / f"shard_{len(self.paths):05d}.bin"
+        chunk.astype(self.dtype).tofile(path)
+        self.paths.append(path.name)
+        print(f"[tokenize_corpus] wrote {path} ({chunk.size:,} tokens)")
+
+    def finish(self) -> None:
+        if self._buffered:
+            self._write(np.concatenate(self._buf))
+            self._buf, self._buffered = [], 0
+
+
+def main(argv=None) -> None:
+    from distributed_lion_tpu.data.tokenizer import load_tokenizer
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    (args,) = parse_dataclasses((TokenizeArguments,), argv)
+    paths = sorted(glob.glob(args.text, recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no files match {args.text!r}")
+
+    tok = load_tokenizer(args.tokenizer or None)
+    dtype = np.uint16 if tok.vocab_size <= 65536 else np.uint32
+    writer = _ShardWriter(args.output_dir, args.shard_tokens, dtype)
+
+    num_proc = args.num_proc or min(os.cpu_count() or 1, 16)
+    docs = _iter_docs(paths, args.jsonl_field)
+    n_docs = 0
+    if num_proc > 1:
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")  # fork-safety: jax/XLA may be loaded
+        with ctx.Pool(num_proc, initializer=_worker_init,
+                      initargs=(args.tokenizer,)) as pool:
+            jobs = ((d, args.doc_sep_eos) for d in docs)
+            # imap (ordered) keeps output deterministic at any num_proc
+            for blob in pool.imap(_worker_encode, jobs, chunksize=8):
+                writer.add(np.frombuffer(blob, np.uint32))
+                n_docs += 1
+    else:
+        for doc in docs:
+            ids = tok.encode(doc, add_eos=args.doc_sep_eos)
+            writer.add(np.asarray(ids, np.uint32))
+            n_docs += 1
+    writer.finish()
+
+    meta = {
+        "dtype": np.dtype(dtype).name,
+        "vocab_size": int(tok.vocab_size),
+        "tokenizer": args.tokenizer,
+        "eos_id": int(getattr(tok, "eos_id", 0)),
+        "n_tokens": writer.total,
+        "n_docs": n_docs,
+        "shards": writer.paths,
+    }
+    with open(os.path.join(args.output_dir, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    print(f"[tokenize_corpus] {n_docs} docs -> {writer.total:,} tokens in "
+          f"{len(writer.paths)} shard(s) ({np.dtype(dtype).name}) at "
+          f"{args.output_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,8 @@ import os
 from functools import lru_cache
 from typing import Iterable, List, Optional
 
+import numpy as np
+
 try:  # \p{L}/\p{N} classes need the `regex` module (baked in)
     import regex as _re
 except ImportError:  # pragma: no cover
@@ -62,6 +64,91 @@ def _get_pairs(word: tuple) -> set:
     return {(word[i], word[i + 1]) for i in range(len(word) - 1)}
 
 
+class _NativeCore:
+    """ctypes bridge to the C++ merge core (native/bpe_core.cc).
+
+    Lowers the tokenizer's tables into id space once — vocab as raw
+    byte-strings indexed by id, merges as (left_id, right_id) pairs in rank
+    order — then encodes whole documents with one C call over the
+    regex-pre-tokenized byte stream. Output is pinned token-for-token to the
+    Python ``_bpe`` path by tests/test_bpe.py."""
+
+    def __init__(self, vocab: dict, ranks: dict):
+        import ctypes
+
+        from distributed_lion_tpu import native
+
+        self._lib = native.load_bpe()
+        n = len(vocab)
+        by_id: List[Optional[str]] = [None] * n
+        for t, i in vocab.items():
+            if not (0 <= i < n) or by_id[i] is not None:
+                raise ValueError("native BPE needs dense, unique vocab ids")
+            by_id[i] = t
+        u2b = unicode_to_bytes()
+
+        def raw(tok: str) -> bytes:
+            try:
+                return bytes(u2b[c] for c in tok)
+            except KeyError:  # specials outside the b2u alphabet
+                return tok.encode("utf-8")
+
+        blobs = [raw(t) for t in by_id]
+        blob = b"".join(blobs)
+        off = np.zeros(n + 1, np.int64)
+        np.cumsum([len(b) for b in blobs], out=off[1:])
+        ordered = sorted(ranks.items(), key=lambda kv: kv[1])
+        pairs = np.asarray(
+            [[vocab[a], vocab[b]] for (a, b), _ in ordered], np.int32
+        ).reshape(-1)
+        self._blob = np.frombuffer(blob, np.uint8).copy()
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        self._c = (c_u8p, c_i64p, c_i32p)
+        handle = self._lib.bpe_new(
+            self._blob.ctypes.data_as(c_u8p), off.ctypes.data_as(c_i64p),
+            n, pairs.ctypes.data_as(c_i32p) if pairs.size else
+            np.zeros(1, np.int32).ctypes.data_as(c_i32p), len(ordered),
+        )
+        if not handle:
+            raise RuntimeError(
+                f"bpe_new failed: {self._lib.bpe_last_error().decode()}"
+            )
+        self._h = handle
+
+    def encode_pretoks(self, pretoks: List[bytes]) -> np.ndarray:
+        """[pre-token byte strings] → int32 ids (one C call)."""
+        c_u8p, c_i64p, c_i32p = self._c
+        blob = b"".join(pretoks)
+        buf = np.frombuffer(blob, np.uint8)
+        off = np.zeros(len(pretoks) + 1, np.int64)
+        np.cumsum([len(p) for p in pretoks], out=off[1:])
+        cap = len(blob) + 8  # merges only shrink the per-byte id sequence
+        out = np.empty(cap, np.int32)
+        k = self._lib.bpe_encode(
+            self._h,
+            buf.ctypes.data_as(c_u8p) if buf.size else
+            np.zeros(1, np.uint8).ctypes.data_as(c_u8p),
+            off.ctypes.data_as(c_i64p), len(pretoks),
+            out.ctypes.data_as(c_i32p), cap,
+        )
+        if k < 0:  # can't happen with cap >= len(blob); defensive retry
+            out = np.empty(-k, np.int32)
+            k = self._lib.bpe_encode(
+                self._h, buf.ctypes.data_as(c_u8p),
+                off.ctypes.data_as(c_i64p), len(pretoks),
+                out.ctypes.data_as(c_i32p), -k,
+            )
+        return out[:k]
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self._lib.bpe_free(self._h)
+        except Exception:
+            pass
+
+
 class BPETokenizer:
     """Byte-level BPE over a ``vocab.json`` (token → id) + ranked
     ``merges.txt``. API-compatible with data.tokenizer.ByteTokenizer."""
@@ -80,9 +167,23 @@ class BPETokenizer:
         self.inv_vocab = {i: t for t, i in self.vocab.items()}
         self._pat = _re.compile(_PAT)
         self._cache: dict = {}
+        self._native: object = None  # _NativeCore, False (disabled), or None
         self.eos_id = self.vocab.get(END_OF_TEXT, len(self.vocab) - 1)
         self.bos_id = self.eos_id  # GPT-2 convention: <|endoftext|> is both
         self.pad_id = self.eos_id
+
+    def _native_core(self) -> Optional["_NativeCore"]:
+        """Lazily build the C++ merge core; any failure (no compiler,
+        non-dense ids) pins this tokenizer to the Python path."""
+        if self._native is None:
+            if os.environ.get("DLION_NATIVE_BPE", "1") == "0":
+                self._native = False
+            else:
+                try:
+                    self._native = _NativeCore(self.vocab, self.ranks)
+                except Exception:
+                    self._native = False
+        return self._native or None
 
     @property
     def vocab_size(self) -> int:
@@ -117,6 +218,14 @@ class BPETokenizer:
 
     def encode(self, text: str, add_bos: bool = False,
                add_eos: bool = False) -> List[int]:
+        core = self._native_core()
+        if core is not None:
+            # native path: regex pre-tokenize here, merge in C++ (raw bytes;
+            # the byte→unicode mapping lives in the lowered id tables)
+            pretoks = [t.encode("utf-8") for t in self._pat.findall(text)]
+            body = core.encode_pretoks(pretoks).tolist() if pretoks else []
+            return ([self.bos_id] if add_bos else []) + body + (
+                [self.eos_id] if add_eos else [])
         b2u = bytes_to_unicode()
         ids: List[int] = []
         if add_bos:

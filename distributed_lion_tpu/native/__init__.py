@@ -22,8 +22,11 @@ from typing import Optional
 _HERE = pathlib.Path(__file__).resolve().parent
 _SRC = _HERE / "dataloader.cc"
 _LIB = _HERE / "_dataloader.so"
+_BPE_SRC = _HERE / "bpe_core.cc"
+_BPE_LIB = _HERE / "_bpe_core.so"
 _lock = threading.Lock()
 _cached: Optional[ctypes.CDLL] = None
+_bpe_cached: Optional[ctypes.CDLL] = None
 
 
 class NativeBuildError(RuntimeError):
@@ -34,11 +37,12 @@ def library_path() -> pathlib.Path:
     return _LIB
 
 
-def build(force: bool = False) -> pathlib.Path:
-    """Compile dataloader.cc → _dataloader.so (atomic rename, so concurrent
-    builders race benignly). Raises NativeBuildError on failure."""
-    if not force and _LIB.exists() and _LIB.stat().st_mtime >= _SRC.stat().st_mtime:
-        return _LIB
+def _compile(src: pathlib.Path, lib: pathlib.Path,
+             force: bool = False) -> pathlib.Path:
+    """Compile one .cc → .so (atomic rename, so concurrent builders race
+    benignly). Raises NativeBuildError on failure."""
+    if not force and lib.exists() and lib.stat().st_mtime >= src.stat().st_mtime:
+        return lib
     with tempfile.NamedTemporaryFile(
         suffix=".so", dir=str(_HERE), delete=False
     ) as tmp:
@@ -46,7 +50,7 @@ def build(force: bool = False) -> pathlib.Path:
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", tmp_path,
+        str(src), "-o", tmp_path,
     ]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
@@ -56,8 +60,12 @@ def build(force: bool = False) -> pathlib.Path:
     if proc.returncode != 0:
         pathlib.Path(tmp_path).unlink(missing_ok=True)
         raise NativeBuildError(f"g++ failed:\n{proc.stderr}")
-    os.replace(tmp_path, _LIB)
-    return _LIB
+    os.replace(tmp_path, lib)
+    return lib
+
+
+def build(force: bool = False) -> pathlib.Path:
+    return _compile(_SRC, _LIB, force)
 
 
 def load() -> ctypes.CDLL:
@@ -100,4 +108,39 @@ def available() -> bool:
     except (NativeBuildError, OSError):
         # OSError covers a stale/corrupt/wrong-arch .so that CDLL rejects —
         # callers should fall back to the Python path, not crash
+        return False
+
+
+def load_bpe() -> ctypes.CDLL:
+    """Build (if needed) and load the BPE merge core (bpe_core.cc), with
+    typed signatures; consumed by data/bpe.py's native fast path."""
+    global _bpe_cached
+    with _lock:
+        if _bpe_cached is not None:
+            return _bpe_cached
+        lib = ctypes.CDLL(str(_compile(_BPE_SRC, _BPE_LIB)))
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_new.argtypes = [c_u8p, c_i64p, ctypes.c_int32, c_i32p,
+                                ctypes.c_int32]
+        lib.bpe_encode.restype = ctypes.c_int64
+        lib.bpe_encode.argtypes = [ctypes.c_void_p, c_u8p, c_i64p,
+                                   ctypes.c_int64, c_i32p, ctypes.c_int64]
+        lib.bpe_cache_size.restype = ctypes.c_int64
+        lib.bpe_cache_size.argtypes = [ctypes.c_void_p]
+        lib.bpe_free.restype = None
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_last_error.restype = ctypes.c_char_p
+        lib.bpe_last_error.argtypes = []
+        _bpe_cached = lib
+        return lib
+
+
+def bpe_available() -> bool:
+    try:
+        load_bpe()
+        return True
+    except (NativeBuildError, OSError):
         return False

@@ -1,0 +1,195 @@
+"""Native (C++) BPE merge core + corpus tokenization CLI.
+
+The C++ core (native/bpe_core.cc) must match the Python ``_bpe`` path
+token-for-token — same best-pair selection, same left-to-right collapse,
+same byte<->unicode lowering — and ``cli.tokenize_corpus`` must produce
+byte-identical shards at any worker count (the reference's
+``datasets.map(num_proc=N)`` + group_texts caching, run_clm.py:463-544).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.data.bpe import BPETokenizer, train_bpe
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog. " * 5,
+    "Ünïcödé tèxt — em-dash, 中文字符, emoji 🎉🎊, tabs\t\tand\nnewlines " * 3,
+    "def f(x):\n    return x ** 2  # code-ish 12345 67890 " * 4,
+    "it's we've they'll don't I'm o'clock 'quoted' ",
+]
+
+TRICKY = [
+    "",
+    " ",
+    "   leading and trailing   ",
+    "a",
+    "completely unseen wörds żółć flambé 999!?!?",
+    "\n\n\n",
+    "🎉" * 10,
+    "mixedCASE WordBoundaries123abc",
+]
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return train_bpe(CORPUS, vocab_size=400)
+
+
+def _fresh(tok, native: bool) -> BPETokenizer:
+    merges = [list(k) for k, _ in sorted(tok.ranks.items(), key=lambda kv: kv[1])]
+    t = BPETokenizer(tok.vocab, merges)
+    if not native:
+        t._native = False
+    return t
+
+
+def test_native_core_builds(tok):
+    from distributed_lion_tpu import native
+
+    assert native.bpe_available()
+    assert _fresh(tok, native=True)._native_core() is not None
+
+
+def test_native_matches_python_token_for_token(tok):
+    nat, py = _fresh(tok, True), _fresh(tok, False)
+    for text in CORPUS + TRICKY:
+        assert nat.encode(text) == py.encode(text), text[:40]
+        assert (nat.encode(text, add_bos=True, add_eos=True)
+                == py.encode(text, add_bos=True, add_eos=True))
+
+
+def test_native_roundtrip_decode(tok):
+    nat = _fresh(tok, True)
+    for text in CORPUS:
+        assert nat.decode(nat.encode(text)) == text
+
+
+def test_native_fuzz_parity(tok):
+    rng = np.random.default_rng(0)
+    nat, py = _fresh(tok, True), _fresh(tok, False)
+    alphabet = list("abcdefgh ABC.,!?'\n\t0123456789éü中🎉")
+    for _ in range(50):
+        n = int(rng.integers(0, 80))
+        text = "".join(rng.choice(alphabet) for _ in range(n))
+        assert nat.encode(text) == py.encode(text), repr(text)
+
+
+def test_partial_byte_coverage_refused(tok):
+    """A vocab that doesn't cover all 256 byte values must NOT get the
+    native path (silent byte-dropping); it pins to the Python fallback."""
+    merges = [list(k) for k, _ in sorted(tok.ranks.items(), key=lambda kv: kv[1])]
+    vocab = dict(tok.vocab)
+    # remove one single-char byte token and re-densify ids
+    victim = next(t for t in vocab if len(t) == 1)
+    del vocab[victim]
+    dense = {t: i for i, t in enumerate(vocab)}
+    t = BPETokenizer(dense, [m for m in merges
+                             if victim not in m and "".join(m) in dense])
+    assert t._native_core() is None
+
+
+def test_jsonl_robustness(tok, tmp_path):
+    """Valid-JSON non-object lines and non-string fields are skipped, not
+    fatal."""
+    from distributed_lion_tpu.cli.tokenize_corpus import _iter_docs
+
+    p = tmp_path / "weird.jsonl"
+    with open(p, "w", encoding="utf-8") as f:
+        f.write("123\n")
+        f.write('"plain string"\n')
+        f.write('{"text": 42}\n')
+        f.write('{"text": null}\n')
+        f.write('{"text": "good doc"}\n')
+        f.write("not json at all {{{\n")
+    assert list(_iter_docs([str(p)], "text")) == ["good doc"]
+
+
+def test_env_kill_switch(tok, monkeypatch):
+    monkeypatch.setenv("DLION_NATIVE_BPE", "0")
+    t = _fresh(tok, True)
+    assert t._native_core() is None  # falls back to the Python path
+    assert t.encode("hello world") == _fresh(tok, False).encode("hello world")
+
+
+# ------------------------------------------------------------- corpus CLI
+def _write_corpus(root: pathlib.Path) -> None:
+    (root / "a.txt").write_text(CORPUS[0], encoding="utf-8")
+    (root / "b.txt").write_text(CORPUS[1], encoding="utf-8")
+    with open(root / "c.jsonl", "w", encoding="utf-8") as f:
+        f.write(json.dumps({"text": CORPUS[2]}) + "\n")
+        f.write("\n")  # blank line skipped
+        f.write(json.dumps({"other": "ignored"}) + "\n")
+        f.write(json.dumps({"text": CORPUS[3]}) + "\n")
+
+
+def test_tokenize_corpus_end_to_end(tok, tmp_path):
+    from distributed_lion_tpu.cli.tokenize_corpus import main
+
+    tok.save(str(tmp_path / "tok"))
+    _write_corpus(tmp_path)
+    out = tmp_path / "bins"
+    main([
+        "--text", str(tmp_path / "*.*"), "--tokenizer", f"bpe:{tmp_path/'tok'}",
+        "--output_dir", str(out), "--num_proc", "1", "--shard_tokens", "200",
+    ])
+    meta = json.loads((out / "meta.json").read_text())
+    assert meta["dtype"] == "uint16" and meta["n_docs"] == 4
+    stream = np.concatenate([
+        np.fromfile(out / s, np.uint16) for s in meta["shards"]
+    ])
+    assert stream.size == meta["n_tokens"]
+    # the stream is the eos-joined concatenation of the docs in input order
+    ref = []
+    for doc in [CORPUS[0], CORPUS[1], CORPUS[2], CORPUS[3]]:
+        ref.extend(tok.encode(doc, add_eos=True))
+    np.testing.assert_array_equal(stream, np.asarray(ref, np.uint16))
+    # shard size respected (all but the last full)
+    sizes = [np.fromfile(out / s, np.uint16).size for s in meta["shards"]]
+    assert all(s == 200 for s in sizes[:-1]) and len(sizes) >= 2
+
+
+def test_tokenize_corpus_parallel_deterministic(tok, tmp_path):
+    from distributed_lion_tpu.cli.tokenize_corpus import main
+
+    tok.save(str(tmp_path / "tok"))
+    _write_corpus(tmp_path)
+    outs = []
+    for np_, name in ((1, "seq"), (2, "par")):
+        out = tmp_path / name
+        main([
+            "--text", str(tmp_path / "*.*"),
+            "--tokenizer", f"bpe:{tmp_path/'tok'}",
+            "--output_dir", str(out), "--num_proc", str(np_),
+        ])
+        meta = json.loads((out / "meta.json").read_text())
+        outs.append(np.concatenate([
+            np.fromfile(out / s, np.uint16) for s in meta["shards"]
+        ]))
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_tokenized_bins_feed_token_dataset(tok, tmp_path):
+    from distributed_lion_tpu.cli.tokenize_corpus import main
+    from distributed_lion_tpu.data.sources import TokenDataset
+
+    tok.save(str(tmp_path / "tok"))
+    _write_corpus(tmp_path)
+    out = tmp_path / "bins"
+    main([
+        "--text", str(tmp_path / "*.txt"), "--tokenizer", f"bpe:{tmp_path/'tok'}",
+        "--output_dir", str(out), "--num_proc", "1",
+    ])
+    meta = json.loads((out / "meta.json").read_text())
+    ds = TokenDataset.from_bin(out / meta["shards"][0], block_size=16)
+    assert len(ds) > 0 and ds.blocks.shape[1] == 16
+    # first block must replay the first doc's tokens
+    first_doc = tok.encode(CORPUS[0], add_eos=True)
+    np.testing.assert_array_equal(np.asarray(ds.blocks[0], np.int32),
+                                  np.asarray(first_doc[:16], np.int32))
