@@ -38,6 +38,9 @@ class ModelArguments:
     # dir / .safetensors / .bin / .npz) → finetune from pretrained weights,
     # the reference's from_pretrained path (run_clm.py:425-444). Overrides
     # model_name's architecture with the checkpoint's.
+    hf_export: Optional[str] = None  # also write the final model as an HF
+    # save_pretrained directory (models/hf_export) — the reference's
+    # save_model output format (run_clm.py:611-622)
     vocab_size: Optional[int] = None  # default: tokenizer/model default
     n_ctx: Optional[int] = None
     dropout: float = 0.0
@@ -272,6 +275,11 @@ def main(argv=None):
             model_cfg = dataclasses.replace(model_cfg, vocab_size=tok_vocab)
     if model_args.n_ctx:
         model_cfg = dataclasses.replace(model_cfg, n_ctx=model_args.n_ctx)
+    if model_args.hf_export and model_cfg.moe_experts > 0:
+        # fail BEFORE spending the training budget: MoE blocks have no HF
+        # GPT-2 equivalent (models/hf_export raises the same at save time)
+        raise ValueError("--hf_export is incompatible with --moe_experts: "
+                         "MoE blocks have no HF GPT-2 equivalent")
     if train_cfg.block_size > model_cfg.n_ctx:
         # run_clm.py:491-506 caps block_size at the model context length.
         print(f"[run_clm] capping block_size {train_cfg.block_size} -> n_ctx {model_cfg.n_ctx}")
@@ -295,17 +303,28 @@ def main(argv=None):
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:
             trainer.save()
-        if train_cfg.output_dir:
-            # portable single-file export (HF save_pretrained role) —
-            # consumed by cli/run_generate
-            from distributed_lion_tpu.utils.serialization import save_pytree
-
+        if train_cfg.output_dir or model_args.hf_export:
             export = trainer.params
             if train_cfg.pipeline_parallel > 1:
                 from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
 
                 export = unpipeline_params(export, model_cfg.n_layer)
+        if train_cfg.output_dir:
+            # portable single-file export (HF save_pretrained role) —
+            # consumed by cli/run_generate
+            from distributed_lion_tpu.utils.serialization import save_pytree
+
             save_pytree(f"{train_cfg.output_dir}/model.npz", export)
+        if model_args.hf_export:
+            # HF save_pretrained layout (run_clm.py:611-622's save_model;
+            # loadable by GPT2LMHeadModel.from_pretrained) — dense
+            # architectures only (guarded before training starts)
+            import jax
+
+            from distributed_lion_tpu.models.hf_export import gpt2_to_hf
+
+            gpt2_to_hf(jax.device_get(export), model_cfg, model_args.hf_export)
+            print(f"[run_clm] HF-format checkpoint at {model_args.hf_export}")
     finally:
         trainer.close()
 

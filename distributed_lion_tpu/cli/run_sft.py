@@ -45,7 +45,10 @@ class SFTArguments:
     group_by_length: bool = False
     gradient_checkpointing: bool = False
     tokenizer_name: Optional[str] = None
-    merged_output: Optional[str] = None  # save merged model here
+    merged_output: Optional[str] = None  # save the LoRA-merged model here:
+    # a *.npz path → flat save_pytree archive (cli/run_generate's format);
+    # any other path → an HF save_pretrained directory
+    # (LlamaForCausalLM.from_pretrained-loadable, models/hf_export)
 
 
 def main(argv=None):
@@ -257,7 +260,16 @@ def main(argv=None):
             from distributed_lion_tpu.ops.quant import dequantize_tree
 
             merged = dequantize_tree(merge_lora(base_params, trainer.params, lora_cfg))
-            save_pytree(script_args.merged_output, merged)
+            if script_args.merged_output.endswith(".npz"):
+                save_pytree(script_args.merged_output, merged)
+            else:
+                # HF save_pretrained layout — loadable by
+                # LlamaForCausalLM.from_pretrained, the format the
+                # reference's merge flow emits (sft_llama2.py:196-199)
+                from distributed_lion_tpu.models.hf_export import llama_to_hf
+
+                llama_to_hf(jax.device_get(merged), model_cfg,
+                            script_args.merged_output)
             print(f"[run_sft] merged model saved to {script_args.merged_output}")
     finally:
         trainer.close()

@@ -1,0 +1,164 @@
+"""Export trained pytrees back to the HF ``save_pretrained`` layout.
+
+The reference ends every workload by writing an HF checkpoint — Trainer's
+``save_model`` (/root/reference/run_clm.py:611-622), the SFT merge flow
+(sft_llama2.py:183-199: save → reload → ``merge_and_unload`` → save merged),
+and optionally ``push_to_hub`` (run_clm.py:650-653). Push is out of scope
+(zero egress), but the *format* isn't: this module is the exact inverse of
+models/hf_import — same Conv1D orientation, q|k|v packing, RoPE
+interleaved → half-rotation permutation, tied-head handling — so a model
+trained here loads straight into ``GPT2LMHeadModel.from_pretrained`` /
+``LlamaForCausalLM.from_pretrained`` (pinned by tests/test_hf_export.py,
+which round-trips through the torch models' own logits).
+
+Weights are written as ``model.safetensors`` (via torch tensors, so bf16
+survives) with a ``config.json``; quantized (NF4/int8) frozen bases must be
+dequantized first (ops/quant.dequantize_tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+
+def _to_numpy(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def _save_state_dict(sd: dict, path: str, config: dict) -> None:
+    """{name: np.ndarray} → model.safetensors + config.json under path."""
+    import torch
+
+    os.makedirs(path, exist_ok=True)
+    tensors = {}
+    for k, v in sd.items():
+        arr = np.ascontiguousarray(v)
+        if arr.dtype.name == "bfloat16":  # ml_dtypes bf16 → torch bf16
+            t = torch.from_numpy(arr.view(np.uint16).copy()).view(torch.bfloat16)
+        else:
+            t = torch.from_numpy(arr.copy())
+        tensors[k] = t
+    try:
+        from safetensors.torch import save_file
+
+        save_file(tensors, os.path.join(path, "model.safetensors"))
+    except ImportError:  # pragma: no cover
+        torch.save(tensors, os.path.join(path, "pytorch_model.bin"))
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config, f, indent=1)
+
+
+# ----------------------------------------------------------------------- GPT-2
+
+def gpt2_to_hf(params: dict, cfg: Any, path: str) -> None:
+    """Our GPT-2 pytree → an HF ``GPT2LMHeadModel`` checkpoint directory.
+
+    Inverse of hf_import.gpt2_from_hf: stacked qkv [d, 3, d] flattens to
+    Conv1D's c_attn [d, 3d]; the lm_head is tied to wte (GPT-2 convention),
+    so only ``transformer.*`` weights are written.
+    """
+    p = _to_numpy(params)
+    d = cfg.d_model
+    sd = {
+        "transformer.wte.weight": p["wte"],
+        "transformer.wpe.weight": p["wpe"],
+        "transformer.ln_f.weight": p["ln_f"]["scale"],
+        "transformer.ln_f.bias": p["ln_f"]["bias"],
+    }
+    for i, blk in enumerate(p["blocks"]):
+        if "moe" in blk:
+            raise ValueError(
+                "MoE blocks have no HF GPT-2 equivalent; export is for the "
+                "dense reference architecture"
+            )
+        h = f"transformer.h.{i}"
+        sd[f"{h}.ln_1.weight"] = blk["ln_1"]["scale"]
+        sd[f"{h}.ln_1.bias"] = blk["ln_1"]["bias"]
+        sd[f"{h}.attn.c_attn.weight"] = blk["attn"]["qkv"].reshape(d, 3 * d)
+        sd[f"{h}.attn.c_attn.bias"] = blk["attn"]["qkv_b"].reshape(3 * d)
+        sd[f"{h}.attn.c_proj.weight"] = blk["attn"]["proj"]
+        sd[f"{h}.attn.c_proj.bias"] = blk["attn"]["proj_b"]
+        sd[f"{h}.ln_2.weight"] = blk["ln_2"]["scale"]
+        sd[f"{h}.ln_2.bias"] = blk["ln_2"]["bias"]
+        sd[f"{h}.mlp.c_fc.weight"] = blk["mlp"]["fc"]
+        sd[f"{h}.mlp.c_fc.bias"] = blk["mlp"]["fc_b"]
+        sd[f"{h}.mlp.c_proj.weight"] = blk["mlp"]["proj"]
+        sd[f"{h}.mlp.c_proj.bias"] = blk["mlp"]["proj_b"]
+    config = {
+        "model_type": "gpt2",
+        "architectures": ["GPT2LMHeadModel"],
+        "vocab_size": int(cfg.vocab_size),
+        "n_layer": int(cfg.n_layer),
+        "n_head": int(cfg.n_head),
+        "n_embd": int(cfg.d_model),
+        "n_positions": int(cfg.n_ctx),
+        "n_ctx": int(cfg.n_ctx),
+        "tie_word_embeddings": True,
+    }
+    _save_state_dict(sd, path, config)
+
+
+# ----------------------------------------------------------------------- Llama
+
+def _rope_from_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
+    """Inverse of hf_import._rope_to_interleaved: per head, channel 2i goes
+    back to slot i and channel 2i+1 to slot i + hd/2 (HF's half-rotation
+    layout)."""
+    out, d_in = w_out_in.shape
+    hd = out // n_heads
+    w = w_out_in.reshape(n_heads, hd // 2, 2, d_in)
+    return np.ascontiguousarray(w.transpose(0, 2, 1, 3)).reshape(out, d_in)
+
+
+def llama_to_hf(params: dict, cfg: Any, path: str) -> None:
+    """Our Llama pytree → an HF ``LlamaForCausalLM`` checkpoint directory.
+
+    Inverse of hf_import.llama_from_hf: [in, out] matmul weights transpose
+    back to Linear's [out, in]; q/k projections un-permute from interleaved
+    to half-rotation RoPE; a tied head (lm_head == wte.T) is detected and
+    omitted with ``tie_word_embeddings``.
+    """
+    p = _to_numpy(params)
+    tied = (p["lm_head"].shape == p["wte"].T.shape
+            and np.array_equal(p["lm_head"], p["wte"].T))
+    sd = {
+        "model.embed_tokens.weight": p["wte"],
+        "model.norm.weight": p["ln_f"]["scale"],
+    }
+    if not tied:
+        sd["lm_head.weight"] = np.ascontiguousarray(p["lm_head"].T)
+    for i, blk in enumerate(p["blocks"]):
+        L = f"model.layers.{i}"
+        a, m = blk["attn"], blk["mlp"]
+        sd[f"{L}.input_layernorm.weight"] = blk["ln_attn"]["scale"]
+        sd[f"{L}.self_attn.q_proj.weight"] = _rope_from_interleaved(
+            np.ascontiguousarray(a["wq"].T), cfg.n_head)
+        sd[f"{L}.self_attn.k_proj.weight"] = _rope_from_interleaved(
+            np.ascontiguousarray(a["wk"].T), cfg.n_kv_head)
+        sd[f"{L}.self_attn.v_proj.weight"] = np.ascontiguousarray(a["wv"].T)
+        sd[f"{L}.self_attn.o_proj.weight"] = np.ascontiguousarray(a["wo"].T)
+        sd[f"{L}.post_attention_layernorm.weight"] = blk["ln_mlp"]["scale"]
+        sd[f"{L}.mlp.gate_proj.weight"] = np.ascontiguousarray(m["w_gate"].T)
+        sd[f"{L}.mlp.up_proj.weight"] = np.ascontiguousarray(m["w_up"].T)
+        sd[f"{L}.mlp.down_proj.weight"] = np.ascontiguousarray(m["w_down"].T)
+    config = {
+        "model_type": "llama",
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": int(cfg.vocab_size),
+        "num_hidden_layers": int(cfg.n_layer),
+        "num_attention_heads": int(cfg.n_head),
+        "num_key_value_heads": int(cfg.n_kv_head),
+        "hidden_size": int(cfg.d_model),
+        "intermediate_size": int(cfg.d_ff),
+        "max_position_embeddings": int(cfg.n_ctx),
+        "rope_theta": float(cfg.rope_theta),
+        "rms_norm_eps": float(cfg.rms_eps),
+        "tie_word_embeddings": bool(tied),
+    }
+    _save_state_dict(sd, path, config)

@@ -1,0 +1,173 @@
+"""HF-format export: trained pytrees load back into the torch models.
+
+The inverse of tests/test_hf_import.py and the closing step of every
+reference workload (save_model / save merged, run_clm.py:611-622,
+sft_llama2.py:183-199): export our params with models/hf_export, load them
+with ``from_pretrained`` (local dir, no network), and demand the torch
+model's logits match ours — pinning the Conv1D orientation, q|k|v
+flattening, RoPE interleaved→half-rotation inverse, and tied-head handling.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_lion_tpu.models.hf_export import gpt2_to_hf, llama_to_hf  # noqa: E402
+from distributed_lion_tpu.models.hf_import import (  # noqa: E402
+    gpt2_from_hf,
+    llama_from_hf,
+)
+
+
+def _tokens(vocab, rng_seed=0, shape=(2, 16)):
+    rng = np.random.default_rng(rng_seed)
+    return rng.integers(0, vocab, size=shape, dtype=np.int64)
+
+
+def test_gpt2_export_torch_parity(tmp_path):
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_apply, gpt2_init
+
+    cfg = GPT2Config.tiny(remat=False, compute_dtype=jnp.float32)
+    params = gpt2_init(jax.random.key(0), cfg)
+    gpt2_to_hf(params, cfg, str(tmp_path / "export"))
+
+    hf_model = transformers.GPT2LMHeadModel.from_pretrained(
+        str(tmp_path / "export")).eval()
+    tokens = _tokens(cfg.vocab_size)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = np.asarray(gpt2_apply(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gpt2_roundtrip_exact(tmp_path):
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(1), cfg)
+    gpt2_to_hf(params, cfg, str(tmp_path / "rt"))
+    back, cfg2 = gpt2_from_hf(str(tmp_path / "rt"))
+    assert (cfg2.n_layer, cfg2.n_head, cfg2.d_model, cfg2.vocab_size,
+            cfg2.n_ctx) == (cfg.n_layer, cfg.n_head, cfg.d_model,
+                            cfg.vocab_size, cfg.n_ctx)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_llama_export_torch_parity_untied(tmp_path):
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    params = llama_init(jax.random.key(2), cfg)
+    llama_to_hf(params, cfg, str(tmp_path / "export"))
+
+    hf_model = transformers.LlamaForCausalLM.from_pretrained(
+        str(tmp_path / "export")).eval()
+    tokens = _tokens(cfg.vocab_size, rng_seed=3)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(tokens)).logits.numpy()
+    got = np.asarray(llama_apply(params, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_llama_roundtrip_tied_head(tmp_path):
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(4), cfg)
+    params["lm_head"] = jnp.asarray(np.asarray(params["wte"]).T)  # tie
+    llama_to_hf(params, cfg, str(tmp_path / "tied"))
+    import json
+    hf_cfg = json.loads((tmp_path / "tied" / "config.json").read_text())
+    assert hf_cfg["tie_word_embeddings"] is True
+    back, cfg2 = llama_from_hf(str(tmp_path / "tied"))
+    assert cfg2.n_kv_head == cfg.n_kv_head
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bf16_survives_roundtrip(tmp_path):
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = GPT2Config.tiny(param_dtype=jnp.bfloat16)
+    params = gpt2_init(jax.random.key(5), cfg)
+    gpt2_to_hf(params, cfg, str(tmp_path / "bf16"))
+    back, _ = gpt2_from_hf(str(tmp_path / "bf16"), param_dtype=jnp.bfloat16)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(
+            np.asarray(a).view(np.uint16), np.asarray(b).view(np.uint16))
+
+
+def test_moe_export_refused(tmp_path):
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = GPT2Config.tiny(moe_experts=2)
+    params = gpt2_init(jax.random.key(6), cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        gpt2_to_hf(params, cfg, str(tmp_path / "moe"))
+
+
+def test_run_clm_hf_export_flag(tmp_path):
+    """run_clm --hf_export writes a from_pretrained-loadable directory."""
+    from distributed_lion_tpu.cli.run_clm import main
+
+    out = tmp_path / "out"
+    exp = tmp_path / "hf"
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--block_size", "32",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000", "--output_dir", str(out), "--hf_export", str(exp),
+        "--param_dtype", "float32",
+    ])
+    model = transformers.GPT2LMHeadModel.from_pretrained(str(exp))
+    assert model.config.n_layer == 2
+
+
+def test_run_sft_merged_hf_output(tmp_path):
+    """run_sft --merged_output <dir> lands the merged model in HF format
+    (the reference's merge_and_unload → save flow)."""
+    from distributed_lion_tpu.cli.run_sft import main
+
+    merged = tmp_path / "merged_hf"
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
+        "--num_train_samples", "32", "--size_valid_set", "4",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000", "--merged_output", str(merged),
+    ])
+    model = transformers.LlamaForCausalLM.from_pretrained(str(merged))
+    assert model.config.num_hidden_layers == 2
+
+
+def test_sft_merged_model_exports(tmp_path):
+    """The reference's closing flow: LoRA-SFT → merge → save (sft_llama2.py:
+    183-199) lands in an HF-loadable directory."""
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+    from distributed_lion_tpu.models.lora import (
+        LoraConfig,
+        lora_init,
+        merge_lora,
+    )
+
+    cfg = LlamaConfig.tiny()
+    base = llama_init(jax.random.key(7), cfg)
+    lcfg = LoraConfig(r=4, alpha=8)
+    adapters = lora_init(jax.random.key(8), base, lcfg)
+    merged = merge_lora(base, adapters, lcfg)
+    llama_to_hf(merged, cfg, str(tmp_path / "merged"))
+    back, _ = llama_from_hf(str(tmp_path / "merged"))
+    for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(back)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
