@@ -40,6 +40,8 @@ class GPT2Config:
     n_ctx: int = 1024
     dropout: float = 0.0
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash
+    flash_block_q: int = 0   # flash kernel tile overrides (0 = defaults);
+    flash_block_kv: int = 0  # see ops.attention.attention_flash
     remat: bool = True  # rematerialize blocks (HBM for FLOPs); turn off when
                         # activations fit — backward skips the fwd recompute
     param_dtype: Any = jnp.float32
@@ -200,7 +202,9 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
 
         out = ring_attention(q, k, v, axis_name=seq_axis)
     else:
-        out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
+        out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl,
+                               block_q=cfg.flash_block_q,
+                               block_kv=cfg.flash_block_kv)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _proj(out, p["proj"])
     if tp_axis is not None:

@@ -35,19 +35,41 @@ def attention_xla(q, k, v, *, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def attention_flash(q, k, v, *, causal: bool = True):
-    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+def attention_flash(q, k, v, *, causal: bool = True,
+                    block_q: int = 0, block_kv: int = 0):
+    """Pallas TPU flash attention. ``block_q``/``block_kv`` override the
+    kernel's VMEM tile sizes (0 = library defaults); exposed because the
+    default blocking lost to XLA at T=1024 on v5e (scripts/SWEEP_v5e.md) and
+    tile shape is the first knob to turn."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
 
+    T = q.shape[2]
+    bs = None
+    if block_q or block_kv:
+        bq = min(block_q or 512, T)
+        bkv = min(block_kv or 512, T)
+        bs = BlockSizes(
+            block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+            block_q_major_dkv=bq, block_k_major_dkv=bkv, block_k_dkv=bkv,
+            block_q_dkv=bq, block_k_major_dq=bkv, block_k_dq=bkv,
+            block_q_dq=bq,
+        )
     return flash_attention(
-        q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(q.shape[-1])
+        q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(q.shape[-1]),
+        block_sizes=bs,
     ).astype(q.dtype)
 
 
-def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
+              block_q: int = 0, block_kv: int = 0):
     if impl == "auto":
         impl = "flash" if (jax.default_backend() == "tpu" and q.shape[2] >= 2048) else "xla"
     if impl == "flash":
-        return attention_flash(q, k, v, causal=causal)
+        return attention_flash(q, k, v, causal=causal,
+                               block_q=block_q, block_kv=block_kv)
     if impl == "xla":
         return attention_xla(q, k, v, causal=causal)
     raise ValueError(f"unknown attention impl {impl!r}")

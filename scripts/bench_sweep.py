@@ -35,8 +35,15 @@ def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
 
     n_dev = len(jax.devices())
     mesh = make_mesh()
+    # attn spec "flash@256x512" → flash with block_q=256, block_kv=512
+    attn_spec = attn_impl
+    bq = bkv = 0
+    if "@" in attn_impl:
+        attn_impl, blocks = attn_impl.split("@", 1)
+        bq, bkv = (int(x) for x in blocks.split("x"))
     model_cfg = dataclasses.replace(
         GPT2Config.gpt2_124m(), remat=remat, attn_impl=attn_impl,
+        flash_block_q=bq, flash_block_kv=bkv,
         param_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
     )
     cfg = TrainConfig(
@@ -71,7 +78,7 @@ def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
     steps = K * N_CHUNKS
     tps = tokens_per_step * steps / dt / n_dev
     print(json.dumps({
-        "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_impl,
+        "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_spec,
         "accum": accum, "dtype": dtype, "vocab_chunks": vocab_chunks,
         "ms_per_step": round(dt / steps * 1e3, 1), "loss": round(final_loss, 3),
         "tokens_per_sec_per_chip": round(tps, 1),
