@@ -321,9 +321,26 @@ def main(argv=None):
             # architectures only (guarded before training starts)
             import jax
 
-            from distributed_lion_tpu.models.hf_export import gpt2_to_hf
+            from distributed_lion_tpu.models.hf_export import (
+                gpt2_to_hf,
+                write_model_card,
+            )
 
             gpt2_to_hf(jax.device_get(export), model_cfg, model_args.hf_export)
+            write_model_card(
+                model_args.hf_export, model_type="gpt2",
+                train_summary={
+                    "optimizer": "distributed-lion" if train_cfg.lion else "adamw",
+                    "async_grad": train_cfg.async_grad,
+                    "wire": train_cfg.wire,
+                    "steps": train_cfg.max_steps,
+                    "learning_rate": train_cfg.learning_rate,
+                    "weight_decay": train_cfg.weight_decay,
+                    "global_batch": trainer.global_train_batch(),
+                    "block_size": train_cfg.block_size,
+                    "n_params": trainer.n_params,
+                },
+            )
             print(f"[run_clm] HF-format checkpoint at {model_args.hf_export}")
     finally:
         trainer.close()

@@ -106,6 +106,30 @@ def gpt2_to_hf(params: dict, cfg: Any, path: str) -> None:
 
 # ----------------------------------------------------------------------- Llama
 
+def write_model_card(path: str, *, model_type: str, train_summary: dict) -> None:
+    """Write a README.md model card next to the exported weights.
+
+    The reference ends run_clm with ``trainer.create_model_card`` /
+    ``push_to_hub`` (run_clm.py:650-653); push is out of scope (zero
+    egress), the card isn't. ``train_summary`` is free-form config/metric
+    key-values rendered as a table.
+    """
+    os.makedirs(path, exist_ok=True)
+    lines = [
+        f"# {model_type} — trained with distributed_lion_tpu",
+        "",
+        "Trained with majority-vote **Distributed Lion** "
+        "(arXiv:2404.00438) on TPU via JAX/XLA.",
+        "",
+        "| key | value |",
+        "|---|---|",
+    ]
+    lines += [f"| {k} | {v} |" for k, v in train_summary.items()]
+    lines.append("")
+    with open(os.path.join(path, "README.md"), "w") as f:
+        f.write("\n".join(lines))
+
+
 def _rope_from_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
     """Inverse of hf_import._rope_to_interleaved: per head, channel 2i goes
     back to slot i and channel 2i+1 to slot i + hd/2 (HF's half-rotation

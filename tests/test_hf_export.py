@@ -131,6 +131,8 @@ def test_run_clm_hf_export_flag(tmp_path):
     ])
     model = transformers.GPT2LMHeadModel.from_pretrained(str(exp))
     assert model.config.n_layer == 2
+    card = (exp / "README.md").read_text()
+    assert "Distributed Lion" in card and "| wire |" in card
 
 
 def test_run_sft_merged_hf_output(tmp_path):
