@@ -41,7 +41,9 @@ class DPOArguments:
     lora_r: int = 8
     lora_alpha: int = 16
     tokenizer_name: Optional[str] = None
-    merged_output: Optional[str] = None
+    merged_output: Optional[str] = None  # save the LoRA-merged policy here:
+    # *.npz → flat save_pytree archive; any other path → HF save_pretrained
+    # directory (models/hf_export)
 
 
 def main(argv=None):
@@ -194,7 +196,16 @@ def main(argv=None):
             trainer.save()
         if script_args.merged_output:
             merged = dequantize_tree(merge_lora(base_params, trainer.params, lora_cfg))
-            save_pytree(script_args.merged_output, merged)
+            if script_args.merged_output.endswith(".npz"):
+                save_pytree(script_args.merged_output, merged)
+            else:
+                # HF save_pretrained layout, like run_sft's merge flow
+                import jax
+
+                from distributed_lion_tpu.models.hf_export import llama_to_hf
+
+                llama_to_hf(jax.device_get(merged), model_cfg,
+                            script_args.merged_output)
             print(f"[run_dpo] merged policy saved to {script_args.merged_output}")
     finally:
         trainer.close()

@@ -153,6 +153,23 @@ def test_run_sft_merged_hf_output(tmp_path):
     assert model.config.num_hidden_layers == 2
 
 
+def test_run_dpo_merged_hf_output(tmp_path):
+    """run_dpo --merged_output <dir> lands the merged policy in HF format."""
+    from distributed_lion_tpu.cli.run_dpo import main
+
+    merged = tmp_path / "dpo_hf"
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--max_length", "64",
+        "--num_train_samples", "32", "--size_valid_set", "4",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000", "--merged_output", str(merged),
+    ])
+    model = transformers.LlamaForCausalLM.from_pretrained(str(merged))
+    assert model.config.num_hidden_layers == 2
+
+
 def test_sft_merged_model_exports(tmp_path):
     """The reference's closing flow: LoRA-SFT → merge → save (sft_llama2.py:
     183-199) lands in an HF-loadable directory."""
