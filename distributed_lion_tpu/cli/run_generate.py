@@ -20,7 +20,9 @@ from typing import Optional
 
 @dataclasses.dataclass
 class GenerateArguments:
-    model_path: Optional[str] = None  # .npz from utils.serialization (else random init)
+    model_path: Optional[str] = None  # .npz from utils.serialization, or an
+    # HF save_pretrained directory (hf_export/--merged_output output, family
+    # auto-detected); unset → random init (smoke mode)
     model_family: str = "gpt2"  # gpt2 | llama
     model_name: str = "tiny"    # gpt2: gpt2_124m | tiny; llama: llama2_7b | llama3_8b | tiny
     tokenizer_name: Optional[str] = None  # HF cache name; byte tokenizer otherwise
@@ -32,6 +34,12 @@ class GenerateArguments:
     vocab_size: Optional[int] = None
 
 
+def _is_hf_dir(path: Optional[str]) -> bool:
+    import os
+
+    return bool(path) and os.path.isdir(path)
+
+
 def build(args: GenerateArguments):
     import jax
 
@@ -41,15 +49,31 @@ def build(args: GenerateArguments):
     tok = load_tokenizer(args.tokenizer_name)
     vocab = args.vocab_size or tok.vocab_size
 
+    hf_params = hf_cfg = None
+    if _is_hf_dir(args.model_path):
+        # an HF save_pretrained directory (e.g. run_clm --hf_export or
+        # run_sft --merged_output <dir>): import it, family auto-detected
+        from distributed_lion_tpu.models import hf_import
+
+        family = hf_import.detect_family(args.model_path)
+        if family != args.model_family:
+            print(f"[run_generate] --model_family {args.model_family} -> "
+                  f"{family} (detected from checkpoint)")
+            args.model_family = family
+        loader = (hf_import.gpt2_from_hf if family == "gpt2"
+                  else hf_import.llama_from_hf)
+        hf_params, hf_cfg = loader(args.model_path)
+
     if args.model_family == "gpt2":
         from distributed_lion_tpu.models.gpt2 import (
             GPT2Config, gpt2_decode, gpt2_init, gpt2_init_cache,
         )
 
-        cfg = (GPT2Config.tiny if args.model_name == "tiny" else GPT2Config.gpt2_124m)(
-            vocab_size=vocab
-        )
-        params = (load_pytree(args.model_path) if args.model_path
+        cfg = hf_cfg or (
+            GPT2Config.tiny if args.model_name == "tiny" else GPT2Config.gpt2_124m
+        )(vocab_size=vocab)
+        params = (hf_params if hf_params is not None
+                  else load_pytree(args.model_path) if args.model_path
                   else gpt2_init(jax.random.key(args.seed), cfg))
         decode = partial(lambda c, p, t, k, pos: gpt2_decode(p, t, c, k, pos), cfg)
         init_cache = partial(gpt2_init_cache, cfg)
@@ -60,8 +84,9 @@ def build(args: GenerateArguments):
 
         factory = {"tiny": LlamaConfig.tiny, "llama2_7b": LlamaConfig.llama2_7b,
                    "llama3_8b": LlamaConfig.llama3_8b}[args.model_name]
-        cfg = factory(vocab_size=vocab)
-        params = (load_pytree(args.model_path) if args.model_path
+        cfg = hf_cfg or factory(vocab_size=vocab)
+        params = (hf_params if hf_params is not None
+                  else load_pytree(args.model_path) if args.model_path
                   else llama_init(jax.random.key(args.seed), cfg))
         decode = partial(lambda c, p, t, k, pos: llama_decode(p, t, c, k, pos), cfg)
         init_cache = partial(llama_init_cache, cfg)

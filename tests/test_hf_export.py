@@ -153,6 +153,23 @@ def test_run_sft_merged_hf_output(tmp_path):
     assert model.config.num_hidden_layers == 2
 
 
+def test_run_generate_from_hf_dir(tmp_path, capsys):
+    """run_generate consumes an exported HF directory directly (family
+    auto-detected), closing the train → export → use cycle."""
+    from distributed_lion_tpu.cli.run_generate import main
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(9), cfg)
+    gpt2_to_hf(params, cfg, str(tmp_path / "hf"))
+    main([
+        "--model_path", str(tmp_path / "hf"), "--model_family", "llama",
+        "--prompt", "ab", "--max_new_tokens", "4", "--temperature", "0",
+    ])
+    outerr = capsys.readouterr()
+    assert "detected from checkpoint" in outerr.out  # llama -> gpt2 autocorrect
+
+
 def test_run_dpo_merged_hf_output(tmp_path):
     """run_dpo --merged_output <dir> lands the merged policy in HF format."""
     from distributed_lion_tpu.cli.run_dpo import main
