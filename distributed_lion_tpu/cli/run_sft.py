@@ -95,7 +95,27 @@ def main(argv=None):
             "sharded along the original weight dims. Use a bf16/f32 frozen "
             "base with TP, or quantize under pure data parallelism."
         )
-    mesh = build_mesh(train_cfg.tensor_parallel)
+    sp = train_cfg.seq_parallel
+    if sp > 1:
+        # long-context SFT: packed rows sharded over tokens, ring attention
+        # over the 'seq' axis; boundary labels ride a ppermute
+        # (models/loss.clm_loss_seq_parallel)
+        if not script_args.packing:
+            raise NotImplementedError(
+                "--seq_parallel needs --packing: padded/masked per-example "
+                "rows are not wired across sequence shards"
+            )
+        if train_cfg.tensor_parallel > 1:
+            raise NotImplementedError(
+                "--tensor_parallel x --seq_parallel on the SFT path is not "
+                "wired; pick one"
+            )
+        if train_cfg.vocab_chunks > 0:
+            raise NotImplementedError(
+                "--vocab_chunks under --seq_parallel is not wired on the SFT "
+                "path (the boundary-label exchange lives in the dense loss)"
+            )
+    mesh = build_mesh(train_cfg.tensor_parallel, sp)
     tok = load_tokenizer(script_args.tokenizer_name)
 
     if script_args.dataset == "synthetic":
@@ -132,6 +152,13 @@ def main(argv=None):
         model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
     if script_args.seq_length > model_cfg.n_ctx:
         script_args.seq_length = model_cfg.n_ctx
+    if sp > 1 and script_args.seq_length % sp:
+        # checked AFTER the n_ctx clamp so the validated value is the one
+        # the packed rows actually use
+        raise ValueError(
+            f"--seq_length {script_args.seq_length} (after the n_ctx clamp) "
+            f"must divide evenly over the {sp}-way seq axis"
+        )
     train_cfg.block_size = script_args.seq_length
 
     if not script_args.model_path:
@@ -200,6 +227,21 @@ def main(argv=None):
         trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                           param_specs=adapter_specs, loss_fn=loss_fn,
                           frozen_params=base_params, frozen_specs=base_specs)
+    elif sp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
+        from distributed_lion_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+        def loss_fn(params, batch, dropout_key):
+            # batch is this shard's contiguous token chunk [B, T/sp]
+            effective = apply_adapters(base_params, params, lora_cfg)
+            logits = llama_apply(effective, batch, model_cfg, seq_axis=SEQ_AXIS)
+            return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
+        trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                          loss_fn=loss_fn,
+                          batch_spec=P(DATA_AXIS, SEQ_AXIS))
     else:
         def loss_fn(params, batch, dropout_key):
             tokens, mask = _split_batch(batch)
