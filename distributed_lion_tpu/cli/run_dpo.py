@@ -71,7 +71,13 @@ def main(argv=None):
             "wired (QuantizedTensor leaves cannot shard along weight dims); "
             "use a bf16/f32 ref with TP or quantize under data parallelism."
         )
-    mesh = build_mesh(train_cfg.tensor_parallel)
+    sp = train_cfg.seq_parallel
+    if sp > 1 and train_cfg.tensor_parallel > 1:
+        raise NotImplementedError(
+            "--tensor_parallel x --seq_parallel on the DPO path is not "
+            "wired; pick one"
+        )
+    mesh = build_mesh(train_cfg.tensor_parallel, sp)
     tok = load_tokenizer(script_args.tokenizer_name)
 
     pretrained_params = None
@@ -90,6 +96,12 @@ def main(argv=None):
         model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
     if script_args.max_length > model_cfg.n_ctx:
         script_args.max_length = model_cfg.n_ctx
+    if sp > 1 and script_args.max_length % sp:
+        # checked after the n_ctx clamp: the padded rows use this value
+        raise ValueError(
+            f"--max_length {script_args.max_length} (after the n_ctx clamp) "
+            f"must divide evenly over the {sp}-way seq axis"
+        )
     train_cfg.block_size = script_args.max_length
 
     # Policy and reference both start from the SFT model (dpo_llama2.py:133-152).
@@ -152,6 +164,24 @@ def main(argv=None):
             beta=script_args.beta,
         )
         adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
+    elif sp > 1:
+        # long-context DPO: chosen/rejected rows sharded over tokens — ring
+        # attention through policy and frozen ref, per-shard logprob partials
+        # psum'd before the pairwise sigmoid (train/dpo.py)
+        from distributed_lion_tpu.parallel.mesh import SEQ_AXIS
+
+        policy_apply_lora = lora_apply_fn(
+            lambda p, t: llama_apply(p, t, model_cfg, seq_axis=SEQ_AXIS),
+            base_params, lora_cfg,
+        )
+        loss_fn = make_dpo_loss_fn(
+            policy_apply=policy_apply_lora,
+            ref_apply=lambda t: llama_apply(ref_params, t, model_cfg,
+                                            seq_axis=SEQ_AXIS),
+            beta=script_args.beta,
+            seq_axis=SEQ_AXIS,
+        )
+        adapter_specs = None
     else:
         policy_apply_lora = lora_apply_fn(
             lambda p, t: llama_apply(p, t, model_cfg), base_params, lora_cfg
@@ -184,9 +214,17 @@ def main(argv=None):
     print(f"[run_dpo] {len(train_data['chosen'])} train / {n_valid} eval pairs "
           f"(after length filtering)")
 
+    batch_spec = None
+    if sp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_lion_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+        batch_spec = P(DATA_AXIS, SEQ_AXIS)  # every [B, T] leaf token-sharded
     trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                       loss_fn=loss_fn, param_specs=adapter_specs,
-                      frozen_params=frozen_params, frozen_specs=frozen_specs)
+                      frozen_params=frozen_params, frozen_specs=frozen_specs,
+                      batch_spec=batch_spec)
     it = dpo_batch_iterator(train_data, trainer.global_train_batch(), seed=train_cfg.seed)
     try:
         trainer.train(it, eval_blocks=eval_data)

@@ -89,6 +89,24 @@ def clm_loss_sharded_rows(
     return loss_local, metrics
 
 
+def shift_in_next_shard(
+    x: jnp.ndarray, axis_name: str
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The seq-parallel shard-boundary protocol, in one place: shift a
+    [B, T_local] array left by one column, filling the last column with the
+    NEXT shard's first column via a single [B, 1] ``ppermute``. Returns
+    ``(shifted, is_last_shard)`` — the final shard's fill is garbage (wraps
+    to shard 0) and must be masked by the caller using the flag. Shared by
+    :func:`clm_loss_seq_parallel` and train/dpo's seq-parallel logprob so
+    the perm direction and boundary masking can't drift apart."""
+    S = jax.lax.psum(1, axis_name)
+    sidx = jax.lax.axis_index(axis_name)
+    nxt = jax.lax.ppermute(
+        x[:, :1], axis_name, [(i, (i - 1) % S) for i in range(S)]
+    )
+    return jnp.concatenate([x[:, 1:], nxt], axis=1), sidx == S - 1
+
+
 def clm_loss_seq_parallel(
     logits: jnp.ndarray,
     tokens: jnp.ndarray,
@@ -110,15 +128,11 @@ def clm_loss_seq_parallel(
     are globally reduced (identical on every shard).
     """
     S = jax.lax.psum(1, axis_name)
-    sidx = jax.lax.axis_index(axis_name)
     # my last position's label = next shard's first token (shard i gets it
     # from shard i+1; shard S-1 receives garbage from shard 0 and masks it)
-    nxt = jax.lax.ppermute(
-        tokens[:, :1], axis_name, [(i, (i - 1) % S) for i in range(S)]
-    )
-    labels = jnp.concatenate([tokens[:, 1:], nxt], axis=1)  # [B, T_local]
+    labels, is_last = shift_in_next_shard(tokens, axis_name)  # [B, T_local]
     mask = jnp.ones(labels.shape, jnp.float32)
-    mask = mask.at[:, -1].set(jnp.where(sidx == S - 1, 0.0, 1.0))
+    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
 
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]

@@ -29,26 +29,60 @@ def sequence_logprob(logits: jnp.ndarray, tokens: jnp.ndarray,
     return (ll * mask[:, 1:].astype(jnp.float32)).sum(-1)
 
 
+def sequence_logprob_seq_parallel(
+    logits: jnp.ndarray, tokens: jnp.ndarray, mask: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Seq-parallel :func:`sequence_logprob` (inside shard_map): each device
+    holds a contiguous [B, T/S] chunk of tokens/mask and ITS chunk's logits.
+    Boundary labels (and their mask bits — a label counts iff the mask at
+    the LABEL position is set, exactly like the dense path's
+    ``mask[:, 1:]``) arrive from the next shard via one [B, 1] ppermute;
+    per-shard partial sums are psum'd so every shard returns the full-
+    sequence [B] logprob — the nonlinear pairwise DPO loss downstream then
+    computes identically on every shard, and the train loop's seq-axis grad
+    psum stitches the shard-local cotangent paths into the full gradient."""
+    from distributed_lion_tpu.models.loss import shift_in_next_shard
+
+    labels, is_last = shift_in_next_shard(tokens, axis_name)
+    lmask, _ = shift_in_next_shard(mask, axis_name)
+    lmask = lmask.astype(jnp.float32)
+    # the final shard's last position has no next token (dense path drops it
+    # via logits[:, :-1])
+    lmask = lmask.at[:, -1].set(jnp.where(is_last, 0.0, lmask[:, -1]))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return jax.lax.psum((ll * lmask).sum(-1), axis_name)
+
+
 def make_dpo_loss_fn(
     policy_apply: Callable,
     ref_apply: Callable,
     beta: float = 0.1,
+    seq_axis: str | None = None,
 ) -> Callable:
     """Build ``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` for
     the Trainer. ``policy_apply(params, tokens)`` and ``ref_apply(tokens)``
     (ref params are frozen/closed-over, mirroring the reference's separate
-    4-bit ref model, dpo_llama2.py:146-152)."""
+    4-bit ref model, dpo_llama2.py:146-152). With ``seq_axis``, the batch
+    leaves are token-sharded chunks and the apply fns are expected to run
+    the model with the same seq axis (ring attention)."""
+
+    def seqlp(logits, tokens, mask):
+        if seq_axis is None:
+            return sequence_logprob(logits, tokens, mask)
+        return sequence_logprob_seq_parallel(logits, tokens, mask, seq_axis)
 
     def loss_fn(params, batch, dropout_key):
         del dropout_key
-        pol_c = sequence_logprob(policy_apply(params, batch["chosen"]),
-                                 batch["chosen"], batch["chosen_mask"])
-        pol_r = sequence_logprob(policy_apply(params, batch["rejected"]),
-                                 batch["rejected"], batch["rejected_mask"])
-        ref_c = sequence_logprob(ref_apply(batch["chosen"]),
-                                 batch["chosen"], batch["chosen_mask"])
-        ref_r = sequence_logprob(ref_apply(batch["rejected"]),
-                                 batch["rejected"], batch["rejected_mask"])
+        pol_c = seqlp(policy_apply(params, batch["chosen"]),
+                      batch["chosen"], batch["chosen_mask"])
+        pol_r = seqlp(policy_apply(params, batch["rejected"]),
+                      batch["rejected"], batch["rejected_mask"])
+        ref_c = seqlp(ref_apply(batch["chosen"]),
+                      batch["chosen"], batch["chosen_mask"])
+        ref_r = seqlp(ref_apply(batch["rejected"]),
+                      batch["rejected"], batch["rejected_mask"])
         # stop_gradient is belt-and-braces: ref_apply takes no params arg.
         ref_c = jax.lax.stop_gradient(ref_c)
         ref_r = jax.lax.stop_gradient(ref_r)

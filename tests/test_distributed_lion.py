@@ -94,6 +94,36 @@ def test_wire_paths_agree():
             np.testing.assert_array_equal(np.asarray(outs[0][k]), np.asarray(other[k]))
 
 
+def test_stochastic_composes_with_every_wire():
+    """Stochastic binarization draws ballots from (rng, count, worker) only
+    — the wire moves them. With identical draws, every flat wire (and hier
+    at its degenerate group sizes) elects identically; hier:4 stays
+    replica-consistent."""
+    mesh = make_mesh(data=8)
+    params = _params()
+    grads = _stacked_grads(8, seed=13)
+    outs = {}
+    for wire in ("sign_psum", "packed_allgather", "packed_a2a",
+                 "hier:1", "hier:8", "hier:4"):
+        opt = distributed_lion(learning_rate=0.05, wire=wire,
+                               max_grad_norm=1.0)
+        state = shard_state(
+            init_global_state(opt, params, world=8, rng=jax.random.key(42)),
+            mesh)
+        new_p, _ = _run_steps(mesh, opt, params, grads, state, n=2)
+        outs[wire] = new_p
+    for k in params:
+        base = np.asarray(outs["sign_psum"][k])
+        for wire in ("packed_allgather", "packed_a2a", "hier:1", "hier:8"):
+            np.testing.assert_array_equal(base, np.asarray(outs[wire][k]),
+                                          err_msg=wire)
+        # hier:4 may differ (majority-of-majorities) but must be replicated
+        leaf = outs["hier:4"][k]
+        shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+        for s in shards[1:]:
+            np.testing.assert_array_equal(shards[0], s)
+
+
 def test_permutation_invariance():
     mesh = make_mesh(data=8)
     params = _params()

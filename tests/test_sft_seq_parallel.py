@@ -67,14 +67,14 @@ def _train(mesh, sp, steps=8):
     history = trainer.train(iter(list(rows)), max_steps=steps)
     losses = [h["loss"] for h in history if "loss" in h]
     trainer.close()
-    return losses, trainer
+    return losses
 
 
 def test_sft_sp_trajectory_matches_pure_dp():
     mesh_sp = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
     mesh_dp = make_mesh(data=2, devices=jax.devices()[:2])
-    losses_sp, _ = _train(mesh_sp, sp=4)
-    losses_dp, _ = _train(mesh_dp, sp=1)
+    losses_sp = _train(mesh_sp, sp=4)
+    losses_dp = _train(mesh_dp, sp=1)
     assert len(losses_sp) == len(losses_dp) > 0
     np.testing.assert_allclose(losses_sp, losses_dp, rtol=2e-2, atol=2e-2)
 
@@ -86,6 +86,76 @@ def test_run_sft_cli_seq_parallel_smoke():
         "--model_name", "tiny", "--dataset", "synthetic", "--lion",
         "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
         "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
+        "--num_train_samples", "32", "--size_valid_set", "0",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000", "--seq_parallel", "4",
+    ])
+
+
+def _dpo_batches(steps, gb, T, vocab, seed=0):
+    """Random chosen/rejected pairs with realistic prompt/padding masks that
+    CROSS shard boundaries (prompt lengths straddle T/sp multiples)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(steps):
+        b = {}
+        for side in ("chosen", "rejected"):
+            toks = rng.integers(0, vocab, size=(gb, T)).astype(np.int32)
+            mask = np.zeros((gb, T), np.float32)
+            for r in range(gb):
+                start = int(rng.integers(3, T // 2))     # prompt end
+                stop = int(rng.integers(T // 2 + 1, T))  # padding start
+                mask[r, start:stop] = 1.0
+            b[side] = toks
+            b[f"{side}_mask"] = mask
+        out.append(b)
+    return out
+
+
+def _train_dpo(mesh, sp, steps=6):
+    from distributed_lion_tpu.train.dpo import make_dpo_loss_fn
+
+    model_cfg, base, lcfg, adapters = _sft_pieces()
+    from distributed_lion_tpu.models.lora import lora_apply_fn
+
+    seq_axis = SEQ_AXIS if sp > 1 else None
+    pol = lora_apply_fn(
+        lambda p, t: llama_apply(p, t, model_cfg, seq_axis=seq_axis),
+        base, lcfg)
+    loss_fn = make_dpo_loss_fn(
+        policy_apply=pol,
+        ref_apply=lambda t: llama_apply(base, t, model_cfg, seq_axis=seq_axis),
+        beta=0.1, seq_axis=seq_axis,
+    )
+    cfg = _cfg(learning_rate=1e-3)
+    spec = P(DATA_AXIS, SEQ_AXIS) if sp > 1 else None
+    trainer = Trainer(cfg, mesh, apply_fn=None, params=adapters,
+                      loss_fn=loss_fn, batch_spec=spec)
+    model_cfg_vocab = model_cfg.vocab_size
+    batches = _dpo_batches(steps, trainer.global_train_batch(), 64,
+                           model_cfg_vocab)
+    history = trainer.train(iter(batches), max_steps=steps)
+    losses = [h["loss"] for h in history if "loss" in h]
+    trainer.close()
+    return losses
+
+
+def test_dpo_sp_trajectory_matches_pure_dp():
+    mesh_sp = make_mesh(data=2, seq=4, devices=jax.devices()[:8])
+    mesh_dp = make_mesh(data=2, devices=jax.devices()[:2])
+    losses_sp = _train_dpo(mesh_sp, sp=4)
+    losses_dp = _train_dpo(mesh_dp, sp=1)
+    assert len(losses_sp) == len(losses_dp) > 0
+    np.testing.assert_allclose(losses_sp, losses_dp, rtol=2e-2, atol=2e-2)
+
+
+def test_run_dpo_cli_seq_parallel_smoke():
+    from distributed_lion_tpu.cli.run_dpo import main
+
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--max_length", "64",
         "--num_train_samples", "32", "--size_valid_set", "0",
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
         "1000", "--seq_parallel", "4",
