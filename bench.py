@@ -87,6 +87,13 @@ def run_inner() -> None:
     import dataclasses
 
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # The axon sitecustomize force-registers the TPU plugin and
+        # overrides JAX_PLATFORMS from the env; only the config knob set
+        # before first backend use reliably wins (same trick as
+        # tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -207,16 +214,19 @@ def main() -> None:
     line and exit 0. Never imports jax itself (backend init can hang)."""
     timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
     attempts = (
-        ("default", {}),
-        ("default", {}),
-        # evidence-of-life config: the CPU fallback exists to prove the
-        # program runs, not to measure a meaningful number — full flagship
-        # size would itself blow the timeout on a host CPU
-        ("cpu", {"JAX_PLATFORMS": "cpu", "BENCH_STEPS": "2",
-                 "BENCH_CALLS": "1", "BENCH_ACCUM": "4"}),
+        # a full-budget TPU attempt, a short retry (if the backend hung once
+        # it rarely recovers seconds later — don't spend a second full
+        # budget), then the CPU evidence-of-life config: it exists to prove
+        # the program runs, not to measure a meaningful number — full
+        # flagship size would itself blow the timeout on a host CPU
+        ("default", timeout_s, {}),
+        ("default", min(timeout_s, 300.0), {}),
+        ("cpu", timeout_s,
+         {"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1",
+          "BENCH_STEPS": "1", "BENCH_CALLS": "1", "BENCH_ACCUM": "1"}),
     )
     errors: list[str] = []
-    for label, env_extra in attempts:
+    for label, budget, env_extra in attempts:
         env = dict(os.environ)
         env.update(env_extra)
         try:
@@ -224,12 +234,12 @@ def main() -> None:
                 [sys.executable, os.path.abspath(__file__), "--inner"],
                 capture_output=True,
                 text=True,
-                timeout=timeout_s,
+                timeout=budget,
                 env=env,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
         except subprocess.TimeoutExpired:
-            errors.append(f"[{label}] timeout after {timeout_s:.0f}s")
+            errors.append(f"[{label}] timeout after {budget:.0f}s")
             continue
         result = _extract_json_line(proc.stdout)
         if proc.returncode == 0 and result is not None:

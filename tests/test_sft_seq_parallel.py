@@ -86,7 +86,7 @@ def test_run_sft_cli_seq_parallel_smoke():
         "--model_name", "tiny", "--dataset", "synthetic", "--lion",
         "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
         "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
-        "--num_train_samples", "32", "--size_valid_set", "0",
+        "--num_train_samples", "32", "--size_valid_set", "8",
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
         "1000", "--seq_parallel", "4",
     ])
@@ -156,7 +156,7 @@ def test_run_dpo_cli_seq_parallel_smoke():
         "--model_name", "tiny", "--dataset", "synthetic", "--lion",
         "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
         "1", "--gradient_accumulation_steps", "1", "--max_length", "64",
-        "--num_train_samples", "32", "--size_valid_set", "0",
+        "--num_train_samples", "32", "--size_valid_set", "4",
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
         "1000", "--seq_parallel", "4",
     ])
