@@ -44,6 +44,9 @@ class ModelArguments:
     vocab_size: Optional[int] = None  # default: tokenizer/model default
     n_ctx: Optional[int] = None
     dropout: float = 0.0
+    seq_impl: str = "ring"  # sequence-parallel attention under
+    # --seq_parallel: 'ring' (kv rotation) | 'ulysses' (all_to_all to head
+    # sharding; needs n_head % seq_parallel == 0)
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = True  # per-block activation remat (off = faster when HBM allows)
@@ -238,6 +241,7 @@ def main(argv=None):
         param_dtype=dtypes[model_args.param_dtype],
         compute_dtype=dtypes[model_args.compute_dtype],
         remat=model_args.remat,
+        seq_impl=model_args.seq_impl,
         moe_experts=model_args.moe_experts,
         moe_every=model_args.moe_every,
         moe_capacity_factor=model_args.moe_capacity_factor,

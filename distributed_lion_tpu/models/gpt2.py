@@ -42,6 +42,10 @@ class GPT2Config:
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash
     flash_block_q: int = 0   # flash kernel tile overrides (0 = defaults);
     flash_block_kv: int = 0  # see ops.attention.attention_flash
+    seq_impl: str = "ring"   # sequence-parallel attention: 'ring' (k/v
+    # blocks rotate over the seq axis — O(T/S) memory, any head count) or
+    # 'ulysses' (all_to_all to head sharding — needs n_head % sp == 0,
+    # two collective hops but full-T local attention)
     remat: bool = True  # rematerialize blocks (HBM for FLOPs); turn off when
                         # activations fit — backward skips the fwd recompute
     param_dtype: Any = jnp.float32
@@ -198,9 +202,14 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
         out = out.astype(x.dtype)
     elif seq_axis is not None:
-        from distributed_lion_tpu.parallel.ring_attention import ring_attention
+        from distributed_lion_tpu.parallel.ring_attention import (
+            ring_attention,
+            ulysses_attention,
+        )
 
-        out = ring_attention(q, k, v, axis_name=seq_axis)
+        seq_attn = (ulysses_attention if cfg.seq_impl == "ulysses"
+                    else ring_attention)
+        out = seq_attn(q, k, v, axis_name=seq_axis)
     else:
         out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl,
                                block_q=cfg.flash_block_q,

@@ -39,6 +39,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_eps: float = 1e-5
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash
+    seq_impl: str = "ring"   # sequence-parallel attention: ring | ulysses
     remat: bool = True  # per-block jax.checkpoint; off when activations fit
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
@@ -157,9 +158,14 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if seq_axis is not None:
-        from distributed_lion_tpu.parallel.ring_attention import ring_attention
+        from distributed_lion_tpu.parallel.ring_attention import (
+            ring_attention,
+            ulysses_attention,
+        )
 
-        out = ring_attention(q, k, v, axis_name=seq_axis)
+        seq_attn = (ulysses_attention if cfg.seq_impl == "ulysses"
+                    else ring_attention)
+        out = seq_attn(q, k, v, axis_name=seq_axis)
     else:
         out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
