@@ -57,7 +57,12 @@ from distributed_lion_tpu.parallel.mesh import (
 )
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
-from distributed_lion_tpu.train.profiling import StepProfiler, StepTimer, comm_report
+from distributed_lion_tpu.train.profiling import (
+    StepProfiler,
+    StepTimer,
+    comm_report,
+    peak_hbm_gb,
+)
 from distributed_lion_tpu.train.schedule import (
     constant_schedule,
     cosine_schedule_with_warmup,
@@ -611,6 +616,9 @@ class Trainer:
                 if comm:
                     m["comm_bytes_per_step"] = comm["comm_bytes_per_step"]
                     m["comm_mbytes_per_sec"] = comm.get("comm_mbytes_per_sec", 0.0)
+                hbm = peak_hbm_gb()
+                if hbm is not None:
+                    m["peak_hbm_gb"] = hbm
                 t_last, s_last = now, self.step_count
                 self.logger.log(self.step_count, m, prefix="train")
                 history.append({"step": self.step_count, **m})

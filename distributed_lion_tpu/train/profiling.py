@@ -123,6 +123,20 @@ class StepTimer:
         }
 
 
+def peak_hbm_gb() -> Optional[float]:
+    """Peak device-memory high-water mark in GiB, or None where the backend
+    exposes no memory_stats (host CPU)."""
+    try:
+        import jax
+
+        ms = jax.local_devices()[0].memory_stats()
+        if not ms or "peak_bytes_in_use" not in ms:
+            return None
+        return round(ms["peak_bytes_in_use"] / 2**30, 3)
+    except Exception:
+        return None
+
+
 def comm_report(num_params: int, world: int, wire: str,
                 steps_per_sec: Optional[float] = None,
                 vote_every: int = 1, accum_steps: int = 1) -> dict:
