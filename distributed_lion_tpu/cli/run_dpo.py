@@ -37,6 +37,8 @@ class DPOArguments:
     num_train_samples: int = 512
     size_valid_set: int = 64
     sanity_check: bool = False
+    attn_impl: str = "auto"  # ops.attention: auto | xla | flash | splash
+    seq_impl: str = "ring"   # under --seq_parallel: ring | ulysses
     quant_ref: str = "none"        # none | int8 | nf4 — frozen ref model
     lora_r: int = 8
     lora_alpha: int = 16
@@ -94,6 +96,8 @@ def main(argv=None):
             "llama3_8b": LlamaConfig.llama3_8b,
         }[script_args.model_name]
         model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+    model_cfg = dataclasses.replace(model_cfg, attn_impl=script_args.attn_impl,
+                                    seq_impl=script_args.seq_impl)
     if script_args.max_length > model_cfg.n_ctx:
         script_args.max_length = model_cfg.n_ctx
     if sp > 1 and script_args.max_length % sp:

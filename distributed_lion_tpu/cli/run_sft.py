@@ -44,6 +44,8 @@ class SFTArguments:
     packing: bool = True
     group_by_length: bool = False
     gradient_checkpointing: bool = False
+    attn_impl: str = "auto"  # ops.attention: auto | xla | flash | splash
+    seq_impl: str = "ring"   # under --seq_parallel: ring | ulysses
     tokenizer_name: Optional[str] = None
     merged_output: Optional[str] = None  # save the LoRA-merged model here:
     # a *.npz path → flat save_pytree archive (cli/run_generate's format);
@@ -150,6 +152,8 @@ def main(argv=None):
             "llama3_8b": LlamaConfig.llama3_8b,
         }[script_args.model_name]
         model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+    model_cfg = dataclasses.replace(model_cfg, attn_impl=script_args.attn_impl,
+                                    seq_impl=script_args.seq_impl)
     if script_args.seq_length > model_cfg.n_ctx:
         script_args.seq_length = model_cfg.n_ctx
     if sp > 1 and script_args.seq_length % sp:
