@@ -33,7 +33,11 @@ class ModelArguments:
     """run_clm.py ModelArguments (:89-166) — the subset that configures a
     from-scratch model rather than an HF hub download."""
 
-    model_name: str = "gpt2_124m"  # gpt2_124m | tiny
+    model_family: str = "gpt2"  # gpt2 | llama — the reference's run_clm is
+    # architecture-agnostic (AutoModelForCausalLM, run_clm.py:425-444);
+    # llama composes with dp x tp x sp (pipe/expert/MoE are GPT-2-only)
+    model_name: str = "gpt2_124m"  # gpt2: gpt2_124m | tiny;
+    # llama: llama2_7b | llama3_8b | tiny
     model_path: Optional[str] = None  # local HF checkpoint (save_pretrained
     # dir / .safetensors / .bin / .npz) → finetune from pretrained weights,
     # the reference's from_pretrained path (run_clm.py:425-444). Overrides
@@ -246,19 +250,59 @@ def main(argv=None):
         moe_every=model_args.moe_every,
         moe_capacity_factor=model_args.moe_capacity_factor,
     )
+    family = model_args.model_family
+    if model_args.model_path:
+        # the checkpoint's architecture wins; resolve BEFORE the family
+        # guards so they judge what will actually run
+        from distributed_lion_tpu.models import hf_import
+
+        family = hf_import.detect_family(model_args.model_path)
+        if family != model_args.model_family:
+            print(f"[run_clm] --model_family {model_args.model_family} -> "
+                  f"{family} (detected from --model_path)")
+    if family not in ("gpt2", "llama"):
+        raise ValueError(f"unknown model family {family!r}")
+    if family == "llama" and (
+        model_args.moe_experts > 0 or train_cfg.pipeline_parallel > 1
+        or train_cfg.expert_parallel > 1
+    ):
+        raise NotImplementedError(
+            "--model_family llama composes with dp x tp x sp; MoE and "
+            "pipeline/expert axes are wired for GPT-2 only"
+        )
+    if family == "llama" and model_args.dropout > 0.0:
+        raise ValueError("our Llama (like HF's) has no dropout; set --dropout 0")
     initial_params = None
     if model_args.model_path:
-        from distributed_lion_tpu.models.hf_import import gpt2_from_hf
+        if family == "llama":
+            initial_params, model_cfg = hf_import.llama_from_hf(
+                model_args.model_path,
+                param_dtype=dtypes[model_args.param_dtype],
+                compute_dtype=dtypes[model_args.compute_dtype],
+                remat=model_args.remat,
+                seq_impl=model_args.seq_impl,
+            )
+        else:
+            initial_params, model_cfg = hf_import.gpt2_from_hf(
+                model_args.model_path,
+                dropout=model_args.dropout,
+                param_dtype=dtypes[model_args.param_dtype],
+                compute_dtype=dtypes[model_args.compute_dtype],
+                remat=model_args.remat,
+                seq_impl=model_args.seq_impl,
+            )
+        print(f"[run_clm] loaded pretrained {family} from {model_args.model_path}: "
+              f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+    elif family == "llama":
+        from distributed_lion_tpu.models.llama import LlamaConfig
 
-        initial_params, model_cfg = gpt2_from_hf(
-            model_args.model_path,
-            dropout=model_args.dropout,
+        llama_common = dict(
             param_dtype=dtypes[model_args.param_dtype],
             compute_dtype=dtypes[model_args.compute_dtype],
             remat=model_args.remat,
+            seq_impl=model_args.seq_impl,
         )
-        print(f"[run_clm] loaded pretrained GPT-2 from {model_args.model_path}: "
-              f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+        model_cfg = LlamaConfig.named(model_args.model_name, **llama_common)
     elif model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)
     else:
@@ -279,7 +323,7 @@ def main(argv=None):
             model_cfg = dataclasses.replace(model_cfg, vocab_size=tok_vocab)
     if model_args.n_ctx:
         model_cfg = dataclasses.replace(model_cfg, n_ctx=model_args.n_ctx)
-    if model_args.hf_export and model_cfg.moe_experts > 0:
+    if model_args.hf_export and getattr(model_cfg, "moe_experts", 0) > 0:
         # fail BEFORE spending the training budget: MoE blocks have no HF
         # GPT-2 equivalent (models/hf_export raises the same at save time)
         raise ValueError("--hf_export is incompatible with --moe_experts: "
@@ -289,7 +333,8 @@ def main(argv=None):
         print(f"[run_clm] capping block_size {train_cfg.block_size} -> n_ctx {model_cfg.n_ctx}")
         train_cfg.block_size = model_cfg.n_ctx
 
-    trainer = Trainer.for_gpt2(train_cfg, mesh, model_cfg, initial_params=initial_params)
+    factory = Trainer.for_llama if family == "llama" else Trainer.for_gpt2
+    trainer = factory(train_cfg, mesh, model_cfg, initial_params=initial_params)
     native = make_native_pipeline(
         data_args, train_cfg.block_size, model_cfg.vocab_size,
         trainer.global_train_batch(), train_cfg.seed,
@@ -309,7 +354,7 @@ def main(argv=None):
             trainer.save()
         if train_cfg.output_dir or model_args.hf_export:
             export = trainer.params
-            if train_cfg.pipeline_parallel > 1:
+            if train_cfg.pipeline_parallel > 1 and family == "gpt2":
                 from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
 
                 export = unpipeline_params(export, model_cfg.n_layer)
@@ -327,12 +372,14 @@ def main(argv=None):
 
             from distributed_lion_tpu.models.hf_export import (
                 gpt2_to_hf,
+                llama_to_hf,
                 write_model_card,
             )
 
-            gpt2_to_hf(jax.device_get(export), model_cfg, model_args.hf_export)
+            to_hf = llama_to_hf if family == "llama" else gpt2_to_hf
+            to_hf(jax.device_get(export), model_cfg, model_args.hf_export)
             write_model_card(
-                model_args.hf_export, model_type="gpt2",
+                model_args.hf_export, model_type=family,
                 train_summary={
                     "optimizer": "distributed-lion" if train_cfg.lion else "adamw",
                     "async_grad": train_cfg.async_grad,

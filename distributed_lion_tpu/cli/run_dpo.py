@@ -90,12 +90,8 @@ def main(argv=None):
         print(f"[run_dpo] loaded pretrained Llama from {script_args.model_path}: "
               f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
     else:
-        model_ctor = {
-            "tiny": LlamaConfig.tiny,
-            "llama2_7b": LlamaConfig.llama2_7b,
-            "llama3_8b": LlamaConfig.llama3_8b,
-        }[script_args.model_name]
-        model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+        model_cfg = LlamaConfig.named(script_args.model_name,
+                                      vocab_size=max(tok.vocab_size, 259))
     model_cfg = dataclasses.replace(model_cfg, attn_impl=script_args.attn_impl,
                                     seq_impl=script_args.seq_impl)
     if script_args.max_length > model_cfg.n_ctx:

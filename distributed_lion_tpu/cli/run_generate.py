@@ -82,9 +82,7 @@ def build(args: GenerateArguments):
             LlamaConfig, llama_decode, llama_init, llama_init_cache,
         )
 
-        factory = {"tiny": LlamaConfig.tiny, "llama2_7b": LlamaConfig.llama2_7b,
-                   "llama3_8b": LlamaConfig.llama3_8b}[args.model_name]
-        cfg = hf_cfg or factory(vocab_size=vocab)
+        cfg = hf_cfg or LlamaConfig.named(args.model_name, vocab_size=vocab)
         params = (hf_params if hf_params is not None
                   else load_pytree(args.model_path) if args.model_path
                   else llama_init(jax.random.key(args.seed), cfg))

@@ -146,12 +146,8 @@ def main(argv=None):
                 f"{model_cfg.vocab_size}; pass the checkpoint's own tokenizer"
             )
     else:
-        model_ctor = {
-            "tiny": LlamaConfig.tiny,
-            "llama2_7b": LlamaConfig.llama2_7b,
-            "llama3_8b": LlamaConfig.llama3_8b,
-        }[script_args.model_name]
-        model_cfg = model_ctor(vocab_size=max(tok.vocab_size, 259))
+        model_cfg = LlamaConfig.named(script_args.model_name,
+                                      vocab_size=max(tok.vocab_size, 259))
     model_cfg = dataclasses.replace(model_cfg, attn_impl=script_args.attn_impl,
                                     seq_impl=script_args.seq_impl)
     if script_args.seq_length > model_cfg.n_ctx:

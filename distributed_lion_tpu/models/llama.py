@@ -67,6 +67,19 @@ class LlamaConfig:
         base.update(kw)
         return LlamaConfig(**base)
 
+    @classmethod
+    def named(cls, name: str, **kw) -> "LlamaConfig":
+        """Resolve a CLI model name — single source for every entry point
+        (run_clm / run_sft / run_dpo / run_generate)."""
+        ctors = {"tiny": cls.tiny, "llama2_7b": cls.llama2_7b,
+                 "llama3_8b": cls.llama3_8b}
+        if name not in ctors:
+            raise ValueError(
+                f"unknown llama model_name {name!r}; pick one of "
+                f"{sorted(ctors)}"
+            )
+        return ctors[name](**kw)
+
 
 def _normal(key, shape, std, dtype):
     return (jax.random.normal(key, shape) * std).astype(dtype)

@@ -891,6 +891,96 @@ class Trainer:
         return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs,
                        loss_fn=loss_fn, batch_spec=batch_spec)
 
+    @staticmethod
+    def for_llama(cfg: TrainConfig, mesh, model_cfg, seed: Optional[int] = None,
+                  initial_params: Any = None):
+        """Full-parameter CLM training of a Llama-family model — the
+        reference's run_clm is architecture-agnostic (AutoModelForCausalLM,
+        run_clm.py:425-444), so ours trains Llama from scratch or from an
+        imported checkpoint too. Composes with dp, tensor (dp×tp) and
+        sequence (dp×sp) parallelism; pipe/expert axes are GPT-2-only."""
+        from distributed_lion_tpu.models.llama import (
+            llama_apply,
+            llama_hidden,
+            llama_init,
+        )
+        from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            llama_param_specs,
+            validate_tp,
+        )
+
+        if dict(mesh.shape).get(PIPE_AXIS, 1) > 1 or dict(mesh.shape).get(EXPERT_AXIS, 1) > 1:
+            raise NotImplementedError(
+                "pipeline/expert mesh axes are wired for GPT-2 only; Llama "
+                "composes with dp x tp x sp"
+            )
+        params = (initial_params if initial_params is not None else
+                  llama_init(jax.random.key(seed if seed is not None else cfg.seed),
+                             model_cfg))
+        n = count_params(params)
+        acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
+                                    vote_every=cfg.vote_every,
+                                    accum_steps=cfg.gradient_accumulation_steps)
+        tp = mesh.shape[TENSOR_AXIS]
+        print(
+            f"[trainer] Llama {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
+            f"tp={tp} | vote wire={cfg.wire}"
+            + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
+            + f": {acct['bits_per_param']:.2f} bits/param/step"
+            + (f" | DCN leg {acct['dcn_bits_per_param']:.3f} bits/param"
+               if "dcn_bits_per_param" in acct else "")
+        )
+        param_specs = None
+        tp_axis = None
+        if tp > 1:
+            validate_tp(model_cfg, tp, "llama")
+            param_specs = llama_param_specs(model_cfg)
+            tp_axis = TENSOR_AXIS
+
+        sp = dict(mesh.shape).get(SEQ_AXIS, 1)
+        seq_axis = SEQ_AXIS if sp > 1 else None
+        batch_spec = None
+        loss_fn = None
+        if seq_axis:
+            if cfg.vocab_chunks > 0:
+                raise NotImplementedError(
+                    "--vocab_chunks under --seq_parallel is not wired (the "
+                    "boundary-label exchange lives in the dense seq loss)"
+                )
+            if cfg.block_size % sp:
+                raise ValueError(f"block_size {cfg.block_size} not divisible "
+                                 f"by seq axis {sp}")
+            if cfg.block_size > model_cfg.n_ctx:
+                raise ValueError(
+                    f"seq-parallel block_size {cfg.block_size} exceeds n_ctx "
+                    f"{model_cfg.n_ctx}: rope offsets would extrapolate"
+                )
+            batch_spec = P(DATA_AXIS, SEQ_AXIS)
+
+            def loss_fn(params, batch, dropout_key):
+                logits = llama_apply(params, batch, model_cfg,
+                                     tp_axis=tp_axis, seq_axis=SEQ_AXIS)
+                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
+        def apply_fn(params, tokens, dropout_key):
+            del dropout_key  # our Llama (like HF's) has no dropout
+            return llama_apply(params, tokens, model_cfg, tp_axis=tp_axis)
+
+        if cfg.vocab_chunks > 0 and loss_fn is None:
+            from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
+
+            def loss_fn(params, batch, dropout_key):
+                hidden = llama_hidden(params, batch, model_cfg, tp_axis=tp_axis)
+                return chunked_clm_loss_and_metrics(
+                    hidden, params["lm_head"], batch, cfg.vocab_chunks,
+                    None, emb_layout="dv")
+
+            loss_fn._vocab_chunked = True  # consumed; don't trip the guard
+
+        return Trainer(cfg, mesh, apply_fn, params, param_specs=param_specs,
+                       loss_fn=loss_fn, batch_spec=batch_spec)
+
 
 def _count_of(state) -> jnp.ndarray:
     return state.count
