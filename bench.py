@@ -112,10 +112,10 @@ def run_inner() -> None:
         GPT2Config.gpt2_124m(), remat=False, attn_impl="xla",
         param_dtype=jnp.bfloat16,
     )
-    batch_per_dev, accum = 4, 16
+    batch_per_dev = 4
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
-    accum = int(os.environ.get("BENCH_ACCUM", accum))
+    accum = int(os.environ.get("BENCH_ACCUM", 16))
     cfg = TrainConfig(
         lion=True,
         async_grad=True,

@@ -296,12 +296,10 @@ def main(argv=None):
     elif family == "llama":
         from distributed_lion_tpu.models.llama import LlamaConfig
 
-        llama_common = dict(
-            param_dtype=dtypes[model_args.param_dtype],
-            compute_dtype=dtypes[model_args.compute_dtype],
-            remat=model_args.remat,
-            seq_impl=model_args.seq_impl,
-        )
+        # the gpt2 `common` kwargs minus the fields LlamaConfig doesn't have
+        # (dropout, moe_*)
+        llama_common = {k: common[k] for k in
+                        ("param_dtype", "compute_dtype", "remat", "seq_impl")}
         model_cfg = LlamaConfig.named(model_args.model_name, **llama_common)
     elif model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)
