@@ -110,6 +110,95 @@ def chunked_softmax_xent(
     return nll, besti == labels
 
 
+def tp_vocab_xent(
+    hidden: jnp.ndarray,
+    head_shard: jnp.ndarray,
+    labels: jnp.ndarray,
+    axis_name: str,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Megatron-style vocab-parallel cross entropy (inside shard_map).
+
+    Each tensor rank holds ``head_shard`` [d, V/tp] — its contiguous slice
+    of the lm_head's vocab columns — and computes only those logits: the
+    full [N, V] logits never exist on any device, and the head matmul's
+    FLOPs split tp ways (the replicated-head TP path computes identical
+    full-vocab logits on every rank). The softmax normalizer assembles from
+    per-rank (max, sumexp) via ``pmax``/``psum``; the label logit via a
+    masked gather on the one rank whose slice contains it; argmax (for the
+    accuracy metric) via pmax-then-pmin, matching dense argmax's
+    lowest-index tie rule.
+
+    ``hidden`` [N, d] must be replicated over ``axis_name``; it is passed
+    through the Megatron copy boundary here, so backward psums d(hidden)
+    across ranks — callers get complete backbone gradients without extra
+    plumbing. Returns (nll [N] f32, correct [N] bool), identical on every
+    rank.
+    """
+    from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
+
+    vshard = head_shard.shape[1]
+    start = lax.axis_index(axis_name) * vshard
+    hidden = copy_to_tp_region(hidden, axis_name)
+    logits = jnp.einsum("nd,dv->nv", hidden,
+                        head_shard.astype(hidden.dtype),
+                        preferred_element_type=jnp.float32)
+    # the max shift is a constant offset that cancels analytically in the
+    # softmax gradient, so detaching it is exact — and the stop_gradient
+    # must sit UPSTREAM of the pmax (which defines no differentiation rule)
+    # so no tangent ever reaches the collective
+    m = lax.pmax(lax.stop_gradient(logits).max(-1), axis_name)
+    se = lax.psum(jnp.exp(logits - m[:, None]).sum(-1), axis_name)
+    lse = jnp.log(se) + m
+
+    in_range = (labels >= start) & (labels < start + vshard)
+    idx = jnp.clip(labels - start, 0, vshard - 1)
+    lab = jnp.take_along_axis(logits, idx[:, None], axis=-1)[..., 0]
+    label_logit = lax.psum(jnp.where(in_range, lab, 0.0), axis_name)
+    nll = lse - label_logit
+
+    stopped = lax.stop_gradient(logits)  # accuracy metric: no grad path
+    # m IS the global max — ranks whose local max reaches it are the argmax
+    # candidates; pmin picks the lowest global id (dense argmax's tie rule)
+    cand = jnp.where(stopped.max(-1) == m, stopped.argmax(-1) + start,
+                     jnp.int32(2**30))
+    best_id = lax.pmin(cand, axis_name)
+    return nll, best_id == labels
+
+
+def _shifted_clm_metrics(xent_fn, hidden, tokens, loss_mask):
+    """Shared shift-by-one CLM tail: ``xent_fn(h [N,d], labels [N]) ->
+    (nll, correct)`` over positions 0..T-2 predicting tokens 1..T-1, masked
+    mean loss/accuracy — the one place the contract of
+    models/loss.clm_loss_and_metrics is reproduced from hidden states."""
+    b, t, d = hidden.shape
+    h = hidden[:, :-1].reshape(b * (t - 1), d)
+    labels = tokens[:, 1:].reshape(-1).astype(jnp.int32)
+    nll, correct = xent_fn(h, labels)
+    if loss_mask is None:
+        mask = jnp.ones_like(nll)
+    else:
+        mask = loss_mask[:, 1:].reshape(-1).astype(jnp.float32)
+    nmask = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / nmask
+    acc = (correct.astype(jnp.float32) * mask).sum() / nmask
+    return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}
+
+
+def tp_vocab_clm_loss_and_metrics(
+    hidden: jnp.ndarray,
+    head_shard: jnp.ndarray,
+    tokens: jnp.ndarray,
+    axis_name: str,
+    loss_mask: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Shift-by-one CLM loss over a vocab-sharded head — the
+    tensor-parallel twin of :func:`chunked_clm_loss_and_metrics`, same
+    return contract."""
+    return _shifted_clm_metrics(
+        lambda h, lab: tp_vocab_xent(h, head_shard, lab, axis_name),
+        hidden, tokens, loss_mask)
+
+
 def chunked_clm_loss_and_metrics(
     hidden: jnp.ndarray,
     emb: jnp.ndarray,
@@ -124,15 +213,6 @@ def chunked_clm_loss_and_metrics(
     ``hidden`` [B, T, d]; positions 0..T-2 predict tokens 1..T-1. ``emb``
     is the head in either layout (see :func:`chunked_softmax_xent`).
     """
-    b, t, d = hidden.shape
-    h = hidden[:, :-1].reshape(b * (t - 1), d)
-    labels = tokens[:, 1:].reshape(-1).astype(jnp.int32)
-    nll, correct = chunked_softmax_xent(h, emb, labels, n_chunks, emb_layout)
-    if loss_mask is None:
-        mask = jnp.ones_like(nll)
-    else:
-        mask = loss_mask[:, 1:].reshape(-1).astype(jnp.float32)
-    nmask = jnp.maximum(mask.sum(), 1.0)
-    loss = (nll * mask).sum() / nmask
-    acc = (correct.astype(jnp.float32) * mask).sum() / nmask
-    return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}
+    return _shifted_clm_metrics(
+        lambda h, lab: chunked_softmax_xent(h, emb, lab, n_chunks, emb_layout),
+        hidden, tokens, loss_mask)

@@ -19,6 +19,17 @@ without the boundary psum the gradients of everything upstream (layer norms,
 embeddings) would be per-rank partials — and per-rank momenta/votes would
 silently drift replicated parameters apart. (Under ``shard_map`` with
 ``check_vma=False`` JAX does not insert this reduction automatically.)
+
+**Gradient-scale convention.** jax.grad runs INSIDE the train step's
+shard_map, where the transpose of ``lax.psum`` is ``psum`` — so each
+row-parallel exit reduce and each copy boundary a leaf's backward crosses
+multiplies its gradient by W. The net effect is a CONSTANT positive
+per-leaf factor W^k (constant across steps; pinned by
+tests/test_tp_vocab.py). Sign-based vote-Lion is exactly invariant to a
+constant per-leaf scale, which is why tensor-parallel training is
+Lion-only (train/loop.py guards the AdamW and stochastic-binarization
+paths): AdamW's moments and the stochastic quantizer's Bernoulli
+probabilities are magnitude-dependent and would silently mis-scale.
 """
 
 from __future__ import annotations
@@ -82,8 +93,13 @@ def gpt2_param_specs(cfg) -> dict:
     }
 
 
-def llama_param_specs(cfg) -> dict:
-    """PartitionSpec pytree matching models/llama.llama_init's structure."""
+def llama_param_specs(cfg, vocab_parallel: bool = False) -> dict:
+    """PartitionSpec pytree matching models/llama.llama_init's structure.
+
+    ``vocab_parallel`` shards the lm_head's vocab columns over the tensor
+    axis (Megatron vocab-parallel CE, ops/xent.tp_vocab_xent): V/tp logit
+    columns per rank instead of a replicated [d, V] head — the memory and
+    FLOPs win that matters at 128k-class vocabularies."""
     col = P(None, TENSOR_AXIS)
     row = P(TENSOR_AXIS, None)
     rep = P()
@@ -95,7 +111,7 @@ def llama_param_specs(cfg) -> dict:
     }
     return {
         "wte": rep,
-        "lm_head": rep,
+        "lm_head": col if vocab_parallel else rep,
         "ln_f": {"scale": rep},
         "blocks": [block] * cfg.n_layer,
     }

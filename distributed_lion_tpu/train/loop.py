@@ -92,6 +92,10 @@ class TrainConfig:
     # the [B,T,V] f32 logits (the largest activation at GPT-2 124M: ~823MB
     # per microbatch) are never materialized; streaming logsumexp over V/N
     # chunks, chunk logits rematerialized in backward. Same math, less HBM.
+    tp_vocab: bool = False  # Llama path, tensor_parallel > 1: shard the
+    # lm_head's vocab columns over the tensor axis and compute the CLM loss
+    # with Megatron vocab-parallel CE (ops/xent.tp_vocab_xent) — V/tp logit
+    # columns per rank instead of every rank computing the full [B,T,V].
     tensor_parallel: int = 1  # tensor mesh axis size (consumed by the CLIs
                               # when building the mesh; net-new vs reference)
     seq_parallel: int = 1  # sequence/context mesh axis size: batches are
@@ -263,6 +267,16 @@ class Trainer:
                 "--vocab_chunks is not wired into this entry point's loss "
                 "function (supported: run_clm's dense dp/tp path, run_sft)"
             )
+        if cfg.tp_vocab and not getattr(loss_fn, "_tp_vocab", False):
+            # same silent-ignore trap as vocab_chunks: the flag is
+            # CLI-auto-exposed everywhere but only for_llama's dense dp x tp
+            # loss consumes it (parse_dataclasses exposes every TrainConfig
+            # field)
+            raise NotImplementedError(
+                "--tp_vocab is wired for run_clm --model_family llama with "
+                "--tensor_parallel > 1 only; this entry point's loss would "
+                "silently ignore it"
+            )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         # number of ways batch ROWS (dim 0) are sharded: data alone normally;
         # data x expert under expert parallelism (tokens ride both axes)
@@ -278,6 +292,15 @@ class Trainer:
             param_specs = jax.tree.map(lambda _: P(), params)
         elif not cfg.lion:
             raise NotImplementedError("tensor-parallel param_specs require the Lion path")
+        if (cfg.max_grad_norm is not None
+                and dict(mesh.shape).get(TENSOR_AXIS, 1) > 1):
+            raise NotImplementedError(
+                "stochastic binarization (max_grad_norm) under tensor "
+                "parallelism is not wired: TP gradients carry constant "
+                "per-leaf W^k scale factors (parallel/tensor_parallel.py "
+                "docstring) that deterministic sign votes absorb but the "
+                "magnitude-dependent Bernoulli quantizer would not"
+            )
         self.param_specs = param_specs
         if cfg.lion and cfg.vote_every > 1:
             sharded_axes = {
@@ -931,17 +954,36 @@ class Trainer:
             + (f" | DCN leg {acct['dcn_bits_per_param']:.3f} bits/param"
                if "dcn_bits_per_param" in acct else "")
         )
+        if cfg.tp_vocab and tp <= 1:
+            raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "
+                             "shards the lm_head over the tensor axis)")
+        if cfg.tp_vocab and cfg.vocab_chunks > 0:
+            raise NotImplementedError(
+                "--tp_vocab and --vocab_chunks are alternative head "
+                "strategies (vocab sharded across ranks vs streamed in "
+                "chunks); pick one"
+            )
         param_specs = None
         tp_axis = None
         if tp > 1:
             validate_tp(model_cfg, tp, "llama")
-            param_specs = llama_param_specs(model_cfg)
+            if cfg.tp_vocab and model_cfg.vocab_size % tp:
+                raise ValueError(
+                    f"--tp_vocab: vocab {model_cfg.vocab_size} not divisible "
+                    f"by tensor axis {tp}"
+                )
+            param_specs = llama_param_specs(model_cfg,
+                                            vocab_parallel=cfg.tp_vocab)
             tp_axis = TENSOR_AXIS
 
         sp = dict(mesh.shape).get(SEQ_AXIS, 1)
         seq_axis = SEQ_AXIS if sp > 1 else None
         batch_spec = None
         loss_fn = None
+        if seq_axis and cfg.tp_vocab:
+            raise NotImplementedError(
+                "--tp_vocab under --seq_parallel is not wired; pick one"
+            )
         if seq_axis:
             if cfg.vocab_chunks > 0:
                 raise NotImplementedError(
@@ -967,7 +1009,18 @@ class Trainer:
             del dropout_key  # our Llama (like HF's) has no dropout
             return llama_apply(params, tokens, model_cfg, tp_axis=tp_axis)
 
-        if cfg.vocab_chunks > 0 and loss_fn is None:
+        if cfg.tp_vocab and loss_fn is None:
+            from distributed_lion_tpu.ops.xent import tp_vocab_clm_loss_and_metrics
+
+            def loss_fn(params, batch, dropout_key):
+                hidden = llama_hidden(params, batch, model_cfg, tp_axis=tp_axis)
+                # params["lm_head"] is this rank's [d, V/tp] column slice
+                return tp_vocab_clm_loss_and_metrics(
+                    hidden, params["lm_head"], batch, TENSOR_AXIS)
+
+            loss_fn._tp_vocab = True  # consumed; don't trip the guard
+
+        elif cfg.vocab_chunks > 0 and loss_fn is None:
             from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
 
             def loss_fn(params, batch, dropout_key):
