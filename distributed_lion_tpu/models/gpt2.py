@@ -28,7 +28,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_lion_tpu.ops.attention import attention as shared_attention
-from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
+from distributed_lion_tpu.parallel.tensor_parallel import (
+    copy_to_tp_region,
+    reduce_from_tp_region,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -217,7 +220,7 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _proj(out, p["proj"])
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)  # row-parallel reduction
+        out = reduce_from_tp_region(out, tp_axis)  # row-parallel exit (g op)
     return out + p["proj_b"].astype(x.dtype)
 
 
@@ -235,7 +238,7 @@ def _mlp(x, p, tp_axis=None):
     h = jax.nn.gelu(h, approximate=True)
     out = _proj(h, p["proj"])
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
+        out = reduce_from_tp_region(out, tp_axis)
     return out + p["proj_b"].astype(x.dtype)
 
 
@@ -280,6 +283,27 @@ def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
 _moe_block_remat = partial(jax.checkpoint, static_argnums=(3, 4))(_moe_block)
 
 
+def vocab_parallel_embed(wte_shard: jnp.ndarray, tokens: jnp.ndarray,
+                         vocab_axis: str, out_dtype=None) -> jnp.ndarray:
+    """Megatron VocabParallelEmbedding: ``wte_shard`` [V/tp, d] is this
+    rank's contiguous vocab-row slice; out-of-range tokens contribute zero
+    and the partial embeddings reduce over the tensor axis (the *g*
+    operator — exact identity backward). Pairs with the vocab-parallel tied
+    head (ops/xent.tp_vocab_xent on ``wte_shard.T``) so the full [V, d]
+    table never exists on one device. ``out_dtype`` casts BEFORE the
+    collective: exactly one rank contributes a nonzero row per token, so
+    reducing in the (usually narrower) compute dtype is bit-identical at
+    half the wire bytes."""
+    vshard = wte_shard.shape[0]
+    start = lax.axis_index(vocab_axis) * vshard
+    in_range = (tokens >= start) & (tokens < start + vshard)
+    idx = jnp.clip(tokens - start, 0, vshard - 1)
+    part = wte_shard[idx] * in_range[..., None].astype(wte_shard.dtype)
+    if out_dtype is not None:
+        part = part.astype(out_dtype)
+    return reduce_from_tp_region(part, vocab_axis)
+
+
 def gpt2_hidden(
     params: dict,
     tokens: jnp.ndarray,
@@ -289,11 +313,13 @@ def gpt2_hidden(
     tp_axis: Optional[str] = None,
     seq_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
+    vocab_axis: Optional[str] = None,
 ) -> tuple:
     """Backbone forward: tokens [B, T] → (final hidden [B, T, d] after ln_f,
     MoE aux loss scalar). The tied-logits head is applied by
     :func:`gpt2_apply`, or streamed chunk-wise by ops/xent for the
-    memory-lean loss path."""
+    memory-lean loss path. With ``vocab_axis``, ``params["wte"]`` is this
+    rank's vocab-row shard (:func:`vocab_parallel_embed`)."""
     B, T = tokens.shape
     if seq_axis is None:
         if T > cfg.n_ctx:
@@ -304,7 +330,12 @@ def gpt2_hidden(
         pos_start = sidx * T
         if dropout_key is not None:
             dropout_key = jax.random.fold_in(dropout_key, sidx)
-    x = params["wte"][tokens].astype(cfg.compute_dtype)
+    if vocab_axis is not None:
+        x = vocab_parallel_embed(params["wte"], tokens, vocab_axis,
+                                 out_dtype=cfg.compute_dtype)
+    else:
+        x = params["wte"][tokens]
+    x = x.astype(cfg.compute_dtype)
     x = x + lax.dynamic_slice_in_dim(params["wpe"], pos_start, T, axis=0).astype(
         cfg.compute_dtype
     )

@@ -24,7 +24,10 @@ import jax.numpy as jnp
 
 from distributed_lion_tpu.ops.attention import attention as shared_attention
 from distributed_lion_tpu.ops.quant import maybe_dequant
-from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
+from distributed_lion_tpu.parallel.tensor_parallel import (
+    copy_to_tp_region,
+    reduce_from_tp_region,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,7 +187,7 @@ def _attention(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _matmul(out, p["wo"])
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
+        out = reduce_from_tp_region(out, tp_axis)
     return out
 
 
@@ -194,7 +197,7 @@ def _mlp(x, p, tp_axis=None):
     gate = jax.nn.silu(_matmul(x, p["w_gate"]))
     out = _matmul(gate * _matmul(x, p["w_up"]), p["w_down"])
     if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
+        out = reduce_from_tp_region(out, tp_axis)
     return out
 
 

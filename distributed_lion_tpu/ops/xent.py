@@ -134,7 +134,10 @@ def tp_vocab_xent(
     plumbing. Returns (nll [N] f32, correct [N] bool), identical on every
     rank.
     """
-    from distributed_lion_tpu.parallel.tensor_parallel import copy_to_tp_region
+    from distributed_lion_tpu.parallel.tensor_parallel import (
+        copy_to_tp_region,
+        reduce_from_tp_region,
+    )
 
     vshard = head_shard.shape[1]
     start = lax.axis_index(axis_name) * vshard
@@ -147,13 +150,13 @@ def tp_vocab_xent(
     # must sit UPSTREAM of the pmax (which defines no differentiation rule)
     # so no tangent ever reaches the collective
     m = lax.pmax(lax.stop_gradient(logits).max(-1), axis_name)
-    se = lax.psum(jnp.exp(logits - m[:, None]).sum(-1), axis_name)
+    se = reduce_from_tp_region(jnp.exp(logits - m[:, None]).sum(-1), axis_name)
     lse = jnp.log(se) + m
 
     in_range = (labels >= start) & (labels < start + vshard)
     idx = jnp.clip(labels - start, 0, vshard - 1)
     lab = jnp.take_along_axis(logits, idx[:, None], axis=-1)[..., 0]
-    label_logit = lax.psum(jnp.where(in_range, lab, 0.0), axis_name)
+    label_logit = reduce_from_tp_region(jnp.where(in_range, lab, 0.0), axis_name)
     nll = lse - label_logit
 
     stopped = lax.stop_gradient(logits)  # accuracy metric: no grad path

@@ -269,13 +269,13 @@ class Trainer:
             )
         if cfg.tp_vocab and not getattr(loss_fn, "_tp_vocab", False):
             # same silent-ignore trap as vocab_chunks: the flag is
-            # CLI-auto-exposed everywhere but only for_llama's dense dp x tp
-            # loss consumes it (parse_dataclasses exposes every TrainConfig
-            # field)
+            # CLI-auto-exposed everywhere but only the dense dp x tp losses
+            # of for_gpt2/for_llama consume it (parse_dataclasses exposes
+            # every TrainConfig field)
             raise NotImplementedError(
-                "--tp_vocab is wired for run_clm --model_family llama with "
-                "--tensor_parallel > 1 only; this entry point's loss would "
-                "silently ignore it"
+                "--tp_vocab is wired for run_clm's dense dp x tp paths "
+                "(gpt2 and llama families) only; this entry point's loss "
+                "would silently ignore it"
             )
         self.batch_spec = batch_spec if batch_spec is not None else P(DATA_AXIS)
         # number of ways batch ROWS (dim 0) are sharded: data alone normally;
@@ -292,15 +292,6 @@ class Trainer:
             param_specs = jax.tree.map(lambda _: P(), params)
         elif not cfg.lion:
             raise NotImplementedError("tensor-parallel param_specs require the Lion path")
-        if (cfg.max_grad_norm is not None
-                and dict(mesh.shape).get(TENSOR_AXIS, 1) > 1):
-            raise NotImplementedError(
-                "stochastic binarization (max_grad_norm) under tensor "
-                "parallelism is not wired: TP gradients carry constant "
-                "per-leaf W^k scale factors (parallel/tensor_parallel.py "
-                "docstring) that deterministic sign votes absorb but the "
-                "magnitude-dependent Bernoulli quantizer would not"
-            )
         self.param_specs = param_specs
         if cfg.lion and cfg.vote_every > 1:
             sharded_axes = {
@@ -855,11 +846,29 @@ class Trainer:
                            param_specs=moe_specs, loss_fn=moe_loss,
                            batch_spec=moe_batch_spec)
 
+        if cfg.tp_vocab and tp <= 1:
+            raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "
+                             "shards the tied embedding over the tensor axis)")
+        if cfg.tp_vocab and cfg.vocab_chunks > 0:
+            raise NotImplementedError(
+                "--tp_vocab and --vocab_chunks are alternative head "
+                "strategies; pick one"
+            )
+        if cfg.tp_vocab and dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+            raise NotImplementedError(
+                "--tp_vocab under --seq_parallel is not wired; pick one"
+            )
         param_specs = None
         tp_axis = None
         if tp > 1:
             validate_tp(model_cfg, tp, "gpt2")
-            param_specs = gpt2_param_specs(model_cfg)
+            if cfg.tp_vocab and model_cfg.vocab_size % tp:
+                raise ValueError(
+                    f"--tp_vocab: vocab {model_cfg.vocab_size} not divisible "
+                    f"by tensor axis {tp}"
+                )
+            param_specs = gpt2_param_specs(model_cfg,
+                                           vocab_parallel=cfg.tp_vocab)
             tp_axis = TENSOR_AXIS
 
         sp = dict(mesh.shape).get(SEQ_AXIS, 1)
@@ -899,7 +908,24 @@ class Trainer:
             return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key,
                               tp_axis=tp_axis, seq_axis=seq_axis)
 
-        if cfg.vocab_chunks > 0 and loss_fn is None:
+        if cfg.tp_vocab and loss_fn is None:
+            from distributed_lion_tpu.models.gpt2 import gpt2_hidden
+            from distributed_lion_tpu.ops.xent import tp_vocab_clm_loss_and_metrics
+
+            def loss_fn(params, batch, dropout_key):
+                # params["wte"] is this rank's [V/tp, d] vocab-row slice:
+                # VocabParallelEmbedding on the way in, its transpose as the
+                # tied vocab-parallel head on the way out
+                hidden, _ = gpt2_hidden(params, batch, model_cfg,
+                                        dropout_key=dropout_key,
+                                        tp_axis=tp_axis,
+                                        vocab_axis=TENSOR_AXIS)
+                return tp_vocab_clm_loss_and_metrics(
+                    hidden, params["wte"].T, batch, TENSOR_AXIS)
+
+            loss_fn._tp_vocab = True  # consumed; don't trip the guard
+
+        elif cfg.vocab_chunks > 0 and loss_fn is None:
             from distributed_lion_tpu.models.gpt2 import gpt2_hidden
             from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
 
