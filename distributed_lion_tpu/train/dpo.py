@@ -52,7 +52,15 @@ def sequence_logprob_seq_parallel(
     lmask = lmask.at[:, -1].set(jnp.where(is_last, 0.0, lmask[:, -1]))
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return jax.lax.psum((ll * lmask).sum(-1), axis_name)
+    # the reduced [B] logprob is consumed replicated (every shard computes
+    # the same pairwise loss), so the exit reduce is the Megatron g operator
+    # — identity backward; a raw psum's transpose would scale every
+    # adapter gradient by S (uniform, so sign-Lion hid it, but exact is
+    # exact). The train loop's seq-axis grad psum then sums the per-shard
+    # partial cotangent paths into the full gradient.
+    from distributed_lion_tpu.parallel.tensor_parallel import reduce_from_tp_region
+
+    return reduce_from_tp_region((ll * lmask).sum(-1), axis_name)
 
 
 def make_dpo_loss_fn(
