@@ -54,6 +54,9 @@ class ModelArguments:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = True  # per-block activation remat (off = faster when HBM allows)
+    remat_policy: str = "full"  # 'full' (recompute the whole block) |
+    # 'dots' (keep matmul outputs, recompute elementwise — cheaper backward
+    # at slightly more HBM; models/gpt2._remat_policy)
     moe_experts: int = 0  # > 0: Switch-MoE FFN every moe_every-th block
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
@@ -245,6 +248,7 @@ def main(argv=None):
         param_dtype=dtypes[model_args.param_dtype],
         compute_dtype=dtypes[model_args.compute_dtype],
         remat=model_args.remat,
+        remat_policy=model_args.remat_policy,
         seq_impl=model_args.seq_impl,
         moe_experts=model_args.moe_experts,
         moe_every=model_args.moe_every,
@@ -299,7 +303,8 @@ def main(argv=None):
         # the gpt2 `common` kwargs minus the fields LlamaConfig doesn't have
         # (dropout, moe_*)
         llama_common = {k: common[k] for k in
-                        ("param_dtype", "compute_dtype", "remat", "seq_impl")}
+                        ("param_dtype", "compute_dtype", "remat",
+                         "remat_policy", "seq_impl")}
         model_cfg = LlamaConfig.named(model_args.model_name, **llama_common)
     elif model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)

@@ -51,6 +51,10 @@ class GPT2Config:
     # two collective hops but full-T local attention)
     remat: bool = True  # rematerialize blocks (HBM for FLOPs); turn off when
                         # activations fit — backward skips the fwd recompute
+    remat_policy: str = "full"  # what the per-block checkpoint SAVES:
+    # 'full' (nothing — recompute everything), 'dots' (keep matmul outputs,
+    # recompute elementwise/softmax — the usual best trade on TPU: matmuls
+    # are the expensive recompute, elementwise is free next to HBM)
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     moe_experts: int = 0  # > 0: Switch-MoE FFN (parallel/expert.py) replaces
@@ -257,7 +261,17 @@ def _block(x, p, key, cfg: GPT2Config, tp_axis=None, seq_axis=None):
     return x
 
 
-_block_remat = partial(jax.checkpoint, static_argnums=(3, 4, 5))(_block)
+def _remat_policy(name: str):
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "full":
+        return None  # save nothing: recompute the whole block in backward
+    raise ValueError(f"unknown remat_policy {name!r} (full | dots)")
+
+
+def _block_remat_for(cfg):
+    return partial(jax.checkpoint, static_argnums=(3, 4, 5),
+                   policy=_remat_policy(cfg.remat_policy))(_block)
 
 
 def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
@@ -280,7 +294,9 @@ def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
     return x, aux
 
 
-_moe_block_remat = partial(jax.checkpoint, static_argnums=(3, 4))(_moe_block)
+def _moe_block_remat_for(cfg):
+    return partial(jax.checkpoint, static_argnums=(3, 4),
+                   policy=_remat_policy(cfg.remat_policy))(_moe_block)
 
 
 def vocab_parallel_embed(wte_shard: jnp.ndarray, tokens: jnp.ndarray,
@@ -345,8 +361,8 @@ def gpt2_hidden(
         else list(jax.random.split(dropout_key, cfg.n_layer + 1))
     )
     x = _dropout(x, cfg.dropout, keys[-1])
-    block = _block_remat if cfg.remat else _block
-    moe_block = _moe_block_remat if cfg.remat else _moe_block
+    block = _block_remat_for(cfg) if cfg.remat else _block
+    moe_block = _moe_block_remat_for(cfg) if cfg.remat else _moe_block
     aux_total = jnp.float32(0)
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
         if "moe" in p:  # static pytree-structure branch, resolved at trace

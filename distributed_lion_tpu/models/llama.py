@@ -44,6 +44,8 @@ class LlamaConfig:
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash
     seq_impl: str = "ring"   # sequence-parallel attention: ring | ulysses
     remat: bool = True  # per-block jax.checkpoint; off when activations fit
+    remat_policy: str = "full"  # 'full' | 'dots' (keep matmul outputs,
+    # recompute elementwise — models/gpt2._remat_policy)
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -208,7 +210,11 @@ def _block(x, p, cfg: LlamaConfig, cos, sin, tp_axis=None, seq_axis=None):
     return x
 
 
-_block_remat = partial(jax.checkpoint, static_argnums=(2, 5, 6))(_block)
+def _block_remat_for(cfg):
+    from distributed_lion_tpu.models.gpt2 import _remat_policy
+
+    return partial(jax.checkpoint, static_argnums=(2, 5, 6),
+                   policy=_remat_policy(cfg.remat_policy))(_block)
 
 
 def llama_init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> list:
@@ -291,7 +297,7 @@ def llama_hidden(
         offset = jax.lax.axis_index(seq_axis) * T
     x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
     cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta, offset=offset)
-    block = _block_remat if cfg.remat else _block
+    block = _block_remat_for(cfg) if cfg.remat else _block
     for p in params["blocks"]:
         x = block(x, p, cfg, cos, sin, tp_axis, seq_axis)
     return _rms_norm(x, params["ln_f"], cfg.rms_eps)

@@ -68,3 +68,34 @@ def test_dropout_changes_output_only_with_key():
     d = gpt2_apply(params, toks, cfg)
     assert not np.array_equal(np.asarray(a), np.asarray(b))
     np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+
+
+def test_remat_policy_dots_matches_full():
+    """remat_policy is a perf knob, not a numerics knob: same loss, same
+    grads as the full-recompute policy."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_apply, gpt2_init
+
+    toks = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 32)), jnp.int32)
+
+    def loss_for(policy):
+        cfg = GPT2Config.tiny(remat=True, remat_policy=policy)
+        params = gpt2_init(jax.random.key(0), cfg)
+
+        def loss(p):
+            logits = gpt2_apply(p, toks, cfg)
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        return jax.value_and_grad(loss)(params)
+
+    l_full, g_full = loss_for("full")
+    l_dots, g_dots = loss_for("dots")
+    np.testing.assert_allclose(float(l_full), float(l_dots), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_dots)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
