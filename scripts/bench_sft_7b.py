@@ -30,7 +30,8 @@ TIMED_CALLS = 2
 
 def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         vocab_chunks: int = 8, n_layer: int | None = None,
-        seq_len: int = 1024, model: str = "llama2_7b") -> None:
+        seq_len: int = 1024, model: str = "llama2_7b",
+        remat_policy: str = "full") -> None:
     import jax
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -46,7 +47,7 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
     mesh = make_mesh()
     kw = {} if n_layer is None else {"n_layer": n_layer}
     ctor = {"llama2_7b": LlamaConfig.llama2_7b, "tiny": LlamaConfig.tiny}[model]
-    model_cfg = ctor(**kw)
+    model_cfg = ctor(remat_policy=remat_policy, **kw)
     cfg = TrainConfig(
         lion=True, async_grad=True, learning_rate=1e-4, weight_decay=0.0,
         warmup_steps=10, max_steps=10_000,
@@ -118,6 +119,7 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         "quant": quant, "n_layer": model_cfg.n_layer,
         "base_params": n_base, "adapter_params": n_adapter,
         "batch_per_dev": batch_per_dev, "accum": accum, "seq_len": seq_len,
+        "remat_policy": remat_policy,
         "vocab_chunks": vocab_chunks, "device_kind": device_kind,
         "compile_s": round(compile_s, 1), "loss": round(loss, 3),
         "ms_per_step": round(dt / steps * 1e3, 1),
@@ -129,11 +131,11 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
 if __name__ == "__main__":
     specs = sys.argv[1:] or ["nf4:1:4:8"]
     for spec in specs:
-        parts = (spec.split(":") + ["1", "4", "8", "", "1024"])[:6]
-        quant, bs, accum, vc, nl, sl = parts
+        parts = (spec.split(":") + ["1", "4", "8", "", "1024", "full"])[:7]
+        quant, bs, accum, vc, nl, sl, pol = parts
         try:
             run(quant, int(bs), int(accum), int(vc or 0),
-                None if not nl else int(nl), int(sl))
+                None if not nl else int(nl), int(sl), remat_policy=pol or "full")
         except Exception as e:
             print(json.dumps({"spec": spec,
                               "error": str(e).split("\n")[0][:200]}), flush=True)

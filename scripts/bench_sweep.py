@@ -21,7 +21,7 @@ K = 10          # steps per device dispatch
 N_CHUNKS = 4    # timed dispatches → K * N_CHUNKS steps
 
 
-def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
+def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         accum: int = 1, dtype: str = "f32", vocab_chunks: int = 0) -> float:
     import jax
     import jax.numpy as jnp
@@ -42,8 +42,9 @@ def run(remat: bool, batch_per_dev: int, attn_impl: str = "auto",
         attn_impl, blocks = attn_impl.split("@", 1)
         bq, bkv = (int(x) for x in blocks.split("x"))
     model_cfg = dataclasses.replace(
-        GPT2Config.gpt2_124m(), remat=remat, attn_impl=attn_impl,
-        flash_block_q=bq, flash_block_kv=bkv,
+        GPT2Config.gpt2_124m(), remat=remat != "noremat",
+        remat_policy="dots" if remat == "dots" else "full",
+        attn_impl=attn_impl, flash_block_q=bq, flash_block_kv=bkv,
         param_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
     )
     cfg = TrainConfig(
@@ -94,10 +95,10 @@ if __name__ == "__main__":
         remat_s, bs_s, attn, accum_s, dtype = parts[:5]
         vc = int(parts[5]) if len(parts) > 5 else 0
         try:
-            run(remat_s == "remat", int(bs_s), attn, int(accum_s), dtype, vc)
+            run(remat_s, int(bs_s), attn, int(accum_s), dtype, vc)
         except Exception as e:  # OOM on big configs: report and keep sweeping
             print(json.dumps({
-                "remat": remat_s == "remat", "batch_per_dev": int(bs_s),
+                "remat": remat_s, "batch_per_dev": int(bs_s),
                 "attn": attn, "accum": int(accum_s), "dtype": dtype,
                 "vocab_chunks": vc, "error": str(e).split("\n")[0][:160],
             }), flush=True)
