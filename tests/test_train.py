@@ -148,6 +148,40 @@ def test_checkpoint_resume_exact(tmp_path):
     t_cont.close()
 
 
+def test_checkpoint_resume_exact_under_tp_vocab(tmp_path):
+    """Resume with TENSOR-SHARDED params (incl. the vocab-row-sharded tied
+    embedding of --tp_vocab): Orbax must restore every shard to its rank and
+    the continued trajectory must equal the uninterrupted one."""
+    mesh = make_mesh(data=4, tensor=2)
+    model_cfg = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(512, 32, model_cfg.vocab_size)
+    kw = dict(max_steps=12, tp_vocab=True)
+
+    t_cont = Trainer.for_gpt2(_tiny_cfg(**kw), mesh, model_cfg)
+    t_cont.train(batch_iterator(blocks, t_cont.global_train_batch(), seed=9),
+                 max_steps=12)
+
+    cfg_a = _tiny_cfg(output_dir=str(tmp_path / "run"), save_steps=10**9, **kw)
+    t1 = Trainer.for_gpt2(cfg_a, mesh, model_cfg)
+    t1.train(batch_iterator(blocks, t1.global_train_batch(), seed=9),
+             max_steps=6)
+    t1.save()
+    t1.close()
+
+    t2 = Trainer.for_gpt2(cfg_a, mesh, model_cfg)
+    assert t2.step_count == 6, "did not resume from checkpoint"
+    # restored wte must still be vocab-row-sharded, not gathered
+    assert (t2.params["wte"].addressable_shards[0].data.shape[0]
+            == model_cfg.vocab_size // 2)
+    t2.train(batch_iterator(blocks, t2.global_train_batch(), seed=9),
+             max_steps=6)
+
+    for a, b in zip(jax.tree.leaves(t_cont.params), jax.tree.leaves(t2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    t2.close()
+    t_cont.close()
+
+
 def test_clip_by_global_norm():
     from distributed_lion_tpu.train.loop import clip_by_global_norm
 
