@@ -89,6 +89,31 @@ def test_replica_consistency_and_unanimity():
     assert out[0, :10].all() and not out[0, 10:20].any()
 
 
+@pytest.mark.parametrize("w,g", [(2, 1), (2, 2), (4, 2), (6, 2), (6, 3),
+                                 (8, 2), (8, 4)])
+def test_hier_matches_numpy_oracle(w, g):
+    """Fuzz: elected bits equal a numpy majority-of-majorities oracle for
+    every (world, group) combination the 8-device mesh can host."""
+    rng = np.random.default_rng(w * 10 + g)
+    votes = rng.random((w, 97)) < 0.5
+    mesh = Mesh(np.array(jax.devices()[:w]), ("data",))
+
+    def body(v):
+        return majority_vote(v[0], "data", f"hier:{g}")[None]
+
+    out = shard_map(body, mesh=mesh, in_specs=P("data"),
+                    out_specs=P("data"))(jnp.asarray(votes))
+    got = np.asarray(out)[0]
+
+    groups = votes.reshape(w // g, g, -1)
+    tallies = groups.sum(1) * 2 - g            # per-group ±1 sums
+    verdicts = tallies > 0                     # group tie → -1
+    expected = verdicts.sum(0) * 2 > (w // g)  # group-level tie → -1
+    np.testing.assert_array_equal(got, expected)
+    for row in np.asarray(out)[1:]:
+        np.testing.assert_array_equal(row, got)
+
+
 def test_group_size_must_divide_world():
     votes = np.zeros((W, 16), bool)
     with pytest.raises(ValueError, match="divide"):
