@@ -94,6 +94,26 @@ def test_wire_paths_agree():
             np.testing.assert_array_equal(np.asarray(outs[0][k]), np.asarray(other[k]))
 
 
+@pytest.mark.parametrize("world", [2, 3, 5, 6, 7])
+def test_wire_paths_agree_odd_worlds(world):
+    """Flat wires elect identically at non-power-of-two worlds — exercises
+    packed_a2a's uneven chunk padding and packed_allgather's bit trimming."""
+    mesh = make_mesh(data=world, devices=jax.devices()[:world])
+    params = _params()
+    grads = _stacked_grads(world, seed=world)
+    outs = []
+    for wire in ("sign_psum", "packed_allgather", "packed_a2a",
+                 f"hier:{world}"):  # g=W degenerates to the flat vote
+        opt = distributed_lion(learning_rate=0.05, wire=wire)
+        state = shard_state(init_global_state(opt, params, world=world), mesh)
+        new_p, _ = _run_steps(mesh, opt, params, grads, state, n=2)
+        outs.append(new_p)
+    for k in params:
+        for other in outs[1:]:
+            np.testing.assert_array_equal(np.asarray(outs[0][k]),
+                                          np.asarray(other[k]))
+
+
 def test_stochastic_composes_with_every_wire():
     """Stochastic binarization draws ballots from (rng, count, worker) only
     — the wire moves them. With identical draws, every flat wire (and hier
