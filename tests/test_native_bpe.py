@@ -175,6 +175,34 @@ def test_tokenize_corpus_parallel_deterministic(tok, tmp_path):
     np.testing.assert_array_equal(outs[0], outs[1])
 
 
+def test_corpus_to_training_end_to_end(tok, tmp_path):
+    """The full data path: text corpus → tokenize_corpus shards (C++ BPE)
+    → run_clm on bin: via the C++ mmap loader → vote-Lion training steps."""
+    from distributed_lion_tpu.cli.run_clm import main as run_clm_main
+    from distributed_lion_tpu.cli.tokenize_corpus import main as tok_main
+
+    tok.save(str(tmp_path / "tok"))
+    # enough text for a few 32-token blocks
+    big = tmp_path / "corpus"
+    big.mkdir()
+    for i in range(4):
+        (big / f"doc{i}.txt").write_text(CORPUS[i % len(CORPUS)] * 3,
+                                         encoding="utf-8")
+    out = tmp_path / "bins"
+    tok_main([
+        "--text", str(big / "*.txt"), "--tokenizer", f"bpe:{tmp_path/'tok'}",
+        "--output_dir", str(out), "--num_proc", "1",
+    ])
+    run_clm_main([
+        "--model_name", "tiny", "--dataset", f"bin:{out}/shard_*.bin",
+        "--vocab_size", str(tok.vocab_size), "--lion", "--async_grad",
+        "--max_steps", "2", "--per_device_train_batch_size", "1",
+        "--gradient_accumulation_steps", "1", "--block_size", "32",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000",
+    ])
+
+
 def test_tokenized_bins_feed_token_dataset(tok, tmp_path):
     from distributed_lion_tpu.cli.tokenize_corpus import main
     from distributed_lion_tpu.data.sources import TokenDataset
