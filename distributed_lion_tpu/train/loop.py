@@ -88,6 +88,10 @@ class TrainConfig:
     # step, the BASELINE.md comm budget), stale elected signs applied
     # elsewhere (optim.distributed_lion).
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
+    mom_dtype: str = ""  # Lion momentum dtype override ('bfloat16' halves
+    # the per-worker optimizer state and its read/write traffic — at 7B
+    # full-param scale that is ~14 GB of HBM; '' = the param dtype, the
+    # reference's exp_avg = zeros_like(p) behavior)
     vocab_chunks: int = 0  # > 0: chunked-vocab cross entropy (ops/xent) —
     # the [B,T,V] f32 logits (the largest activation at GPT-2 124M: ~823MB
     # per microbatch) are never materialized; streaming logsumexp over V/N
@@ -169,6 +173,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             "all_gather would stitch together chunk-wise single-worker updates"
         )
     if cfg.lion:
+        mom_dtype = jnp.dtype(cfg.mom_dtype) if cfg.mom_dtype else None
         return distributed_lion(
             cfg.schedule(),
             b1=cfg.beta1,
@@ -179,6 +184,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             wire=cfg.wire,
             vote_every=cfg.vote_every,
             kernel=cfg.kernel,
+            mom_dtype=mom_dtype,
         )
     if cfg.async_grad:
         raise ValueError(
