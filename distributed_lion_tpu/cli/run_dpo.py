@@ -43,6 +43,8 @@ class DPOArguments:
     lora_r: int = 8
     lora_alpha: int = 16
     tokenizer_name: Optional[str] = None
+    adapter_output: Optional[str] = None  # save the trained policy LoRA
+    # adapters as a HF PEFT checkpoint directory (models/hf_export.lora_to_peft)
     merged_output: Optional[str] = None  # save the LoRA-merged policy here:
     # *.npz → flat save_pytree archive; any other path → HF save_pretrained
     # directory (models/hf_export)
@@ -232,6 +234,13 @@ def main(argv=None):
             trainer.evaluate(eval_data)
         if trainer.checkpointer:
             trainer.save()
+        if script_args.adapter_output:
+            from distributed_lion_tpu.models.hf_export import lora_to_peft
+
+            lora_to_peft(jax.device_get(trainer.params), model_cfg, lora_cfg,
+                         script_args.adapter_output,
+                         base_model_name=script_args.model_path or "")
+            print(f"[run_dpo] PEFT adapter saved to {script_args.adapter_output}")
         if script_args.merged_output:
             merged = dequantize_tree(merge_lora(base_params, trainer.params, lora_cfg))
             if script_args.merged_output.endswith(".npz"):

@@ -47,6 +47,10 @@ class SFTArguments:
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash | splash
     seq_impl: str = "ring"   # under --seq_parallel: ring | ulysses
     tokenizer_name: Optional[str] = None
+    adapter_output: Optional[str] = None  # save the trained LoRA adapters
+    # as a HF PEFT checkpoint directory (adapter_model.safetensors +
+    # adapter_config.json — PeftModel.from_pretrained-loadable; the
+    # reference's pre-merge save_model artifact, sft_llama2.py:183-190)
     merged_output: Optional[str] = None  # save the LoRA-merged model here:
     # a *.npz path → flat save_pytree archive (cli/run_generate's format);
     # any other path → an HF save_pretrained directory
@@ -297,6 +301,13 @@ def main(argv=None):
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:
             trainer.save()
+        if script_args.adapter_output:
+            from distributed_lion_tpu.models.hf_export import lora_to_peft
+
+            lora_to_peft(jax.device_get(trainer.params), model_cfg, lora_cfg,
+                         script_args.adapter_output,
+                         base_model_name=script_args.model_path or "")
+            print(f"[run_sft] PEFT adapter saved to {script_args.adapter_output}")
         # merge_and_unload parity (sft_llama2.py:183-199)
         if script_args.merged_output:
             from distributed_lion_tpu.ops.quant import dequantize_tree

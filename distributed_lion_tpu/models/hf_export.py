@@ -31,11 +31,23 @@ def _to_numpy(tree):
     return jax.tree.map(lambda x: np.asarray(x), tree)
 
 
+def _write_tensors(tensors: dict, path: str, stem: str) -> None:
+    """{name: torch.Tensor} → <stem>.safetensors (or <stem>.bin fallback)."""
+    import torch
+
+    os.makedirs(path, exist_ok=True)
+    try:
+        from safetensors.torch import save_file
+
+        save_file(tensors, os.path.join(path, f"{stem}.safetensors"))
+    except ImportError:  # pragma: no cover
+        torch.save(tensors, os.path.join(path, f"{stem}.bin"))
+
+
 def _save_state_dict(sd: dict, path: str, config: dict) -> None:
     """{name: np.ndarray} → model.safetensors + config.json under path."""
     import torch
 
-    os.makedirs(path, exist_ok=True)
     tensors = {}
     for k, v in sd.items():
         arr = np.ascontiguousarray(v)
@@ -44,12 +56,7 @@ def _save_state_dict(sd: dict, path: str, config: dict) -> None:
         else:
             t = torch.from_numpy(arr.copy())
         tensors[k] = t
-    try:
-        from safetensors.torch import save_file
-
-        save_file(tensors, os.path.join(path, "model.safetensors"))
-    except ImportError:  # pragma: no cover
-        torch.save(tensors, os.path.join(path, "pytorch_model.bin"))
+    _write_tensors(tensors, path, "model")
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(config, f, indent=1)
 
@@ -138,6 +145,75 @@ def _rope_from_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
     hd = out // n_heads
     w = w_out_in.reshape(n_heads, hd // 2, 2, d_in)
     return np.ascontiguousarray(w.transpose(0, 2, 1, 3)).reshape(out, d_in)
+
+
+# our llama leaf name → (PEFT module path, heads attr for rope un-permute)
+_PEFT_MODULES = {
+    "wq": ("self_attn.q_proj", "n_head"),
+    "wk": ("self_attn.k_proj", "n_kv_head"),
+    "wv": ("self_attn.v_proj", None),
+    "wo": ("self_attn.o_proj", None),
+    "w_gate": ("mlp.gate_proj", None),
+    "w_up": ("mlp.up_proj", None),
+    "w_down": ("mlp.down_proj", None),
+}
+
+
+def lora_to_peft(adapters: dict, model_cfg: Any, lora_cfg: Any,
+                 path: str, base_model_name: str = "") -> None:
+    """Export trained LoRA adapters as a HF PEFT checkpoint directory.
+
+    The reference's SFT saves the PEFT adapter before merging
+    (sft_llama2.py:183-190, ``trainer.save_model`` on a peft-wrapped model);
+    this is that artifact for our adapters: ``adapter_model.safetensors`` +
+    ``adapter_config.json``, loadable by ``peft.PeftModel.from_pretrained``
+    on top of an exported base (:func:`llama_to_hf`) — logit parity with
+    our ``apply_adapters`` forward is pinned by tests/test_hf_export.py.
+
+    Layout mapping per adapted leaf (ours: A [in, r], B [r, out] on a
+    [in, out] matmul weight): PEFT's lora_A.weight = A.T, lora_B.weight =
+    B.T — with q/k projections additionally un-permuting B's output rows
+    from our interleaved RoPE layout to HF's half-rotation
+    (:func:`_rope_from_interleaved`). ``scaling = alpha/r`` matches PEFT's
+    convention, so values export verbatim.
+    """
+    import torch
+
+    sd = {}
+    modules = set()
+    for apath, ab in adapters.items():
+        parts = apath.split("/")  # e.g. blocks/3/attn/wq
+        if parts[0] != "blocks" or parts[-1] not in _PEFT_MODULES:
+            raise ValueError(
+                f"adapter on {apath!r} has no PEFT-Llama equivalent "
+                f"(exportable targets: {sorted(_PEFT_MODULES)})"
+            )
+        layer = parts[1]
+        module, heads_attr = _PEFT_MODULES[parts[-1]]
+        A = np.ascontiguousarray(np.asarray(ab["A"]).T)  # [r, in]
+        B = np.ascontiguousarray(np.asarray(ab["B"]).T)  # [out, r]
+        if heads_attr is not None:
+            B = _rope_from_interleaved(B, int(getattr(model_cfg, heads_attr)))
+        prefix = f"base_model.model.model.layers.{layer}.{module}"
+        sd[f"{prefix}.lora_A.weight"] = torch.from_numpy(A.astype(np.float32))
+        sd[f"{prefix}.lora_B.weight"] = torch.from_numpy(B.astype(np.float32))
+        modules.add(module.split(".")[-1])
+
+    _write_tensors(sd, path, "adapter_model")
+    config = {
+        "peft_type": "LORA",
+        "task_type": "CAUSAL_LM",
+        "r": int(lora_cfg.r),
+        "lora_alpha": int(lora_cfg.alpha),
+        "lora_dropout": 0.0,
+        "bias": "none",
+        "fan_in_fan_out": False,
+        "inference_mode": True,
+        "target_modules": sorted(modules),
+        "base_model_name_or_path": base_model_name,
+    }
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(config, f, indent=1)
 
 
 def llama_to_hf(params: dict, cfg: Any, path: str) -> None:

@@ -187,6 +187,46 @@ def test_run_dpo_merged_hf_output(tmp_path):
     assert model.config.num_hidden_layers == 2
 
 
+def test_lora_peft_export_parity(tmp_path):
+    """Export base + trained-style adapters; load with the REAL peft library
+    (PeftModel.from_pretrained over our exported base) and demand its logits
+    match our apply_adapters forward — pinning the A/B transposes, the
+    q-projection RoPE un-permute, and the alpha/r scaling convention."""
+    peft = pytest.importorskip("peft")
+
+    from distributed_lion_tpu.models.hf_export import llama_to_hf, lora_to_peft
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
+    from distributed_lion_tpu.models.lora import (
+        LoraConfig,
+        apply_adapters,
+        lora_init,
+    )
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    base = llama_init(jax.random.key(10), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=("wq", "wk", "wv", "wo"))
+    adapters = lora_init(jax.random.key(11), base, lcfg)
+    # B inits to zero (identity adapter); randomize so the delta is live
+    adapters = jax.tree.map(
+        lambda x: jax.random.normal(jax.random.key(12), x.shape) * 0.1,
+        adapters)
+
+    llama_to_hf(base, cfg, str(tmp_path / "base"))
+    lora_to_peft(adapters, cfg, lcfg, str(tmp_path / "adapter"))
+
+    hf_base = transformers.LlamaForCausalLM.from_pretrained(
+        str(tmp_path / "base")).eval()
+    pm = peft.PeftModel.from_pretrained(hf_base, str(tmp_path / "adapter")).eval()
+
+    tokens = _tokens(cfg.vocab_size, rng_seed=13)
+    with torch.no_grad():
+        ref = pm(torch.from_numpy(tokens)).logits.numpy()
+
+    effective = apply_adapters(base, adapters, lcfg)
+    got = np.asarray(llama_apply(effective, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
 def test_sft_merged_model_exports(tmp_path):
     """The reference's closing flow: LoRA-SFT → merge → save (sft_llama2.py:
     183-199) lands in an HF-loadable directory."""
