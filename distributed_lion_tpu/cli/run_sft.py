@@ -47,6 +47,9 @@ class SFTArguments:
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash | splash
     seq_impl: str = "ring"   # under --seq_parallel: ring | ulysses
     tokenizer_name: Optional[str] = None
+    adapter_path: Optional[str] = None  # start from a PEFT adapter
+    # checkpoint (adapter_config.json + adapter_model.safetensors) instead
+    # of fresh lora_init — models/hf_import.peft_to_lora
     adapter_output: Optional[str] = None  # save the trained LoRA adapters
     # as a HF PEFT checkpoint directory (adapter_model.safetensors +
     # adapter_config.json — PeftModel.from_pretrained-loadable; the
@@ -171,8 +174,18 @@ def main(argv=None):
         print(f"[run_sft] quantizing frozen base to {script_args.quant}")
         base_params = quantize_tree(base_params, script_args.quant)
 
-    lora_cfg = LoraConfig(r=script_args.lora_r, alpha=script_args.lora_alpha)
-    adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
+    if script_args.adapter_path:
+        # continue training a PEFT checkpoint (ours via --adapter_output, or
+        # one trained by the torch/peft stack) — r/alpha/targets come from
+        # its adapter_config.json, overriding --lora_r/--lora_alpha
+        from distributed_lion_tpu.models.hf_import import peft_to_lora
+
+        adapters, lora_cfg = peft_to_lora(script_args.adapter_path, model_cfg)
+        print(f"[run_sft] resumed PEFT adapter from {script_args.adapter_path} "
+              f"(r={lora_cfg.r} alpha={lora_cfg.alpha})")
+    else:
+        lora_cfg = LoraConfig(r=script_args.lora_r, alpha=script_args.lora_alpha)
+        adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
     print(f"[run_sft] LoRA adapters: {len(adapters)} sites, {n_adapter/1e3:.1f}k trainable params")
 

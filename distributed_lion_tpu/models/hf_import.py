@@ -285,6 +285,66 @@ def llama_from_hf(path: str, param_dtype: Any = None, **config_overrides):
     return params, cfg
 
 
+def peft_to_lora(path: str, model_cfg: Any, dtype: Any = None) -> tuple:
+    """Import a HF PEFT LoRA checkpoint → (adapters pytree, LoraConfig).
+
+    Inverse of hf_export.lora_to_peft: ``lora_A.weight`` [r, in] → A [in, r],
+    ``lora_B.weight`` [out, r] → B [r, out] with q/k output rows permuted
+    from HF's half-rotation RoPE layout to ours (same transform as the base
+    import). Lets run_sft/run_dpo continue training an adapter produced by
+    the torch/PEFT stack (or by our own ``--adapter_output``).
+    """
+    import json as _json
+
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.hf_export import _PEFT_MODULES
+    from distributed_lion_tpu.models.lora import LoraConfig
+
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        pc = _json.load(f)
+    if pc.get("peft_type") != "LORA":
+        raise ValueError(f"not a LoRA adapter: peft_type={pc.get('peft_type')!r}")
+    # PEFT names its weight file adapter_model.*, not model.* — load directly
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(st_path):
+        sd = _load_safetensors(st_path)
+    else:
+        sd = _load_torch_bin(os.path.join(path, "adapter_model.bin"))
+
+    module_to_ours = {v[0]: (k, v[1]) for k, v in _PEFT_MODULES.items()}
+    dt = dtype or jnp.float32
+    adapters: dict = {}
+    for key, val in sd.items():
+        if not key.endswith(".lora_A.weight"):
+            continue
+        stem = key[: -len(".lora_A.weight")]
+        b_key = stem + ".lora_B.weight"
+        # stem like base_model.model.model.layers.3.self_attn.q_proj
+        parts = stem.split(".")
+        layer = parts[parts.index("layers") + 1]
+        module = ".".join(parts[parts.index("layers") + 2:])
+        if module not in module_to_ours:
+            raise ValueError(f"unsupported PEFT target module {module!r}")
+        ours, heads_attr = module_to_ours[module]
+        A = np.asarray(sd[key]).T                     # [in, r]
+        B = np.asarray(sd[b_key])                     # [out, r]
+        if heads_attr is not None:
+            B = _rope_to_interleaved(B, int(getattr(model_cfg, heads_attr)))
+        group = "attn" if ours.startswith("w") and ours in (
+            "wq", "wk", "wv", "wo") else "mlp"
+        adapters[f"blocks/{layer}/{group}/{ours}"] = {
+            "A": jnp.asarray(A, dt),
+            "B": jnp.asarray(np.ascontiguousarray(B.T), dt),  # [r, out]
+        }
+    if not adapters:
+        raise ValueError(f"no lora_A/lora_B pairs found under {path!r}")
+    lcfg = LoraConfig(r=int(pc["r"]), alpha=int(pc["lora_alpha"]),
+                      target_patterns=tuple(sorted(
+                          {p.split("/")[-1] for p in adapters})))
+    return adapters, lcfg
+
+
 def detect_family(path: str) -> str:
     """'gpt2' | 'llama' from config.json (or key shapes as fallback)."""
     hf_cfg = load_hf_config(path)

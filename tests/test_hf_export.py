@@ -227,6 +227,67 @@ def test_lora_peft_export_parity(tmp_path):
     np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
 
 
+def test_peft_adapter_roundtrip_exact(tmp_path):
+    """lora_to_peft → peft_to_lora is the identity on adapters (A/B values,
+    rope permutes cancel) and recovers r/alpha/targets."""
+    from distributed_lion_tpu.models.hf_export import lora_to_peft
+    from distributed_lion_tpu.models.hf_import import peft_to_lora
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+    from distributed_lion_tpu.models.lora import LoraConfig, lora_init
+
+    cfg = LlamaConfig.tiny()
+    base = llama_init(jax.random.key(20), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=("wq", "wk", "wv", "wo"))
+    adapters = lora_init(jax.random.key(21), base, lcfg)
+    adapters = jax.tree.map(
+        lambda x: jax.random.normal(jax.random.key(22), x.shape) * 0.1,
+        adapters)
+    lora_to_peft(adapters, cfg, lcfg, str(tmp_path / "pf"))
+    back, lcfg2 = peft_to_lora(str(tmp_path / "pf"), cfg)
+    assert (lcfg2.r, lcfg2.alpha) == (4, 8)
+    assert set(back) == set(adapters)
+    for k in adapters:
+        for ab in ("A", "B"):
+            np.testing.assert_allclose(np.asarray(back[k][ab]),
+                                       np.asarray(adapters[k][ab]),
+                                       rtol=1e-6, atol=1e-7)
+
+
+def test_import_real_peft_checkpoint(tmp_path):
+    """An adapter SAVED BY the torch peft library imports into our pytree
+    with forward parity — continuing HF-trained LoRA on TPU."""
+    peft = pytest.importorskip("peft")
+
+    from distributed_lion_tpu.models.hf_export import llama_to_hf
+    from distributed_lion_tpu.models.hf_import import peft_to_lora
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
+    from distributed_lion_tpu.models.lora import apply_adapters
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    base = llama_init(jax.random.key(30), cfg)
+    llama_to_hf(base, cfg, str(tmp_path / "base"))
+    hf_base = transformers.LlamaForCausalLM.from_pretrained(
+        str(tmp_path / "base"))
+    pc = peft.LoraConfig(r=4, lora_alpha=8,
+                         target_modules=["q_proj", "k_proj", "v_proj"],
+                         task_type="CAUSAL_LM", lora_dropout=0.0)
+    pm = peft.get_peft_model(hf_base, pc)
+    # randomize lora_B (init is zero → identity) so the delta is live
+    with torch.no_grad():
+        for n, p in pm.named_parameters():
+            if "lora_B" in n:
+                p.copy_(torch.randn_like(p) * 0.1)
+    pm.save_pretrained(str(tmp_path / "adapter"))
+
+    adapters, lcfg = peft_to_lora(str(tmp_path / "adapter"), cfg)
+    tokens = _tokens(cfg.vocab_size, rng_seed=31)
+    with torch.no_grad():
+        ref = pm(torch.from_numpy(tokens)).logits.numpy()
+    effective = apply_adapters(base, adapters, lcfg)
+    got = np.asarray(llama_apply(effective, jnp.asarray(tokens, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
 def test_sft_merged_model_exports(tmp_path):
     """The reference's closing flow: LoRA-SFT → merge → save (sft_llama2.py:
     183-199) lands in an HF-loadable directory."""
@@ -247,3 +308,24 @@ def test_sft_merged_model_exports(tmp_path):
     for a, b in zip(jax.tree.leaves(merged), jax.tree.leaves(back)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_run_sft_adapter_chain(tmp_path):
+    """run_sft --adapter_output then run_sft --adapter_path: the PEFT
+    checkpoint round-trips through the CLI surface."""
+    from distributed_lion_tpu.cli.run_sft import main
+
+    common = [
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
+        "--num_train_samples", "32", "--size_valid_set", "0",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000",
+    ]
+    main(common + ["--adapter_output", str(tmp_path / "a1"), "--lora_r", "4"])
+    main(common + ["--adapter_path", str(tmp_path / "a1"),
+                   "--adapter_output", str(tmp_path / "a2")])
+    import json
+    cfg2 = json.loads((tmp_path / "a2" / "adapter_config.json").read_text())
+    assert cfg2["r"] == 4  # checkpoint's r carried through, not the CLI default
