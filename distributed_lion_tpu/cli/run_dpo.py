@@ -43,6 +43,8 @@ class DPOArguments:
     lora_r: int = 8
     lora_alpha: int = 16
     tokenizer_name: Optional[str] = None
+    adapter_path: Optional[str] = None  # start the policy from a PEFT
+    # adapter checkpoint (models/hf_import.peft_to_lora) instead of fresh init
     adapter_output: Optional[str] = None  # save the trained policy LoRA
     # adapters as a HF PEFT checkpoint directory (models/hf_export.lora_to_peft)
     merged_output: Optional[str] = None  # save the LoRA-merged policy here:
@@ -132,11 +134,18 @@ def main(argv=None):
         ref_params = quantize_tree(base_params, script_args.quant_ref)
 
     # LoRA on the policy, the reference's wider DPO target set (:192-207).
-    lora_cfg = LoraConfig(
-        r=script_args.lora_r, alpha=script_args.lora_alpha,
-        target_patterns=("wq", "wk", "wv", "wo", "q_proj", "k_proj", "v_proj", "out_proj"),
-    )
-    adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
+    if script_args.adapter_path:
+        from distributed_lion_tpu.models.hf_import import peft_to_lora
+
+        adapters, lora_cfg = peft_to_lora(script_args.adapter_path, model_cfg)
+        print(f"[run_dpo] resumed PEFT adapter from {script_args.adapter_path} "
+              f"(r={lora_cfg.r} alpha={lora_cfg.alpha})")
+    else:
+        lora_cfg = LoraConfig(
+            r=script_args.lora_r, alpha=script_args.lora_alpha,
+            target_patterns=("wq", "wk", "wv", "wo", "q_proj", "k_proj", "v_proj", "out_proj"),
+        )
+        adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
 
     tp = train_cfg.tensor_parallel
     frozen_params = frozen_specs = None
