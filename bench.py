@@ -212,7 +212,10 @@ def main() -> None:
     """Orchestrator: run the measurement in a child process under a hard
     timeout, retry on failure, fall back to CPU, and ALWAYS print one JSON
     line and exit 0. Never imports jax itself (backend init can hang)."""
-    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
+    # a healthy TPU run needs ~2-4 min (compile + 50 fused steps); 900s is
+    # ample headroom while keeping the worst-case hung-backend chain
+    # (900 + 300 + CPU fallback ~400s) well inside the driver's window
+    timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
     attempts = (
         # a full-budget TPU attempt, a short retry (if the backend hung once
         # it rarely recovers seconds later — don't spend a second full
