@@ -1,0 +1,228 @@
+"""Native reader for HF fast-tokenizer ``tokenizer.json`` files (BPE models).
+
+Llama-3/Mistral-class checkpoints ship their tokenizer as a single
+``tokenizer.json`` (the HF ``tokenizers`` serialization) instead of
+SentencePiece's ``tokenizer.model``. The reference reaches these through
+``AutoTokenizer`` (/root/reference/sft_llama2.py:157-158); this module reads
+the file directly so a local checkpoint tokenizes with its true vocabulary
+(128256 for Llama-3) with no HF cache.
+
+Supported shape — the one Llama-3/GPT-2/Qwen-class models actually use:
+
+- ``model.type == "BPE"`` with ``vocab`` (token→id) + ranked ``merges``;
+- byte-level alphabet (the GPT-2 byte→unicode table, shared with data.bpe);
+- pre-tokenization: the regex from a ``Split`` pre-tokenizer (tiktoken-style
+  pattern, compiled with the ``regex`` module) and/or ``ByteLevel``; a
+  ``Sequence`` of those is walked recursively;
+- ``added_tokens`` (specials like ``<|begin_of_text|>``) matched greedily
+  before pre-tokenization, never split.
+
+Token-for-token parity with the ``tokenizers`` library on this shape is
+pinned by tests/test_llama_tokenizer.py. Anything structurally outside it
+(WordPiece/Unigram models, Metaspace pre-tokenizers, normalizers that
+rewrite text) raises loudly instead of tokenizing wrong.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, List, Optional
+
+from distributed_lion_tpu.data.bpe import bytes_to_unicode, unicode_to_bytes
+
+try:
+    import regex as _re
+except ImportError:  # pragma: no cover
+    _re = None
+
+# GPT-2's pattern, the ByteLevel pre-tokenizer's built-in default
+# (used when use_regex=true and no Split supplies one)
+_BYTELEVEL_PAT = (r"""'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+|"""
+                  r""" ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+""")
+
+
+def _collect_pretokenizers(pt: Optional[dict], out: List[dict]) -> None:
+    if pt is None:
+        return
+    t = pt.get("type")
+    if t == "Sequence":
+        for sub in pt.get("pretokenizers", []):
+            _collect_pretokenizers(sub, out)
+    else:
+        out.append(pt)
+
+
+class TokenizerJSON:
+    """Byte-level BPE driven by a ``tokenizer.json`` file.
+
+    API-compatible with data.tokenizer.ByteTokenizer (vocab_size,
+    bos/eos/pad ids, encode/decode).
+    """
+
+    def __init__(self, spec: dict):
+        if _re is None:
+            raise RuntimeError("the `regex` module is required")
+        model = spec.get("model") or {}
+        if model.get("type") != "BPE":
+            raise ValueError(
+                f"unsupported tokenizer.json model type {model.get('type')!r} "
+                "(only BPE is implemented)"
+            )
+        if spec.get("normalizer") is not None:
+            raise ValueError(
+                "tokenizer.json has a normalizer; this reader supports the "
+                "byte-level-BPE shape (Llama-3/GPT-2) which has none"
+            )
+        self.vocab: dict = dict(model["vocab"])
+        merges = model.get("merges") or []
+        self.ranks = {}
+        for i, m in enumerate(merges):
+            pair = tuple(m.split(" ", 1)) if isinstance(m, str) else tuple(m)
+            self.ranks[pair] = i
+
+        pres: List[dict] = []
+        _collect_pretokenizers(spec.get("pre_tokenizer"), pres)
+        pattern = None
+        add_prefix_space = False
+        byte_level = False
+        for pt in pres:
+            t = pt["type"]
+            if t == "Split":
+                pat = pt.get("pattern", {})
+                pattern = pat.get("Regex") if isinstance(pat, dict) else None
+                if pattern is None:
+                    raise ValueError("Split pre-tokenizer without a Regex "
+                                     "pattern is not supported")
+                if pt.get("invert"):
+                    raise ValueError("inverted Split is not supported")
+            elif t == "ByteLevel":
+                byte_level = True
+                add_prefix_space = bool(pt.get("add_prefix_space", False))
+                if pt.get("use_regex", True) and pattern is None:
+                    pattern = _BYTELEVEL_PAT
+            else:
+                raise ValueError(f"unsupported pre-tokenizer {t!r}")
+        if not byte_level:
+            raise ValueError("only byte-level BPE tokenizer.json files are "
+                             "supported (no ByteLevel pre-tokenizer found)")
+        self._pat = _re.compile(pattern) if pattern else None
+        self._add_prefix_space = add_prefix_space
+
+        self.added: dict = {}  # content -> id
+        self.special_ids: set = set()
+        for at in spec.get("added_tokens", []):
+            self.added[at["content"]] = int(at["id"])
+            if at.get("special"):
+                self.special_ids.add(int(at["id"]))
+            self.vocab.setdefault(at["content"], int(at["id"]))
+        self._added_sorted = sorted(self.added, key=len, reverse=True)
+
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+        self._b2u = bytes_to_unicode()
+        self._cache: dict = {}
+
+        def find(*names):
+            for n in names:
+                if n in self.added:
+                    return self.added[n]
+            return None
+
+        self.bos_id = find("<|begin_of_text|>", "<s>", "<|endoftext|>")
+        self.eos_id = find("<|end_of_text|>", "<|eot_id|>", "</s>",
+                           "<|endoftext|>")
+        if self.eos_id is None:
+            self.eos_id = self.bos_id if self.bos_id is not None else 0
+        if self.bos_id is None:
+            self.bos_id = self.eos_id
+        pad = find("<pad>", "<|finetune_right_pad_id|>")
+        self.pad_id = pad if pad is not None else self.eos_id
+
+    @classmethod
+    def load(cls, path: str) -> "TokenizerJSON":
+        """``path``: a ``tokenizer.json`` file or a directory holding one."""
+        if os.path.isdir(path):
+            path = os.path.join(path, "tokenizer.json")
+        with open(path, encoding="utf-8") as f:
+            return cls(json.load(f))
+
+    @property
+    def vocab_size(self) -> int:
+        return max(len(self.vocab), 1 + max(self.vocab.values(), default=0))
+
+    # ------------------------------------------------------------------ codec
+    def _bpe(self, token: str) -> List[str]:
+        """Greedy lowest-rank merge loop (same procedure as data.bpe)."""
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            first, second = best
+            out: List[str] = []
+            i = 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == first
+                        and word[i + 1] == second):
+                    out.append(first + second)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            word = tuple(out)
+        result = list(word)
+        if len(self._cache) < 65536:
+            self._cache[token] = result
+        return result
+
+    def _encode_chunk(self, text: str, ids: List[int]) -> None:
+        if not text:
+            return
+        pretoks = self._pat.findall(text) if self._pat else [text]
+        for tok in pretoks:
+            mapped = "".join(self._b2u[b] for b in tok.encode("utf-8"))
+            for piece in self._bpe(mapped):
+                ids.append(self.vocab[piece])
+
+    def encode(self, text: str, add_bos: bool = False,
+               add_eos: bool = False) -> List[int]:
+        if self._add_prefix_space and text and not text.startswith(" "):
+            text = " " + text
+        ids: List[int] = [self.bos_id] if add_bos else []
+        # added tokens match greedily before pre-tokenization
+        i = start = 0
+        while i < len(text):
+            for at in self._added_sorted:
+                if text.startswith(at, i):
+                    self._encode_chunk(text[start:i], ids)
+                    ids.append(self.added[at])
+                    i += len(at)
+                    start = i
+                    break
+            else:
+                i += 1
+        self._encode_chunk(text[start:], ids)
+        if add_eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def decode(self, ids: Iterable[int]) -> str:
+        u2b = unicode_to_bytes()
+        parts: List[str] = []
+        for i in ids:
+            i = int(i)
+            if i in self.special_ids or i not in self.inv_vocab:
+                continue
+            tok = self.inv_vocab[i]
+            if i in self.added.values():
+                parts.append(tok)
+            else:
+                parts.append(bytes(u2b[c] for c in tok if c in u2b)
+                             .decode("utf-8", "replace"))
+        text = "".join(parts)
+        if self._add_prefix_space and text.startswith(" "):
+            text = text[1:]
+        return text

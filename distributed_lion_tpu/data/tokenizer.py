@@ -48,8 +48,17 @@ def load_tokenizer(name_or_path: str | None):
     - ``bpe:<dir>`` or a directory containing ``vocab.json`` + ``merges.txt``
       → the native GPT-2 byte-level BPE (data.bpe — drop the real GPT-2
       files in and get the real 50257 vocab);
+    - ``sp:<path>``, a ``*.model`` file, or a directory containing
+      ``tokenizer.model`` → the native SentencePiece BPE reader (data.spm)
+      — a local Llama-2/Mistral checkpoint gets its true 32000 vocab;
+    - a ``tokenizer.json`` file or a directory containing one → the native
+      HF fast-tokenizer BPE reader (data.hf_tokenizer_json) — Llama-3's
+      128256 vocab, GPT-2's 50257;
     - otherwise a locally cached HF tokenizer when one exists;
-    - :class:`ByteTokenizer` as the dependency-free fallback.
+    - :class:`ByteTokenizer` as the dependency-free fallback — with a LOUD
+      warning when ``name_or_path`` was set but unresolvable, because
+      silently training a "Llama" run on the 259-id byte vocab is the
+      classic footgun.
     """
     import os
 
@@ -58,10 +67,28 @@ def load_tokenizer(name_or_path: str | None):
 
         if name_or_path.startswith("bpe:"):
             return BPETokenizer.load(name_or_path[len("bpe:"):])
+        if name_or_path.startswith("sp:"):
+            from distributed_lion_tpu.data.spm import SentencePieceTokenizer
+
+            return SentencePieceTokenizer.load(name_or_path[len("sp:"):])
         if (os.path.isdir(name_or_path)
                 and os.path.exists(os.path.join(name_or_path, "vocab.json"))
                 and os.path.exists(os.path.join(name_or_path, "merges.txt"))):
             return BPETokenizer.load(name_or_path)
+        if (name_or_path.endswith(".model") and os.path.isfile(name_or_path)
+                ) or (os.path.isdir(name_or_path) and os.path.exists(
+                    os.path.join(name_or_path, "tokenizer.model"))):
+            from distributed_lion_tpu.data.spm import SentencePieceTokenizer
+
+            return SentencePieceTokenizer.load(name_or_path)
+        if (name_or_path.endswith("tokenizer.json")
+                and os.path.isfile(name_or_path)
+                ) or (os.path.isdir(name_or_path) and os.path.exists(
+                    os.path.join(name_or_path, "tokenizer.json"))):
+            from distributed_lion_tpu.data.hf_tokenizer_json import (
+                TokenizerJSON)
+
+            return TokenizerJSON.load(name_or_path)
         try:
             from transformers import AutoTokenizer
 
@@ -89,4 +116,14 @@ def load_tokenizer(name_or_path: str | None):
             return _HFAdapter()
         except Exception:
             pass
+        import sys
+
+        print(
+            f"[tokenizer] WARNING: could not resolve {name_or_path!r} to a "
+            "real tokenizer (no vocab.json+merges.txt, tokenizer.model, "
+            "tokenizer.json, or local HF cache) — falling back to the "
+            "259-id ByteTokenizer. A Llama/GPT-2 run with this vocab is "
+            "almost certainly not what you want.",
+            file=sys.stderr,
+        )
     return ByteTokenizer()

@@ -1,0 +1,262 @@
+"""Native Llama-family tokenizers: SentencePiece BPE (tokenizer.model) and
+HF fast tokenizer.json — the formats the reference reaches through
+AutoTokenizer (/root/reference/sft_llama2.py:157-158).
+
+The tokenizer.json path is pinned token-for-token against the real HF
+``tokenizers`` library (installed in this image). The SentencePiece path is
+pinned against hand-computed merges on a tiny model built with the module's
+own proto writer (round-tripped through parse_model_proto, so the wire
+format itself is exercised)."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from distributed_lion_tpu.data.spm import (
+    SentencePieceTokenizer, parse_model_proto, write_model_proto,
+    _BYTE, _CONTROL, _NORMAL, _UNKNOWN, _USER_DEFINED,
+)
+
+
+def _tiny_sp_pieces():
+    """Llama-shaped piece table: <unk>/<s>/</s>, 256 byte pieces, then
+    BPE pieces with descending scores (score = -merge_rank)."""
+    pieces = [("<unk>", 0.0, _UNKNOWN), ("<s>", 0.0, _CONTROL),
+              ("</s>", 0.0, _CONTROL)]
+    pieces += [(f"<0x{b:02X}>", 0.0, _BYTE) for b in range(256)]
+    for ch in ["▁", "h", "e", "l", "o", "w", "r", "d"]:
+        pieces.append((ch, -50.0, _NORMAL))  # base symbols, worst score
+    merged = [("he", -1.0), ("ll", -2.0), ("hell", -3.0), ("hello", -4.0),
+              ("▁hello", -5.0), ("wo", -6.0), ("wor", -7.0), ("worl", -8.0),
+              ("world", -9.0), ("▁world", -10.0)]
+    pieces += [(p, s, _NORMAL) for p, s in merged]
+    return pieces
+
+
+@pytest.fixture(scope="module")
+def sp_tok(tmp_path_factory):
+    blob = write_model_proto(_tiny_sp_pieces())
+    d = tmp_path_factory.mktemp("sp")
+    with open(d / "tokenizer.model", "wb") as f:
+        f.write(blob)
+    return SentencePieceTokenizer.load(str(d))
+
+
+def test_proto_roundtrip():
+    pieces = _tiny_sp_pieces()
+    proto = parse_model_proto(write_model_proto(
+        pieces, add_dummy_prefix=False, pad_id=-1, unk_id=0))
+    assert proto["pieces"] == [(p, pytest.approx(s), t) for p, s, t in pieces]
+    assert proto["model_type"] == 2
+    assert proto["add_dummy_prefix"] is False
+    assert proto["pad_id"] == -1  # negative int32 survives sign extension
+    assert (proto["unk_id"], proto["bos_id"], proto["eos_id"]) == (0, 1, 2)
+
+
+def test_sp_merge_order_and_dummy_prefix(sp_tok):
+    ids = sp_tok.encode("hello world")
+    pieces = [sp_tok.id_to_piece[i] for i in ids]
+    # dummy prefix + whitespace escape: "▁hello" and "▁world" both exist
+    assert pieces == ["▁hello", "▁world"]
+    assert sp_tok.decode(ids) == "hello world"
+
+
+def test_sp_bos_eos(sp_tok):
+    ids = sp_tok.encode("hello", add_bos=True, add_eos=True)
+    assert ids[0] == sp_tok.bos_id == 1
+    assert ids[-1] == sp_tok.eos_id == 2
+    # control pieces never decode into text
+    assert sp_tok.decode(ids) == "hello"
+
+
+def test_sp_byte_fallback(sp_tok):
+    # '☃' has no piece → its UTF-8 bytes (e2 98 83) fall back to <0xXX>
+    ids = sp_tok.encode("hello☃")
+    pieces = [sp_tok.id_to_piece[i] for i in ids]
+    assert pieces[:2] == ["▁hello"] or pieces[0] == "▁hello"
+    assert pieces[-3:] == ["<0xE2>", "<0x98>", "<0x83>"]
+    assert sp_tok.decode(ids) == "hello☃"
+
+
+def test_sp_partial_merges(sp_tok):
+    # "hold" shares letters but no full piece: h+o+l+d with no pair in vocab
+    ids = sp_tok.encode("hold")
+    pieces = [sp_tok.id_to_piece[i] for i in ids]
+    assert pieces == ["▁", "h", "o", "l", "d"]
+
+
+def test_sp_leftmost_tie_and_score_priority():
+    # two competing merges with distinct scores: higher score wins first,
+    # changing the result vs rank-order ("ab" then "bc" can't both fire)
+    base = [("<unk>", 0.0, _UNKNOWN), ("<s>", 0.0, _CONTROL),
+            ("</s>", 0.0, _CONTROL)]
+    syms = [(c, -50.0, _NORMAL) for c in ["a", "b", "c"]]
+    tok_hi_bc = SentencePieceTokenizer(parse_model_proto(write_model_proto(
+        base + syms + [("ab", -2.0, _NORMAL), ("bc", -1.0, _NORMAL)],
+        add_dummy_prefix=False)))
+    pieces = [tok_hi_bc.id_to_piece[i] for i in tok_hi_bc.encode("abc")]
+    assert pieces == ["a", "bc"]  # bc outranks ab
+    tok_hi_ab = SentencePieceTokenizer(parse_model_proto(write_model_proto(
+        base + syms + [("ab", -1.0, _NORMAL), ("bc", -2.0, _NORMAL)],
+        add_dummy_prefix=False)))
+    pieces = [tok_hi_ab.id_to_piece[i] for i in tok_hi_ab.encode("abc")]
+    assert pieces == ["ab", "c"]
+
+
+def test_sp_user_defined_matched_before_bpe():
+    base = [("<unk>", 0.0, _UNKNOWN), ("<s>", 0.0, _CONTROL),
+            ("</s>", 0.0, _CONTROL), ("<tool>", 0.0, _USER_DEFINED)]
+    syms = [(c, -50.0, _NORMAL) for c in
+            ["▁", "x", "y", "<", ">", "t", "o", "l"]]
+    tok = SentencePieceTokenizer(parse_model_proto(write_model_proto(
+        base + syms, add_dummy_prefix=False)))
+    pieces = [tok.id_to_piece[i] for i in tok.encode("x<tool>y")]
+    assert pieces == ["x", "<tool>", "y"]
+
+
+def test_sp_control_never_matched_from_text(sp_tok):
+    # literal "<s>" in raw text must NOT produce the control id
+    ids = sp_tok.encode("<s>")
+    assert sp_tok.bos_id not in ids
+
+
+def test_sp_unigram_rejected():
+    blob = write_model_proto(_tiny_sp_pieces(), model_type=1)
+    with pytest.raises(ValueError, match="BPE"):
+        SentencePieceTokenizer(parse_model_proto(blob))
+
+
+def test_sp_empty_and_space_only(sp_tok):
+    assert sp_tok.encode("") == []
+    ids = sp_tok.encode(" ")
+    assert sp_tok.decode(ids) in (" ", "")  # dummy-prefix strip
+
+
+# ---------------------------------------------------------- tokenizer.json
+
+SAMPLES = [
+    "hello world",
+    "Question: What's 2+2?\nAnswer: 4",
+    "  leading spaces and   runs",
+    "unicode: déjà vu ☃ 日本語",
+    "numbers 1234567 and punct!!! ...",
+    "tabs\tand\nnewlines\r\n",
+]
+
+
+@pytest.fixture(scope="module")
+def trained_json(tmp_path_factory):
+    """Train a small real byte-level BPE with the HF tokenizers library."""
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    corpus = [s * 3 for s in SAMPLES] + [
+        "the quick brown fox jumps over the lazy dog " * 5]
+    tok.train_from_iterator(corpus, trainer)
+    d = tmp_path_factory.mktemp("tj")
+    path = os.path.join(str(d), "tokenizer.json")
+    tok.save(path)
+    return path, tok
+
+
+def test_tokenizer_json_parity_bytelevel(trained_json):
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    path, hf = trained_json
+    ours = TokenizerJSON.load(path)
+    for s in SAMPLES:
+        assert ours.encode(s) == hf.encode(s).ids, s
+        assert ours.decode(ours.encode(s)) == s
+
+
+def test_tokenizer_json_llama3_style_split(trained_json, tmp_path):
+    """Llama-3's shape: Sequence[Split(tiktoken regex), ByteLevel(no regex)]."""
+    from tokenizers import Tokenizer, pre_tokenizers, Regex
+
+    path, _ = trained_json
+    with open(path, encoding="utf-8") as f:
+        spec = json.load(f)
+    llama3_pat = (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}|"
+        r" ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+")
+    hf = Tokenizer.from_str(json.dumps(spec))
+    hf.pre_tokenizer = pre_tokenizers.Sequence([
+        pre_tokenizers.Split(Regex(llama3_pat), behavior="isolated"),
+        pre_tokenizers.ByteLevel(add_prefix_space=False, use_regex=False),
+    ])
+    p2 = tmp_path / "tokenizer.json"
+    hf.save(str(p2))
+
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    ours = TokenizerJSON.load(str(p2))
+    for s in SAMPLES:
+        assert ours.encode(s) == hf.encode(s).ids, s
+
+
+def test_tokenizer_json_added_tokens(trained_json, tmp_path):
+    from tokenizers import Tokenizer
+    from tokenizers.processors import TemplateProcessing  # noqa: F401
+
+    path, hf = trained_json
+    hf.add_special_tokens(["<|special|>"])
+    p2 = tmp_path / "tokenizer.json"
+    hf.save(str(p2))
+
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    ours = TokenizerJSON.load(str(p2))
+    s = "hello <|special|> world"
+    assert ours.encode(s) == hf.encode(s).ids
+    # specials are dropped on decode
+    assert "<|special|>" not in ours.decode(ours.encode(s))
+
+
+def test_tokenizer_json_rejects_unknown_shapes(tmp_path):
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    with pytest.raises(ValueError, match="model type"):
+        TokenizerJSON({"model": {"type": "Unigram"}})
+    with pytest.raises(ValueError, match="normalizer"):
+        TokenizerJSON({"model": {"type": "BPE", "vocab": {}, "merges": []},
+                       "normalizer": {"type": "NFKC"}})
+
+
+# ------------------------------------------------------------- dispatching
+
+def test_load_tokenizer_dispatch(tmp_path, trained_json, capsys):
+    from distributed_lion_tpu.data.tokenizer import (
+        ByteTokenizer, load_tokenizer)
+
+    # directory with tokenizer.model → SP
+    blob = write_model_proto(_tiny_sp_pieces())
+    spdir = tmp_path / "llama2ckpt"
+    spdir.mkdir()
+    (spdir / "tokenizer.model").write_bytes(blob)
+    tok = load_tokenizer(str(spdir))
+    assert isinstance(tok, SentencePieceTokenizer)
+    assert tok.vocab_size == len(_tiny_sp_pieces())
+
+    # sp: prefix on a bare file
+    tok = load_tokenizer("sp:" + str(spdir / "tokenizer.model"))
+    assert isinstance(tok, SentencePieceTokenizer)
+
+    # directory with tokenizer.json → TokenizerJSON
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    path, _ = trained_json
+    tok = load_tokenizer(os.path.dirname(path))
+    assert isinstance(tok, TokenizerJSON)
+
+    # unresolvable spec → ByteTokenizer + loud warning on stderr
+    tok = load_tokenizer(str(tmp_path / "nonexistent-model"))
+    assert isinstance(tok, ByteTokenizer)
+    assert "WARNING" in capsys.readouterr().err
