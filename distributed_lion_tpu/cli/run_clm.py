@@ -374,6 +374,7 @@ def main(argv=None):
             import jax
 
             from distributed_lion_tpu.models.hf_export import (
+                copy_tokenizer_files,
                 gpt2_to_hf,
                 llama_to_hf,
                 write_model_card,
@@ -381,6 +382,7 @@ def main(argv=None):
 
             to_hf = llama_to_hf if family == "llama" else gpt2_to_hf
             to_hf(jax.device_get(export), model_cfg, model_args.hf_export)
+            copy_tokenizer_files(data_args.tokenizer_name, model_args.hf_export)
             write_model_card(
                 model_args.hf_export, model_type=family,
                 train_summary={

@@ -40,8 +40,11 @@ class DPOArguments:
     attn_impl: str = "auto"  # ops.attention: auto | xla | flash | splash
     seq_impl: str = "ring"   # under --seq_parallel: ring | ulysses
     quant_ref: str = "none"        # none | int8 | nf4 — frozen ref model
+    quant_block: Optional[int] = None  # quant block size override; shrink so
+    # a small model's projections shard under --tensor_parallel
     lora_r: int = 8
     lora_alpha: int = 16
+    lora_dropout: float = 0.05  # adapter-branch dropout (PEFT semantics)
     tokenizer_name: Optional[str] = None
     adapter_path: Optional[str] = None  # start the policy from a PEFT
     # adapter checkpoint (models/hf_import.peft_to_lora) instead of fresh init
@@ -71,12 +74,6 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import load_pytree, save_pytree
 
-    if train_cfg.tensor_parallel > 1 and script_args.quant_ref != "none":
-        raise NotImplementedError(
-            "--tensor_parallel with a quantized reference model is not "
-            "wired (QuantizedTensor leaves cannot shard along weight dims); "
-            "use a bf16/f32 ref with TP or quantize under data parallelism."
-        )
     sp = train_cfg.seq_parallel
     if sp > 1 and train_cfg.tensor_parallel > 1:
         raise NotImplementedError(
@@ -131,7 +128,8 @@ def main(argv=None):
 
     ref_params = base_params
     if script_args.quant_ref != "none":
-        ref_params = quantize_tree(base_params, script_args.quant_ref)
+        ref_params = quantize_tree(base_params, script_args.quant_ref,
+                                   block=script_args.quant_block)
 
     # LoRA on the policy, the reference's wider DPO target set (:192-207).
     if script_args.adapter_path:
@@ -141,9 +139,15 @@ def main(argv=None):
         print(f"[run_dpo] resumed PEFT adapter from {script_args.adapter_path} "
               f"(r={lora_cfg.r} alpha={lora_cfg.alpha})")
     else:
+        # the reference's full DPO target set (dpo_llama2.py:192-207):
+        # q/k/v/out projections + the MLP (fc_in/fc_out class) + the token
+        # embedding (wte — gather-side adapter, models/lora.lora_embed)
+        from distributed_lion_tpu.models.lora import DPO_TARGET_PATTERNS
+
         lora_cfg = LoraConfig(
             r=script_args.lora_r, alpha=script_args.lora_alpha,
-            target_patterns=("wq", "wk", "wv", "wo", "q_proj", "k_proj", "v_proj", "out_proj"),
+            dropout=script_args.lora_dropout,
+            target_patterns=DPO_TARGET_PATTERNS,
         )
         adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
 
@@ -160,12 +164,20 @@ def main(argv=None):
 
         validate_tp(model_cfg, tp, "llama")
         base_specs = llama_param_specs(model_cfg)
+        if script_args.quant_ref != "none":
+            # the shaped QuantizedTensor layout shards with the dense specs
+            # — multi-chip DPO holds TWO 7B models, exactly where sharding
+            # the NF4 ref matters
+            from distributed_lion_tpu.ops.quant import validate_quant_tp
+
+            validate_quant_tp(ref_params, base_specs, tp, TENSOR_AXIS)
         frozen_params = {"base": base_params, "ref": ref_params}
         frozen_specs = {"base": base_specs, "ref": base_specs}
 
-        def policy_apply(params, frozen, tokens):
+        def policy_apply(params, frozen, tokens, dropout_key=None):
             effective = apply_adapters(frozen["base"], params, lora_cfg,
-                                       tp_axis=TENSOR_AXIS, base_specs=base_specs)
+                                       tp_axis=TENSOR_AXIS, base_specs=base_specs,
+                                       dropout_key=dropout_key)
             return llama_apply(effective, tokens, model_cfg, tp_axis=TENSOR_AXIS)
 
         loss_fn = make_dpo_loss_fn_frozen(
@@ -258,10 +270,14 @@ def main(argv=None):
                 # HF save_pretrained layout, like run_sft's merge flow
                 import jax
 
-                from distributed_lion_tpu.models.hf_export import llama_to_hf
+                from distributed_lion_tpu.models.hf_export import (
+                    copy_tokenizer_files, llama_to_hf)
 
                 llama_to_hf(jax.device_get(merged), model_cfg,
                             script_args.merged_output)
+                copy_tokenizer_files(script_args.tokenizer_name
+                                     or script_args.model_path,
+                                     script_args.merged_output)
             print(f"[run_dpo] merged policy saved to {script_args.merged_output}")
     finally:
         trainer.close()

@@ -37,10 +37,15 @@ class GenerateArguments:
 def _is_hf_dir(path: Optional[str]) -> bool:
     import os
 
-    return bool(path) and os.path.isdir(path)
+    # A training --output_dir holds model.npz but no config.json; only route
+    # directories that look like save_pretrained output to the HF importer.
+    return bool(path) and os.path.isdir(path) and os.path.isfile(
+        os.path.join(path, "config.json"))
 
 
 def build(args: GenerateArguments):
+    import os
+
     import jax
 
     from distributed_lion_tpu.data.tokenizer import load_tokenizer
@@ -48,6 +53,18 @@ def build(args: GenerateArguments):
 
     tok = load_tokenizer(args.tokenizer_name)
     vocab = args.vocab_size or tok.vocab_size
+
+    if (args.model_path and os.path.isdir(args.model_path)
+            and not _is_hf_dir(args.model_path)):
+        # a training --output_dir: the weights live at <dir>/model.npz
+        npz = os.path.join(args.model_path, "model.npz")
+        if os.path.isfile(npz):
+            args.model_path = npz
+        else:
+            raise FileNotFoundError(
+                f"{args.model_path!r} is a directory with neither config.json "
+                "(HF checkpoint) nor model.npz (training output)"
+            )
 
     hf_params = hf_cfg = None
     if _is_hf_dir(args.model_path):

@@ -39,8 +39,12 @@ class SFTArguments:
     size_valid_set: int = 64
     num_train_samples: int = 512   # synthetic corpus size
     quant: str = "none"            # none | int8 | nf4  (reference: nf4)
+    quant_block: Optional[int] = None  # quant block size override (elements;
+    # defaults: nf4 64, int8 256). Shrink when a small model's projections
+    # must shard under --tensor_parallel (last dim / block % tp == 0).
     lora_r: int = 8
     lora_alpha: int = 16
+    lora_dropout: float = 0.05  # adapter-branch dropout (sft_llama2.py:48)
     packing: bool = True
     group_by_length: bool = False
     gradient_checkpointing: bool = False
@@ -97,13 +101,6 @@ def main(argv=None):
     from distributed_lion_tpu.train.loop import Trainer
     from distributed_lion_tpu.utils.serialization import save_pytree
 
-    if train_cfg.tensor_parallel > 1 and script_args.quant != "none":
-        raise NotImplementedError(
-            "--tensor_parallel with a quantized base is not wired: "
-            "QuantizedTensor packs codes flat, so its leaves cannot be "
-            "sharded along the original weight dims. Use a bf16/f32 frozen "
-            "base with TP, or quantize under pure data parallelism."
-        )
     sp = train_cfg.seq_parallel
     if sp > 1:
         # long-context SFT: packed rows sharded over tokens, ring attention
@@ -113,11 +110,6 @@ def main(argv=None):
             raise NotImplementedError(
                 "--seq_parallel needs --packing: padded/masked per-example "
                 "rows are not wired across sequence shards"
-            )
-        if train_cfg.tensor_parallel > 1:
-            raise NotImplementedError(
-                "--tensor_parallel x --seq_parallel on the SFT path is not "
-                "wired; pick one"
             )
         if train_cfg.vocab_chunks > 0:
             raise NotImplementedError(
@@ -172,7 +164,8 @@ def main(argv=None):
         base_params = llama_init(jax.random.key(train_cfg.seed), model_cfg)
     if script_args.quant != "none":
         print(f"[run_sft] quantizing frozen base to {script_args.quant}")
-        base_params = quantize_tree(base_params, script_args.quant)
+        base_params = quantize_tree(base_params, script_args.quant,
+                                    block=script_args.quant_block)
 
     if script_args.adapter_path:
         # continue training a PEFT checkpoint (ours via --adapter_output, or
@@ -184,7 +177,8 @@ def main(argv=None):
         print(f"[run_sft] resumed PEFT adapter from {script_args.adapter_path} "
               f"(r={lora_cfg.r} alpha={lora_cfg.alpha})")
     else:
-        lora_cfg = LoraConfig(r=script_args.lora_r, alpha=script_args.lora_alpha)
+        lora_cfg = LoraConfig(r=script_args.lora_r, alpha=script_args.lora_alpha,
+                              dropout=script_args.lora_dropout)
         adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
     print(f"[run_sft] LoRA adapters: {len(adapters)} sites, {n_adapter/1e3:.1f}k trainable params")
@@ -232,18 +226,52 @@ def main(argv=None):
 
         validate_tp(model_cfg, tp, "llama")
         base_specs = llama_param_specs(model_cfg)
+        if script_args.quant != "none":
+            # the shaped QuantizedTensor layout shards with the dense specs;
+            # fail fast with the leaf path if block alignment doesn't allow it
+            from distributed_lion_tpu.ops.quant import validate_quant_tp
+
+            validate_quant_tp(base_params, base_specs, tp, TENSOR_AXIS)
         adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
 
-        def loss_fn(params, frozen, batch, dropout_key):
-            tokens, mask = _split_batch(batch)
-            effective = apply_adapters(frozen, params, lora_cfg,
-                                       tp_axis=TENSOR_AXIS, base_specs=base_specs)
-            return _head_loss(effective, tokens, mask, tp_axis=TENSOR_AXIS)
+        if sp > 1:
+            # tp x sp: long-context QLoRA SFT — base weights sharded over
+            # 'tensor', packed rows' tokens sharded over 'seq' (ring
+            # attention), one vote world over 'data'. Gradients: the f/g
+            # custom-vjp pair keeps per-tensor-rank adapter grads exact,
+            # and the train loop psums grads over the seq axis.
+            from jax.sharding import PartitionSpec as P
 
-        loss_fn._vocab_chunked = True
-        trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
-                          param_specs=adapter_specs, loss_fn=loss_fn,
-                          frozen_params=base_params, frozen_specs=base_specs)
+            from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
+            from distributed_lion_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+            def loss_fn(params, frozen, batch, dropout_key):
+                effective = apply_adapters(frozen, params, lora_cfg,
+                                           tp_axis=TENSOR_AXIS,
+                                           base_specs=base_specs,
+                                           dropout_key=dropout_key)
+                logits = llama_apply(effective, batch, model_cfg,
+                                     tp_axis=TENSOR_AXIS, seq_axis=SEQ_AXIS)
+                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
+            trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                              param_specs=adapter_specs, loss_fn=loss_fn,
+                              frozen_params=base_params,
+                              frozen_specs=base_specs,
+                              batch_spec=P(DATA_AXIS, SEQ_AXIS))
+        else:
+            def loss_fn(params, frozen, batch, dropout_key):
+                tokens, mask = _split_batch(batch)
+                effective = apply_adapters(frozen, params, lora_cfg,
+                                           tp_axis=TENSOR_AXIS,
+                                           base_specs=base_specs,
+                                           dropout_key=dropout_key)
+                return _head_loss(effective, tokens, mask, tp_axis=TENSOR_AXIS)
+
+            loss_fn._vocab_chunked = True
+            trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
+                              param_specs=adapter_specs, loss_fn=loss_fn,
+                              frozen_params=base_params, frozen_specs=base_specs)
     elif sp > 1:
         from jax.sharding import PartitionSpec as P
 
@@ -252,7 +280,8 @@ def main(argv=None):
 
         def loss_fn(params, batch, dropout_key):
             # batch is this shard's contiguous token chunk [B, T/sp]
-            effective = apply_adapters(base_params, params, lora_cfg)
+            effective = apply_adapters(base_params, params, lora_cfg,
+                                       dropout_key=dropout_key)
             logits = llama_apply(effective, batch, model_cfg, seq_axis=SEQ_AXIS)
             return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
 
@@ -262,7 +291,8 @@ def main(argv=None):
     else:
         def loss_fn(params, batch, dropout_key):
             tokens, mask = _split_batch(batch)
-            effective = apply_adapters(base_params, params, lora_cfg)
+            effective = apply_adapters(base_params, params, lora_cfg,
+                                       dropout_key=dropout_key)
             return _head_loss(effective, tokens, mask)
 
         loss_fn._vocab_chunked = True
@@ -332,10 +362,14 @@ def main(argv=None):
                 # HF save_pretrained layout — loadable by
                 # LlamaForCausalLM.from_pretrained, the format the
                 # reference's merge flow emits (sft_llama2.py:196-199)
-                from distributed_lion_tpu.models.hf_export import llama_to_hf
+                from distributed_lion_tpu.models.hf_export import (
+                    copy_tokenizer_files, llama_to_hf)
 
                 llama_to_hf(jax.device_get(merged), model_cfg,
                             script_args.merged_output)
+                copy_tokenizer_files(script_args.tokenizer_name
+                                     or script_args.model_path,
+                                     script_args.merged_output)
             print(f"[run_sft] merged model saved to {script_args.merged_output}")
     finally:
         trainer.close()

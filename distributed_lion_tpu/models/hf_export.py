@@ -137,6 +137,54 @@ def write_model_card(path: str, *, model_type: str, train_summary: dict) -> None
         f.write("\n".join(lines))
 
 
+_TOKENIZER_FILES = (
+    "vocab.json", "merges.txt", "tokenizer.json", "tokenizer.model",
+    "tokenizer_config.json", "special_tokens_map.json",
+)
+
+
+def copy_tokenizer_files(tokenizer_name: str | None, path: str) -> list:
+    """Copy tokenizer files next to the exported weights, if resolvable.
+
+    The reference's save flow persists the tokenizer alongside the model
+    (HF ``save_pretrained`` writes both), so ``AutoTokenizer.from_pretrained``
+    works on the export directory. ``tokenizer_name`` is the same spec
+    data.tokenizer.load_tokenizer takes: ``bpe:<dir>`` or a directory with
+    tokenizer files. HF-cache names and the ByteTokenizer have no local
+    files to copy — the gap is recorded in the model card instead (the
+    caller includes the tokenizer spec in ``train_summary``). Returns the
+    list of files copied.
+    """
+    import shutil
+
+    if not tokenizer_name:
+        return []
+    src = tokenizer_name
+    for prefix in ("bpe:", "sp:"):
+        if src.startswith(prefix):
+            src = src[len(prefix):]
+            break
+    copied = []
+    if os.path.isfile(src):
+        # a bare tokenizer.model / tokenizer.json / vocab file path
+        name = os.path.basename(src)
+        if name in _TOKENIZER_FILES or src.endswith(".model"):
+            os.makedirs(path, exist_ok=True)
+            dst = "tokenizer.model" if src.endswith(".model") else name
+            shutil.copy2(src, os.path.join(path, dst))
+            copied.append(dst)
+        return copied
+    if not os.path.isdir(src):
+        return []
+    os.makedirs(path, exist_ok=True)
+    for name in _TOKENIZER_FILES:
+        fp = os.path.join(src, name)
+        if os.path.isfile(fp):
+            shutil.copy2(fp, os.path.join(path, name))
+            copied.append(name)
+    return copied
+
+
 def _rope_from_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
     """Inverse of hf_import._rope_to_interleaved: per head, channel 2i goes
     back to slot i and channel 2i+1 to slot i + hd/2 (HF's half-rotation
@@ -183,10 +231,23 @@ def lora_to_peft(adapters: dict, model_cfg: Any, lora_cfg: Any,
     modules = set()
     for apath, ab in adapters.items():
         parts = apath.split("/")  # e.g. blocks/3/attn/wq
+        if apath == "wte":
+            # PEFT Embedding adapter layout: lora_embedding_A is
+            # [r, num_embeddings], lora_embedding_B is [embedding_dim, r]
+            # (transposed relative to the Linear lora_A/lora_B convention).
+            prefix = "base_model.model.model.embed_tokens"
+            A = np.ascontiguousarray(np.asarray(ab["A"]).T)  # [r, V]
+            B = np.ascontiguousarray(np.asarray(ab["B"]).T)  # [d, r]
+            sd[f"{prefix}.lora_embedding_A"] = torch.from_numpy(
+                A.astype(np.float32))
+            sd[f"{prefix}.lora_embedding_B"] = torch.from_numpy(
+                B.astype(np.float32))
+            modules.add("embed_tokens")
+            continue
         if parts[0] != "blocks" or parts[-1] not in _PEFT_MODULES:
             raise ValueError(
                 f"adapter on {apath!r} has no PEFT-Llama equivalent "
-                f"(exportable targets: {sorted(_PEFT_MODULES)})"
+                f"(exportable targets: {sorted(_PEFT_MODULES)} + wte)"
             )
         layer = parts[1]
         module, heads_attr = _PEFT_MODULES[parts[-1]]

@@ -305,6 +305,24 @@ def peft_to_lora(path: str, model_cfg: Any, dtype: Any = None) -> tuple:
         pc = _json.load(f)
     if pc.get("peft_type") != "LORA":
         raise ValueError(f"not a LoRA adapter: peft_type={pc.get('peft_type')!r}")
+    # Scaling variants this importer does not model: rsLoRA rescales
+    # alpha/sqrt(r), and rank/alpha_pattern give per-module overrides.
+    # Importing one with the plain alpha/r scaling would silently train the
+    # adapter at the wrong effective magnitude — refuse instead.
+    if pc.get("use_rslora"):
+        raise ValueError(
+            "PEFT adapter was trained with use_rslora=True (scaling "
+            "alpha/sqrt(r)); this importer applies plain alpha/r scaling and "
+            "would be silently wrong. Merge the adapter with PEFT first, or "
+            "retrain without rslora."
+        )
+    for pat in ("rank_pattern", "alpha_pattern"):
+        if pc.get(pat):
+            raise ValueError(
+                f"PEFT adapter sets {pat}={pc[pat]!r} (per-module rank/alpha "
+                "overrides); this importer supports a single global r/alpha "
+                "only and would import with wrong effective scaling."
+            )
     # PEFT names its weight file adapter_model.*, not model.* — load directly
     st_path = os.path.join(path, "adapter_model.safetensors")
     if os.path.exists(st_path):
@@ -316,10 +334,28 @@ def peft_to_lora(path: str, model_cfg: Any, dtype: Any = None) -> tuple:
     dt = dtype or jnp.float32
     adapters: dict = {}
     for key, val in sd.items():
+        if key.endswith(".lora_embedding_A"):
+            # PEFT Embedding adapter: A [r, V], B [d, r] (transposed vs the
+            # Linear convention) on embed_tokens → our gather-side "wte"
+            # adapter {A: [V, r], B: [r, d]} (models/lora.lora_embed)
+            b_key = key[: -len("lora_embedding_A")] + "lora_embedding_B"
+            if b_key not in sd:
+                raise ValueError(
+                    f"malformed PEFT checkpoint: {key!r} has no paired "
+                    f"{b_key!r}")
+            adapters["wte"] = {
+                "A": jnp.asarray(np.asarray(val).T, dt),
+                "B": jnp.asarray(np.asarray(sd[b_key]).T, dt),
+            }
+            continue
         if not key.endswith(".lora_A.weight"):
             continue
         stem = key[: -len(".lora_A.weight")]
         b_key = stem + ".lora_B.weight"
+        if b_key not in sd:
+            raise ValueError(
+                f"malformed PEFT checkpoint: {key!r} has no paired {b_key!r}"
+            )
         # stem like base_model.model.model.layers.3.self_attn.q_proj
         parts = stem.split(".")
         layer = parts[parts.index("layers") + 1]

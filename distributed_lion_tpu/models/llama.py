@@ -258,7 +258,9 @@ def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: lis
     pos=0, then one token at a time. Matches ``llama_apply`` logits
     position-for-position (tests/test_generate.py)."""
     B, S = tokens.shape
-    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
+    from distributed_lion_tpu.models.lora import lora_embed
+
+    x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
     # rope tables at the absolute positions of these S tokens: build a
     # max-length table once and slice at pos (pos is traced under jit)
     cos_all, sin_all = rope_angles(cache[0]["k"].shape[2], cfg.head_dim, cfg.rope_theta)
@@ -295,7 +297,9 @@ def llama_hidden(
         offset = 0
     else:
         offset = jax.lax.axis_index(seq_axis) * T
-    x = maybe_dequant(params["wte"], cfg.compute_dtype)[tokens].astype(cfg.compute_dtype)
+    from distributed_lion_tpu.models.lora import lora_embed
+
+    x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
     cos, sin = rope_angles(T, cfg.head_dim, cfg.rope_theta, offset=offset)
     block = _block_remat_for(cfg) if cfg.remat else _block
     for p in params["blocks"]:

@@ -40,20 +40,26 @@ from distributed_lion_tpu.ops.quant import QuantizedTensor, maybe_dequant
 class LoraTensor:
     """A frozen base weight + its low-rank adapter, consumed by the models'
     ``_matmul``/einsum sites in factored form. ``base`` may be a dense array
-    or a QuantizedTensor."""
+    or a QuantizedTensor. ``dropout_key`` (set by apply_adapters during
+    training) enables the reference's ``lora_dropout`` on the adapter
+    branch — PEFT semantics: dropout on the INPUT of the A projection only,
+    the frozen-base path never dropped (sft_llama2.py:48)."""
 
     base: Any               # [d_in, *out_dims] dense or QuantizedTensor
     A: jnp.ndarray          # [d_in, r]
     B: jnp.ndarray          # [r, *out_dims]
     scaling: float          # α/r (static)
+    dropout_rate: float = 0.0          # static
+    dropout_key: Any = None            # child; None ⇒ eval mode
 
     def tree_flatten(self):
-        return (self.base, self.A, self.B), (self.scaling,)
+        return (self.base, self.A, self.B, self.dropout_key), (
+            self.scaling, self.dropout_rate)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        base, A, B = children
-        return cls(base, A, B, aux[0])
+        base, A, B, key = children
+        return cls(base, A, B, aux[0], aux[1], key)
 
     @property
     def shape(self):
@@ -64,29 +70,63 @@ class LoraTensor:
         return len(self.base.shape)
 
 
+def _branch_dropout(x: jnp.ndarray, w: "LoraTensor") -> jnp.ndarray:
+    """Inverted dropout on the adapter-branch input (torch nn.Dropout
+    semantics: scale kept units by 1/(1-p)); identity when no key."""
+    if w.dropout_key is None or w.dropout_rate <= 0.0:
+        return x
+    keep = 1.0 - w.dropout_rate
+    mask = jax.random.bernoulli(w.dropout_key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
 def lora_matmul(x: jnp.ndarray, w) -> jnp.ndarray:
     """``x @ w`` for dense / quantized / LoRA-adapted 2-D weights — the
     single hook the models route every projection through."""
     if isinstance(w, LoraTensor):
         base = maybe_dequant(w.base, x.dtype)
-        delta = (x @ w.A.astype(x.dtype)) @ w.B.astype(x.dtype)
+        xd = _branch_dropout(x, w)
+        delta = (xd @ w.A.astype(x.dtype)) @ w.B.astype(x.dtype)
         return x @ base.astype(x.dtype) + w.scaling * delta
     return x @ maybe_dequant(w, x.dtype).astype(x.dtype)
 
 
+def lora_embed(w, tokens: jnp.ndarray, dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Embedding lookup for dense / quantized / LoRA-adapted tables — the
+    gather-side counterpart of :func:`lora_matmul` (the reference's DPO
+    adapts ``wte`` too, dpo_llama2.py:192-207). For a LoraTensor:
+    ``base[tokens] + (α/r)·(A[tokens] @ B)`` — the one-hot-gather factored
+    form. No adapter dropout here: PEFT's lora_dropout lives on Linear
+    layers only (dropout over integer indices is meaningless)."""
+    if isinstance(w, LoraTensor):
+        base = maybe_dequant(w.base, dtype)[tokens].astype(dtype)
+        a_rows = w.A[tokens].astype(dtype)          # [B, T, r]
+        return base + (w.scaling * (a_rows @ w.B.astype(dtype))).astype(dtype)
+    return maybe_dequant(w, dtype)[tokens].astype(dtype)
+
+
 @dataclasses.dataclass(frozen=True)
 class LoraConfig:
-    """sft_llama2.py:44-51 defaults: r=8, alpha=16, dropout 0.05 (dropout is
-    applied at the data level here; adapter dropout is rarely load-bearing),
-    targets q/v projections."""
+    """sft_llama2.py:44-51 defaults: r=8, alpha=16, lora_dropout=0.05 on the
+    adapter branch (PEFT semantics — applied when apply_adapters gets a
+    dropout key, i.e. during training only), targets q/v projections."""
 
     r: int = 8
     alpha: int = 16
+    dropout: float = 0.0
     target_patterns: Sequence[str] = ("wq", "wv", "q_proj", "v_proj", "qkv")
 
     @property
     def scaling(self) -> float:
         return self.alpha / self.r
+
+
+# the reference's DPO target set (dpo_llama2.py:192-207: q/v/k/out_proj +
+# fc_in/fc_out/wte) translated to this repo's Llama leaf names: all four
+# attention projections, the full SwiGLU MLP, and the token embedding
+# (gather-side adapter, :func:`lora_embed`).
+DPO_TARGET_PATTERNS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                       "wte", "q_proj", "k_proj", "v_proj", "out_proj")
 
 
 def _is_weight_leaf(x) -> bool:
@@ -172,9 +212,14 @@ def merge_lora(base_params: Any, adapters: dict, cfg: LoraConfig,
 
 def apply_adapters(base_params: Any, adapters: dict, cfg: LoraConfig,
                    tp_axis: Optional[str] = None,
-                   base_specs: Any = None) -> Any:
+                   base_specs: Any = None,
+                   dropout_key: Optional[jax.Array] = None) -> Any:
     """Swap each adapted leaf for a :class:`LoraTensor` (factored form — no
     ``W + ΔW`` materialization; the models' matmul sites consume it).
+
+    ``dropout_key`` (training only) arms ``cfg.dropout`` on every adapter
+    branch, one derived key per adapted leaf (deterministic in the leaf's
+    sorted position, so replicas agree bit-for-bit).
 
     Under tensor parallelism (``tp_axis`` + ``base_specs``), the adapter
     factor that is REPLICATED across the tensor axis (A for column-parallel
@@ -183,6 +228,12 @@ def apply_adapters(base_params: Any, adapters: dict, cfg: LoraConfig,
     adapter momenta/votes would silently diverge.
     """
     effective = _copy_tree(base_params)
+    rate = cfg.dropout if dropout_key is not None else 0.0
+    site_keys = {}
+    if rate > 0.0:
+        ordered = sorted(adapters)
+        for k, p in zip(jax.random.split(dropout_key, len(ordered)), ordered):
+            site_keys[p] = k
     for path_str, ab in adapters.items():
         path = tuple(path_str.split("/"))
         A, B = ab["A"], ab["B"]
@@ -205,7 +256,9 @@ def apply_adapters(base_params: Any, adapters: dict, cfg: LoraConfig,
             if a_sharded and not b_sharded:
                 B = copy_to_tp_region(B, tp_axis)
         base_leaf = _tree_get(base_params, path)
-        _tree_set(effective, path, LoraTensor(base_leaf, A, B, cfg.scaling))
+        _tree_set(effective, path, LoraTensor(
+            base_leaf, A, B, cfg.scaling,
+            rate, site_keys.get(path_str)))
     return effective
 
 
@@ -244,8 +297,9 @@ def lora_apply_fn(base_apply: Callable, base_params: Any, cfg: LoraConfig) -> Ca
     constant, possibly quantized) gets no gradient.
     """
 
-    def apply(adapters, tokens, *args, **kwargs):
-        effective = apply_adapters(base_params, adapters, cfg)
+    def apply(adapters, tokens, *args, dropout_key=None, **kwargs):
+        effective = apply_adapters(base_params, adapters, cfg,
+                                   dropout_key=dropout_key)
         return base_apply(effective, tokens, *args, **kwargs)
 
     return apply

@@ -44,20 +44,37 @@ NF4_LEVELS = np.asarray(
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class QuantizedTensor:
+    """Packed codes + per-block absmax scales.
+
+    Two storage layouts:
+
+    - ``shaped`` (default when the last dim divides the block size): blocks
+      run along the LAST dim only, and codes/absmax keep the dense weight's
+      rank — codes is ``[..., last/2]`` (nf4) or ``[..., last]`` (int8),
+      absmax is ``[..., last/block]``. Because every leading dim is 1:1 with
+      the dense weight and last-dim blocks never straddle a slice boundary,
+      the SAME PartitionSpec that shards the dense weight shards the
+      quantized leaf — this is what makes ``--quant nf4`` compose with
+      tensor parallelism (each rank dequantizes only its shard).
+    - ``flat``: the fallback for odd shapes — codes is 1-D over the
+      row-major flattened (padded) weight. Not shardable along weight dims.
+    """
+
     codes: jnp.ndarray      # packed uint8 (nf4: 2 codes/byte; int8: 1 code/byte)
-    absmax: jnp.ndarray     # f32 [n_blocks] per-block scale
-    shape: tuple            # original dense shape (static)
+    absmax: jnp.ndarray     # f32 per-block scale
+    shape: tuple            # original dense GLOBAL shape (static)
     fmt: str                # 'nf4' | 'int8' (static)
     block: int              # block size in elements (static)
+    layout: str = "shaped"  # 'shaped' | 'flat' (static)
 
     def tree_flatten(self):
-        return (self.codes, self.absmax), (self.shape, self.fmt, self.block)
+        return (self.codes, self.absmax), (self.shape, self.fmt, self.block,
+                                           self.layout)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         codes, absmax = children
-        shape, fmt, block = aux
-        return cls(codes, absmax, shape, fmt, block)
+        return cls(codes, absmax, *aux)
 
     @property
     def size(self) -> int:
@@ -68,40 +85,88 @@ class QuantizedTensor:
         return len(self.shape)
 
 
+def _use_shaped(shape: tuple, block: int, fmt: str) -> bool:
+    # nf4 packs 2 codes/byte along the last dim, so it additionally needs an
+    # even block; int8 has no packing constraint.
+    return (len(shape) >= 2 and shape[-1] % block == 0
+            and (fmt != "nf4" or block % 2 == 0))
+
+
+def _nf4_codes(blocks: jnp.ndarray, absmax: jnp.ndarray) -> jnp.ndarray:
+    """[..., block] f32 + [...] absmax → [..., block] uint8 4-bit codes,
+    nearest level via midpoint bisection — O(n log 16) and no [n, 16]
+    distance tensor (which would be 64 transient bytes/param at 7B scale)."""
+    scaled = blocks / jnp.maximum(absmax, 1e-12)[..., None]
+    mids = jnp.asarray((NF4_LEVELS[1:] + NF4_LEVELS[:-1]) / 2.0)
+    return jnp.searchsorted(mids, scaled).astype(jnp.uint8)
+
+
 def quantize_nf4(w: jnp.ndarray, block: int = 64) -> QuantizedTensor:
-    """Blockwise absmax NF4 quantization (nearest codebook level)."""
+    """Blockwise absmax NF4 quantization (nearest codebook level).
+
+    Blocks run along the last dim when it divides ``block`` (the shaped,
+    TP-shardable layout — identical numerics to the flat layout for such
+    shapes, since row-major flat blocks never straddled rows anyway)."""
     shape = tuple(w.shape)
+    if _use_shaped(shape, block, "nf4"):
+        blocks = w.astype(jnp.float32).reshape(
+            shape[:-1] + (shape[-1] // block, block))
+        absmax = jnp.abs(blocks).max(axis=-1)
+        codes4 = _nf4_codes(blocks, absmax).reshape(shape)
+        packed = (codes4[..., 0::2] | (codes4[..., 1::2] << 4)).astype(jnp.uint8)
+        return QuantizedTensor(packed, absmax, shape, "nf4", block, "shaped")
     flat = jnp.ravel(w).astype(jnp.float32)
-    n = flat.shape[0]
-    pad = (-n) % block
+    pad = (-flat.shape[0]) % block
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     blocks = flat.reshape(-1, block)
     absmax = jnp.abs(blocks).max(axis=1)
-    scaled = blocks / jnp.maximum(absmax, 1e-12)[:, None]
-    # nearest level via midpoint bisection — O(n log 16) and no [n, 16]
-    # distance tensor (which would be 64 transient bytes/param at 7B scale)
-    mids = jnp.asarray((NF4_LEVELS[1:] + NF4_LEVELS[:-1]) / 2.0)
-    codes4 = jnp.searchsorted(mids, scaled).astype(jnp.uint8).reshape(-1)
+    codes4 = _nf4_codes(blocks, absmax).reshape(-1)
     packed = (codes4[0::2] | (codes4[1::2] << 4)).astype(jnp.uint8)
-    return QuantizedTensor(packed, absmax, shape, "nf4", block)
+    return QuantizedTensor(packed, absmax, shape, "nf4", block, "flat")
 
 
 def quantize_int8(w: jnp.ndarray, block: int = 256) -> QuantizedTensor:
     shape = tuple(w.shape)
+    if _use_shaped(shape, block, "int8"):
+        blocks = w.astype(jnp.float32).reshape(
+            shape[:-1] + (shape[-1] // block, block))
+        absmax = jnp.abs(blocks).max(axis=-1)
+        q = jnp.round(blocks / jnp.maximum(absmax, 1e-12)[..., None] * 127.0)
+        codes = q.astype(jnp.int8).view(jnp.uint8).reshape(shape)
+        return QuantizedTensor(codes, absmax, shape, "int8", block, "shaped")
     flat = jnp.ravel(w).astype(jnp.float32)
-    n = flat.shape[0]
-    pad = (-n) % block
+    pad = (-flat.shape[0]) % block
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
     blocks = flat.reshape(-1, block)
     absmax = jnp.abs(blocks).max(axis=1)
     q = jnp.round(blocks / jnp.maximum(absmax, 1e-12)[:, None] * 127.0)
     codes = (q.astype(jnp.int8).view(jnp.uint8)).reshape(-1)
-    return QuantizedTensor(codes, absmax, shape, "int8", block)
+    return QuantizedTensor(codes, absmax, shape, "int8", block, "flat")
 
 
 def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16) -> jnp.ndarray:
+    if qt.layout == "shaped":
+        # LOCAL dense shape derives from the codes actually present — under
+        # shard_map each rank holds a slice and dequantizes just that slice.
+        lead = tuple(qt.codes.shape[:-1])
+        if qt.fmt == "nf4":
+            lo = qt.codes & 0x0F
+            hi = qt.codes >> 4
+            last = qt.codes.shape[-1] * 2
+            codes4 = jnp.stack([lo, hi], axis=-1).reshape(lead + (last,))
+            levels = jnp.asarray(NF4_LEVELS)[codes4]
+            vals = (levels.reshape(lead + (last // qt.block, qt.block))
+                    * qt.absmax[..., None])
+        elif qt.fmt == "int8":
+            last = qt.codes.shape[-1]
+            q = qt.codes.view(jnp.int8).astype(jnp.float32)
+            vals = (q.reshape(lead + (last // qt.block, qt.block))
+                    * (qt.absmax[..., None] / 127.0))
+        else:
+            raise ValueError(f"unknown quant format {qt.fmt!r}")
+        return vals.reshape(lead + (last,)).astype(dtype)
     if qt.fmt == "nf4":
         lo = qt.codes & 0x0F
         hi = qt.codes >> 4
@@ -138,6 +203,54 @@ def quantize_tree(params: Any, fmt: str = "nf4", min_size: int = 4096,
         return w
 
     return jax.tree.map(leaf, params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+
+
+def validate_quant_tp(params: Any, specs: Any, tp: int, tp_axis: str) -> None:
+    """Fail fast (with the leaf path) when a quantized leaf cannot shard
+    under the given PartitionSpec tree: flat-layout leaves cannot shard at
+    all; shaped leaves need every tp-sharded dim divisible on codes AND
+    absmax (last dim: ``last/2 % tp == 0`` and ``last/block % tp == 0``)."""
+    def _uses(p, axis):
+        return p == axis or (isinstance(p, (tuple, list)) and axis in p)
+
+    def check(path, leaf, spec):
+        if not isinstance(leaf, QuantizedTensor):
+            return
+        sharded_dims = [i for i in range(len(spec)) if _uses(spec[i], tp_axis)]
+        if not sharded_dims:
+            return
+        if leaf.layout != "shaped":
+            raise ValueError(
+                f"quantized leaf {path!r} has the flat layout (block "
+                f"{leaf.block} does not divide last dim {leaf.shape[-1]}"
+                + (", or is odd for nf4's 2-codes/byte packing"
+                   if leaf.fmt == "nf4" and leaf.block % 2 else "")
+                + f") and cannot shard over {tp_axis!r}; pick a block size "
+                "that divides the last dim (--quant_block)"
+            )
+        for i in sharded_dims:
+            if i < leaf.ndim - 1:
+                if leaf.shape[i] % tp:
+                    raise ValueError(
+                        f"quantized leaf {path!r} dim {i} ({leaf.shape[i]}) "
+                        f"not divisible by tensor axis {tp}")
+            else:
+                last = leaf.shape[-1]
+                pack = 2 if leaf.fmt == "nf4" else 1
+                if (last // pack) % tp or (last // leaf.block) % tp:
+                    raise ValueError(
+                        f"quantized leaf {path!r} last dim {last} cannot "
+                        f"shard {tp}-way: needs last/{pack} and last/block "
+                        f"({last}/{leaf.block}={last // leaf.block}) both "
+                        f"divisible by {tp}; shrink --quant_block"
+                    )
+
+    from distributed_lion_tpu.models.lora import _iter_paths, _tree_get
+
+    for path, leaf in _iter_paths(
+            params, ()):
+        if isinstance(leaf, QuantizedTensor):
+            check("/".join(path), leaf, _tree_get(specs, path))
 
 
 def dequantize_tree(params: Any, dtype=jnp.float32) -> Any:

@@ -63,6 +63,19 @@ def sequence_logprob_seq_parallel(
     return reduce_from_tp_region((ll * lmask).sum(-1), axis_name)
 
 
+def _accepts_dropout_key(fn: Callable) -> bool:
+    """True when ``fn`` can take a ``dropout_key`` keyword (LoRA adapter
+    dropout); plain ``(params, tokens)`` callables keep their signature."""
+    import inspect
+
+    try:
+        return any(
+            p.name == "dropout_key" or p.kind is inspect.Parameter.VAR_KEYWORD
+            for p in inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):  # builtins/partials without signatures
+        return False
+
+
 def make_dpo_loss_fn(
     policy_apply: Callable,
     ref_apply: Callable,
@@ -81,11 +94,22 @@ def make_dpo_loss_fn(
             return sequence_logprob(logits, tokens, mask)
         return sequence_logprob_seq_parallel(logits, tokens, mask, seq_axis)
 
+    _accepts_key = _accepts_dropout_key(policy_apply)
+
+    def _policy(params, tokens, key):
+        if _accepts_key:
+            return policy_apply(params, tokens, dropout_key=key)
+        return policy_apply(params, tokens)
+
     def loss_fn(params, batch, dropout_key):
-        del dropout_key
-        pol_c = seqlp(policy_apply(params, batch["chosen"]),
+        # adapter (lora_dropout) keys: one per policy pass, None in eval —
+        # the reference's PEFT dropout is train-time only (sft_llama2.py:48)
+        kc = kr = None
+        if dropout_key is not None:
+            kc, kr = jax.random.split(dropout_key)
+        pol_c = seqlp(_policy(params, batch["chosen"], kc),
                       batch["chosen"], batch["chosen_mask"])
-        pol_r = seqlp(policy_apply(params, batch["rejected"]),
+        pol_r = seqlp(_policy(params, batch["rejected"], kr),
                       batch["rejected"], batch["rejected_mask"])
         ref_c = seqlp(ref_apply(batch["chosen"]),
                       batch["chosen"], batch["chosen_mask"])
@@ -120,12 +144,15 @@ def make_dpo_loss_fn_frozen(
     ``ref_apply(frozen, tokens)``; returns
     ``loss_fn(params, frozen, batch, dropout_key)``."""
 
+    _accepts_key = _accepts_dropout_key(policy_apply)
+
     def loss_fn(params, frozen, batch, dropout_key):
-        inner = make_dpo_loss_fn(
-            lambda p, t: policy_apply(p, frozen, t),
-            lambda t: ref_apply(frozen, t),
-            beta,
-        )
+        if _accepts_key:
+            pol = (lambda p, t, dropout_key=None:
+                   policy_apply(p, frozen, t, dropout_key=dropout_key))
+        else:
+            pol = lambda p, t: policy_apply(p, frozen, t)  # noqa: E731
+        inner = make_dpo_loss_fn(pol, lambda t: ref_apply(frozen, t), beta)
         return inner(params, batch, dropout_key)
 
     return loss_fn

@@ -324,13 +324,23 @@ class Trainer:
         self.frozen = None
         self.frozen_specs = None
         if frozen_params is not None:
+            from distributed_lion_tpu.ops.quant import QuantizedTensor
+
+            _is_qt = lambda x: isinstance(x, QuantizedTensor)  # noqa: E731
             if frozen_specs is None:
-                frozen_specs = jax.tree.map(lambda _: P(), frozen_params)
+                frozen_specs = jax.tree.map(lambda _: P(), frozen_params,
+                                            is_leaf=_is_qt)
             self.frozen_specs = frozen_specs
-            self.frozen = jax.tree.map(
-                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
-                frozen_params, frozen_specs,
-            )
+
+            def _put(p, s):
+                # a QuantizedTensor node takes its dense leaf's spec: the
+                # shaped layout keeps codes/absmax rank-aligned with the
+                # dense weight, so the same P shards both children
+                return jax.tree.map(
+                    lambda c: jax.device_put(c, NamedSharding(mesh, s)), p)
+
+            self.frozen = jax.tree.map(_put, frozen_params, frozen_specs,
+                                       is_leaf=_is_qt)
         rng = jax.random.key(cfg.seed)
         self._exp_avg_specs = jax.tree.map(
             lambda s: P(*((DATA_AXIS,) + tuple(s))), param_specs

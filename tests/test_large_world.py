@@ -7,6 +7,7 @@ run in a subprocess because conftest pins this process to 8 devices.
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 import textwrap
@@ -43,12 +44,13 @@ def test_world_130_int32_promotion():
             np.testing.assert_array_equal(h[0], h[w])
         print("OK")
     """)
+    env = dict(os.environ)
+    env.update({"XLA_FLAGS": "--xla_force_host_platform_device_count=130",
+                "PYTHONPATH": "."})
     proc = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True, text=True, timeout=300,
-        env={"XLA_FLAGS": "--xla_force_host_platform_device_count=130",
-             "PYTHONPATH": ".", "PATH": "/usr/bin:/bin:/opt/venv/bin",
-             "HOME": "/root"},
+        env=env,
         cwd=".",
     )
     assert proc.returncode == 0, proc.stderr[-2000:]

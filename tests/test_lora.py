@@ -59,6 +59,104 @@ def test_merge_matches_wrapped_apply():
     )
 
 
+def test_dpo_target_set_covers_mlp_and_embedding():
+    """The reference's DPO adapts q/v/k/out + fc_in/fc_out + wte
+    (dpo_llama2.py:192-207); our DPO_TARGET_PATTERNS must land on all four
+    attention projections, the full SwiGLU MLP, and the token embedding."""
+    from distributed_lion_tpu.models.lora import DPO_TARGET_PATTERNS
+
+    cfg = LlamaConfig.tiny()
+    base = llama_init(jax.random.key(0), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=DPO_TARGET_PATTERNS)
+    adapters = lora_init(jax.random.key(1), base, lcfg)
+    assert "wte" in adapters
+    assert adapters["wte"]["A"].shape == (cfg.vocab_size, 4)
+    assert adapters["wte"]["B"].shape == (4, cfg.d_model)
+    per_block = {k.split("/")[-1] for k in adapters if k.startswith("blocks/0/")}
+    assert per_block == {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def test_embedding_adapter_factored_matches_merged():
+    """Gather-side LoRA (lora_embed): the factored wte adapter equals
+    merging A@B into the embedding table."""
+    from distributed_lion_tpu.models.lora import DPO_TARGET_PATTERNS
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    base = llama_init(jax.random.key(0), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=DPO_TARGET_PATTERNS)
+    adapters = lora_init(jax.random.key(1), base, lcfg)
+    adapters = jax.tree.map(lambda x: x + 0.01, adapters)
+    toks = jnp.asarray(np.random.default_rng(3).integers(0, 256, (2, 8)), jnp.int32)
+    wrapped = lora_apply_fn(lambda p, t: llama_apply(p, t, cfg), base, lcfg)
+    merged = merge_lora(base, adapters, lcfg)
+    np.testing.assert_allclose(
+        np.asarray(wrapped(adapters, toks)),
+        np.asarray(llama_apply(merged, toks, cfg)),
+        rtol=2e-4, atol=2e-4,
+    )
+
+
+def test_embedding_adapter_gets_gradient():
+    from distributed_lion_tpu.models.lora import DPO_TARGET_PATTERNS
+
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    base = llama_init(jax.random.key(0), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=DPO_TARGET_PATTERNS)
+    adapters = lora_init(jax.random.key(1), base, lcfg)
+    toks = jnp.asarray(np.random.default_rng(4).integers(0, 256, (1, 8)), jnp.int32)
+    wrapped = lora_apply_fn(lambda p, t: llama_apply(p, t, cfg), base, lcfg)
+    g = jax.grad(lambda ad: wrapped(ad, toks).astype(jnp.float32).mean())(adapters)
+    # B=0 at init ⇒ signal arrives through wte's B via the gathered A rows
+    assert np.abs(np.asarray(g["wte"]["B"])).sum() > 0
+
+
+def test_adapter_dropout_train_vs_eval():
+    """cfg.dropout armed by a dropout key (train) perturbs the adapter
+    branch; without a key (eval) the forward is deterministic and matches
+    dropout=0. PEFT semantics: base path never dropped (sft_llama2.py:48)."""
+    cfg = LlamaConfig.tiny(compute_dtype=jnp.float32)
+    base = llama_init(jax.random.key(0), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, dropout=0.5)
+    adapters = lora_init(jax.random.key(1), base, lcfg)
+    adapters = jax.tree.map(lambda x: x + 0.05, adapters)  # nonzero branch
+    toks = jnp.asarray(np.random.default_rng(5).integers(0, 256, (1, 16)), jnp.int32)
+    wrapped = lora_apply_fn(lambda p, t: llama_apply(p, t, cfg), base, lcfg)
+    eval_out = wrapped(adapters, toks)
+    nodrop = lora_apply_fn(
+        lambda p, t: llama_apply(p, t, cfg), base,
+        LoraConfig(r=4, alpha=8, dropout=0.0))(adapters, toks)
+    np.testing.assert_allclose(np.asarray(eval_out), np.asarray(nodrop),
+                               rtol=1e-6, atol=1e-6)
+    t1 = wrapped(adapters, toks, dropout_key=jax.random.key(2))
+    t2 = wrapped(adapters, toks, dropout_key=jax.random.key(3))
+    assert np.abs(np.asarray(t1) - np.asarray(eval_out)).max() > 1e-5
+    assert np.abs(np.asarray(t1) - np.asarray(t2)).max() > 1e-5
+    # same key ⇒ bit-identical (replica consistency across the vote world)
+    t1b = wrapped(adapters, toks, dropout_key=jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t1b))
+
+
+def test_embedding_adapter_peft_roundtrip(tmp_path):
+    """wte adapter survives lora_to_peft → peft_to_lora (the PEFT
+    Embedding layout: lora_embedding_A [r, V], lora_embedding_B [d, r])."""
+    from distributed_lion_tpu.models.hf_export import lora_to_peft
+    from distributed_lion_tpu.models.hf_import import peft_to_lora
+    from distributed_lion_tpu.models.lora import DPO_TARGET_PATTERNS
+
+    cfg = LlamaConfig.tiny()
+    base = llama_init(jax.random.key(0), cfg)
+    lcfg = LoraConfig(r=4, alpha=8, target_patterns=DPO_TARGET_PATTERNS)
+    adapters = lora_init(jax.random.key(1), base, lcfg)
+    adapters = jax.tree.map(lambda x: x + 0.01, adapters)
+    lora_to_peft(adapters, cfg, lcfg, str(tmp_path))
+    back, back_cfg = peft_to_lora(str(tmp_path), cfg)
+    assert set(back) == set(adapters)
+    np.testing.assert_allclose(np.asarray(back["wte"]["A"]),
+                               np.asarray(adapters["wte"]["A"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(back["wte"]["B"]),
+                               np.asarray(adapters["wte"]["B"]), rtol=1e-6)
+
+
 def test_quantized_base_trains_only_adapters():
     cfg, base, lcfg, adapters = _setup(quant="int8")
     toks = jnp.asarray(np.random.default_rng(2).integers(0, 256, (1, 8)), jnp.int32)

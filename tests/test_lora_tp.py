@@ -108,6 +108,58 @@ def test_sft_tp_matches_dp():
         assert np.abs(a - b).max() <= 2 * 1e-3 * 5 + 1e-6
 
 
+def test_sft_tp_matches_dp_nf4_base():
+    """dp=4 x tp=2 SFT with an NF4-quantized frozen base ≡ dp=4 with the
+    SAME quantized base (the reference's flagship at scale: multi-chip
+    QLoRA, sft_llama2.py:141-153). The shaped QuantizedTensor layout lets
+    the dense PartitionSpecs shard codes/absmax; each rank dequantizes only
+    its shard."""
+    from distributed_lion_tpu.ops.quant import quantize_tree, validate_quant_tp
+
+    base = llama_init(jax.random.key(0), MODEL)
+    # block=16 so tiny-model projections (last dim 64/128) shard 2-way
+    qbase = quantize_tree(base, "nf4", min_size=1024, block=16)
+
+    apply = lora_apply_fn(lambda p, t: llama_apply(p, t, MODEL), qbase, LORA)
+    tr_dp = Trainer(_cfg(), make_mesh(data=4, devices=jax.devices()[:4]),
+                    lambda p, t, key: apply(p, t),
+                    lora_init(jax.random.key(1), base, LORA))
+    losses_dp, ad_dp = _train(tr_dp)
+
+    base_specs = llama_param_specs(MODEL)
+    validate_quant_tp(qbase, base_specs, 2, TENSOR_AXIS)
+    adapters = lora_init(jax.random.key(1), base, LORA)
+    adapter_specs = lora_adapter_specs(adapters, base_specs, TENSOR_AXIS)
+
+    def loss_fn(params, frozen, batch, dropout_key):
+        eff = apply_adapters(frozen, params, LORA, tp_axis=TENSOR_AXIS,
+                             base_specs=base_specs)
+        logits = llama_apply(eff, batch, MODEL, tp_axis=TENSOR_AXIS)
+        return clm_loss_and_metrics(logits, batch)
+
+    tr_tp = Trainer(_cfg(tensor_parallel=2), make_mesh(data=4, tensor=2),
+                    apply_fn=None, params=adapters,
+                    param_specs=adapter_specs, loss_fn=loss_fn,
+                    frozen_params=qbase, frozen_specs=base_specs)
+    losses_tp, ad_tp = _train(tr_tp)
+    np.testing.assert_allclose(losses_tp, losses_dp, rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(ad_dp), jax.tree.leaves(ad_tp)):
+        assert np.abs(a - b).max() <= 2 * 1e-3 * 5 + 1e-6
+
+
+def test_quant_tp_misaligned_block_rejected():
+    """validate_quant_tp names the offending leaf when block alignment
+    can't shard (e.g. default nf4 block 64 == the whole last dim here)."""
+    import pytest
+
+    from distributed_lion_tpu.ops.quant import quantize_tree, validate_quant_tp
+
+    base = llama_init(jax.random.key(0), MODEL)
+    qbase = quantize_tree(base, "nf4", min_size=1024)  # block 64 → 1 block/row
+    with pytest.raises(ValueError, match="quant"):
+        validate_quant_tp(qbase, llama_param_specs(MODEL), 2, TENSOR_AXIS)
+
+
 def test_sft_tp_adapter_replicas_consistent():
     """The copy_to_tp_region boundary's job: after training, every
     REPLICATED adapter factor (A for the col-parallel wq/wv targets) must be

@@ -59,6 +59,75 @@ def test_quantize_tree_targets_large_2d_only():
     assert dense["big"].shape == (128, 64)
 
 
+def test_shaped_layout_selected_and_rank_aligned():
+    """Aligned shapes get the shaped (TP-shardable) layout: codes/absmax
+    keep the dense rank; odd shapes fall back to flat."""
+    w = jnp.ones((128, 64))
+    qt = quantize_nf4(w, block=16)
+    assert qt.layout == "shaped"
+    assert qt.codes.shape == (128, 32)      # last dim / 2
+    assert qt.absmax.shape == (128, 4)      # last dim / block
+    q8 = quantize_int8(w, block=16)
+    assert q8.layout == "shaped" and q8.codes.shape == (128, 64)
+    assert quantize_nf4(jnp.ones((7, 13)), block=64).layout == "flat"
+    # 3-D (GPT-2's stacked qkv) keeps rank too
+    q3 = quantize_nf4(jnp.ones((8, 3, 64)), block=16)
+    assert q3.layout == "shaped" and q3.codes.shape == (8, 3, 32)
+
+
+def test_shaped_matches_flat_numerics():
+    """For aligned shapes the shaped layout is a pure re-layout: identical
+    dequantized values to the flat path (row-major blocks never straddled
+    rows when last%block==0)."""
+    rng = np.random.default_rng(3)
+    w = jnp.asarray(rng.normal(size=(32, 128)).astype(np.float32))
+    shaped = quantize_nf4(w, block=32)
+    assert shaped.layout == "shaped"
+    flat = QuantizedTensor(
+        *_flat_quant_nf4(np.asarray(w), 32), (32, 128), "nf4", 32, "flat")
+    np.testing.assert_array_equal(
+        np.asarray(dequantize(shaped, jnp.float32)),
+        np.asarray(dequantize(flat, jnp.float32)))
+
+
+def _flat_quant_nf4(w, block):
+    """Reference flat packing in numpy (the pre-round-3 storage layout)."""
+    from distributed_lion_tpu.ops.quant import NF4_LEVELS
+
+    flat = w.reshape(-1).astype(np.float32)
+    blocks = flat.reshape(-1, block)
+    absmax = np.abs(blocks).max(1)
+    scaled = blocks / np.maximum(absmax, 1e-12)[:, None]
+    mids = (NF4_LEVELS[1:] + NF4_LEVELS[:-1]) / 2.0
+    codes4 = np.searchsorted(mids, scaled).astype(np.uint8).reshape(-1)
+    packed = (codes4[0::2] | (codes4[1::2] << 4)).astype(np.uint8)
+    return jnp.asarray(packed), jnp.asarray(absmax)
+
+
+def test_sharded_dequant_matches_dense_slice():
+    """shard_map over a column-sharded shaped QuantizedTensor: each rank's
+    local dequant == the corresponding columns of the full dequant (the
+    invariant TP's maybe_dequant relies on)."""
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("tensor",))
+    w = jnp.asarray(np.random.default_rng(4).normal(size=(32, 64)).astype(np.float32))
+    qt = quantize_nf4(w, block=16)
+    spec = P(None, "tensor")
+    qt_sharded = jax.tree.map(
+        lambda c: jax.device_put(c, NamedSharding(mesh, spec)), qt)
+
+    def local_dequant(q):
+        return dequantize(q, jnp.float32)
+
+    out = shard_map(local_dequant, mesh=mesh, in_specs=spec,
+                    out_specs=spec)(qt_sharded)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dequantize(qt, jnp.float32)))
+
+
 def test_maybe_dequant_passthrough():
     w = jnp.ones((4, 4))
     assert maybe_dequant(w, jnp.float32) is w

@@ -79,6 +79,76 @@ def test_sft_sp_trajectory_matches_pure_dp():
     np.testing.assert_allclose(losses_sp, losses_dp, rtol=2e-2, atol=2e-2)
 
 
+def test_sft_tp_sp_trajectory_matches_pure_dp():
+    """dp=2 x tp=2 x sp=2 SFT (sharded frozen base + ring attention) must
+    reproduce the dp=2 trajectory — the long-context multi-chip QLoRA shape
+    (round-3 composition unlock; mirrors cli/run_sft's tp x sp wiring)."""
+    from distributed_lion_tpu.models.lora import lora_adapter_specs
+    from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+    from distributed_lion_tpu.parallel.tensor_parallel import (
+        llama_param_specs, validate_tp)
+
+    model_cfg, base, lcfg, adapters = _sft_pieces()
+    cfg_dp = _cfg()
+    mesh_dp = make_mesh(data=2, devices=jax.devices()[:2])
+
+    def dp_loss(params, batch, dropout_key):
+        effective = apply_adapters(base, params, lcfg)
+        logits = llama_apply(effective, batch, model_cfg)
+        return clm_loss_and_metrics(logits, batch, None)
+
+    tr_dp = Trainer(cfg_dp, mesh_dp, apply_fn=None, params=adapters,
+                    loss_fn=dp_loss)
+
+    validate_tp(model_cfg, 2, "llama")
+    base_specs = llama_param_specs(model_cfg)
+    adapters2 = lora_init(jax.random.key(1), base, lcfg)
+    adapter_specs = lora_adapter_specs(adapters2, base_specs, TENSOR_AXIS)
+    mesh_tpsp = make_mesh(data=2, tensor=2, seq=2, devices=jax.devices()[:8])
+
+    def tpsp_loss(params, frozen, batch, dropout_key):
+        effective = apply_adapters(frozen, params, lcfg, tp_axis=TENSOR_AXIS,
+                                   base_specs=base_specs)
+        logits = llama_apply(effective, batch, model_cfg,
+                             tp_axis=TENSOR_AXIS, seq_axis=SEQ_AXIS)
+        return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
+    tr_tpsp = Trainer(_cfg(tensor_parallel=2, seq_parallel=2), mesh_tpsp,
+                      apply_fn=None, params=adapters2,
+                      param_specs=adapter_specs, loss_fn=tpsp_loss,
+                      frozen_params=base, frozen_specs=base_specs,
+                      batch_spec=P(DATA_AXIS, SEQ_AXIS))
+
+    rng = np.random.default_rng(7)
+    steps = 6
+    rows = rng.integers(0, model_cfg.vocab_size,
+                        size=(steps, tr_dp.global_train_batch(), 64),
+                        ).astype(np.int32)
+    h_dp = tr_dp.train(iter(list(rows)), max_steps=steps)
+    h_tpsp = tr_tpsp.train(iter(list(rows)), max_steps=steps)
+    l_dp = [h["loss"] for h in h_dp if "loss" in h]
+    l_tpsp = [h["loss"] for h in h_tpsp if "loss" in h]
+    tr_dp.close()
+    tr_tpsp.close()
+    assert len(l_dp) == len(l_tpsp) > 0
+    np.testing.assert_allclose(l_tpsp, l_dp, rtol=2e-2, atol=2e-2)
+
+
+def test_run_sft_cli_tp_sp_smoke():
+    """CLI wiring: --tensor_parallel 2 --seq_parallel 2 (+ NF4 base) runs."""
+    from distributed_lion_tpu.cli.run_sft import main
+
+    main([
+        "--model_name", "tiny", "--dataset", "synthetic", "--lion",
+        "--async_grad", "--max_steps", "2", "--per_device_train_batch_size",
+        "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
+        "--num_train_samples", "32", "--size_valid_set", "8",
+        "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
+        "1000", "--tensor_parallel", "2", "--seq_parallel", "2",
+        "--quant", "nf4", "--quant_block", "16",
+    ])
+
+
 def test_run_sft_cli_seq_parallel_smoke():
     from distributed_lion_tpu.cli.run_sft import main
 
