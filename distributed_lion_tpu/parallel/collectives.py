@@ -161,15 +161,25 @@ def _hier_elect(
     idx = lax.axis_index(axis_name) % g  # my position within the group
     intra_perm = [(s, (s // g) * g + ((s % g) + 1) % g) for s in range(w)]
 
+    # All three rings run under lax.scan — one traced hop re-executed g−1
+    # (or W/g−1) times — so trace/compile size is O(1) in the ring length
+    # and pod-scale groups (g=16+, dozens of groups) compile flat instead of
+    # unrolling hundreds of ppermute ops (the hops themselves are inherently
+    # serialized either way; scan adds no extra latency on the wire).
+
     # phase 1 — reduce-scatter: at hop t I pass on the partial sum of chunk
     # (idx − t) mod g and fold my ballots into the arriving partial, ending
     # with the full tally of owned chunk (idx + 1) mod g.
     own = (idx + 1) % g
-    msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
-    for t in range(g - 1):
+
+    def _rs_hop(msg, t):
         msg = lax.ppermute(msg, axis_name, intra_perm)
         recv = (idx - t - 1) % g
-        msg = msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0]
+        return msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0], None
+
+    msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
+    if g > 1:
+        msg, _ = lax.scan(_rs_hop, msg, jnp.arange(g - 1))
     verdict_own = msg > 0  # subgroup tie → −1, for my owned coords
 
     # phase 2 — cross-group ring of packed verdicts: member i of every group
@@ -179,23 +189,33 @@ def _hier_elect(
     cross_perm = [
         (s, ((s // g + 1) % n_groups) * g + s % g) for s in range(w)
     ]
-    count = verdict_own.astype(jnp.int32)
-    rot = pack_signs(verdict_own)
-    for _ in range(n_groups - 1):
+
+    def _cross_hop(carry, _):
+        count, rot = carry
         rot = lax.ppermute(rot, axis_name, cross_perm)
-        count = count + unpack_signs(rot, (chunk,)).astype(jnp.int32)
+        return (count + unpack_signs(rot, (chunk,)).astype(jnp.int32), rot), None
+
+    count = verdict_own.astype(jnp.int32)
+    if n_groups > 1:
+        (count, _), _ = lax.scan(
+            _cross_hop, (count, pack_signs(verdict_own)), None,
+            length=n_groups - 1)
     elected_own = count * 2 > n_groups  # group-level tie → −1
 
     # phase 3 — intra-group all-gather of the packed elected chunks.
-    packed_own = pack_signs(elected_own)  # [chunk/8] uint8
-    out = jnp.zeros((g, chunk // 8), jnp.uint8)
-    out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
-    rot = packed_own
-    for t in range(g - 1):
+    def _ag_hop(carry, t):
+        out, rot = carry
         rot = lax.ppermute(rot, axis_name, intra_perm)
         # the hop-t packet originated at the member t+1 behind me, which
         # owns chunk (idx − t − 1 + 1) mod g
         out = lax.dynamic_update_slice(out, rot[None], ((idx - t) % g, 0))
+        return (out, rot), None
+
+    packed_own = pack_signs(elected_own)  # [chunk/8] uint8
+    out = jnp.zeros((g, chunk // 8), jnp.uint8)
+    out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
+    if g > 1:
+        (out, _), _ = lax.scan(_ag_hop, (out, packed_own), jnp.arange(g - 1))
     return unpack_signs(out.reshape(-1), (g * chunk,))[:n]
 
 

@@ -22,7 +22,8 @@ N_CHUNKS = 4    # timed dispatches → K * N_CHUNKS steps
 
 
 def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
-        accum: int = 1, dtype: str = "f32", vocab_chunks: int = 0) -> float:
+        accum: int = 1, dtype: str = "f32", vocab_chunks: int = 0,
+        mom_dtype: str = "") -> float:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -53,7 +54,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         per_device_train_batch_size=batch_per_dev,
         gradient_accumulation_steps=accum, block_size=model_cfg.n_ctx,
         steps_per_call=K, logging_steps=10_000, output_dir=None,
-        vocab_chunks=vocab_chunks,
+        vocab_chunks=vocab_chunks, mom_dtype=mom_dtype,
     )
     trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
     global_bs = trainer.global_train_batch()
@@ -81,6 +82,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
     print(json.dumps({
         "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_spec,
         "accum": accum, "dtype": dtype, "vocab_chunks": vocab_chunks,
+        "mom_dtype": mom_dtype or "f32",
         "ms_per_step": round(dt / steps * 1e3, 1), "loss": round(final_loss, 3),
         "tokens_per_sec_per_chip": round(tps, 1),
     }), flush=True)
@@ -88,14 +90,17 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
 
 
 if __name__ == "__main__":
-    DEFAULTS = ["auto", "1", "f32", "0"]  # attn, accum, dtype, vocab_chunks
+    # spec: remat:batch[:attn[@bqxbkv][:accum[:dtype[:vocab_chunks[:mom]]]]]
+    DEFAULTS = ["auto", "1", "f32", "0", ""]
     for spec in sys.argv[1:]:
         parts = spec.split(":")
         parts += DEFAULTS[len(parts) - 2:]  # pad only the missing tail
         remat_s, bs_s, attn, accum_s, dtype = parts[:5]
         vc = int(parts[5]) if len(parts) > 5 else 0
+        mom = parts[6] if len(parts) > 6 else ""
         try:
-            run(remat_s, int(bs_s), attn, int(accum_s), dtype, vc)
+            run(remat_s, int(bs_s), attn, int(accum_s), dtype, vc,
+                "bfloat16" if mom in ("bf16", "bfloat16") else mom)
         except Exception as e:  # OOM on big configs: report and keep sweeping
             print(json.dumps({
                 "remat": remat_s, "batch_per_dev": int(bs_s),

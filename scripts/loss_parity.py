@@ -1,0 +1,387 @@
+"""Loss-parity experiment: vote-Lion (W=8) vs local Lion at equal global batch.
+
+BASELINE.md north-star #1 — "distributed-vote Lion matches single-worker
+Lion's loss curve at equal global batch" — at real scale: the GPT-2 124M
+*architecture* (12L, d=768, T=1024) over real local text through the native
+BPE pipeline, a few thousand optimizer steps, on the real chip.
+
+Single-chip discipline: the 8 voters run as VIRTUAL workers on one device —
+a ``lax.scan`` over 8 per-worker (momentum, microbatch) slices computing the
+exact vote-Lion algorithm with ops/lion_math's op ordering (wd → ballot →
+vote → apply → momentum-from-local-grad). This is algebraically identical to
+the dp=8 mesh path: the wire tests (tests/test_distributed_lion.py,
+test_hier_vote.py) already pin that every wire computes exactly this
+ballot-sum election, so the only thing a real 8-chip mesh would change is
+WHERE the int8 sum runs.
+
+Phases:
+    python scripts/loss_parity.py --phase prep        # corpus + vocab + tokens (CPU ok)
+    python scripts/loss_parity.py --phase run --mode local
+    python scripts/loss_parity.py --phase run --mode vote
+    python scripts/loss_parity.py --phase report      # REPORT.md from the JSONLs
+
+prep: concatenates ~200MB of local Python/Markdown sources, trains a 16384-
+token byte-level BPE with the HF ``tokenizers`` trainer (Rust — the pure-
+Python ``train_bpe`` is for small vocabularies; the ARTIFACT is the standard
+vocab.json+merges.txt this framework's native C++ BPE consumes), then
+encodes the corpus with OUR tokenizer (data/bpe._NativeCore) into a token
+memmap. Model embeddings size to the 16k vocab → ~98M params.
+
+Reference anchors: canonical config lr 1e-4, wd 0.1, bf16, T=1024
+(/root/reference/README.md:18-38); update semantics distributed_lion.py:61-96.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in __import__("sys").path:  # `python scripts/loss_parity.py`
+    __import__("sys").path.insert(0, REPO)
+DEFAULT_OUT = os.path.join(REPO, "runs", "parity")
+VOCAB = 16384
+T = 1024
+WORKERS = 8
+ROWS_PER_WORKER = 4          # global batch 32 rows = 32768 tokens/step
+SMOKE = False                # --smoke: tiny model/seq for a CPU pipeline check
+LR, WD, B1, B2 = 1e-4, 0.1, 0.9, 0.99
+WARMUP = 100
+
+
+# ------------------------------------------------------------------- prep
+
+def _corpus_files(max_bytes: int) -> list:
+    pats = [
+        os.path.join(REPO, "**", "*.py"),
+        os.path.join(REPO, "**", "*.md"),
+        "/opt/venv/lib/**/*.py",
+    ]
+    out, total = [], 0
+    for pat in pats:
+        for p in sorted(glob.glob(pat, recursive=True)):
+            try:
+                sz = os.path.getsize(p)
+            except OSError:
+                continue
+            if sz < 256:
+                continue
+            out.append(p)
+            total += sz
+            if total >= max_bytes:
+                return out
+    return out
+
+
+def prep(out_dir: str, max_bytes: int) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    corpus_path = os.path.join(out_dir, "corpus.txt")
+    if not os.path.exists(corpus_path):
+        files = _corpus_files(max_bytes)
+        print(f"[prep] concatenating {len(files)} files")
+        with open(corpus_path, "w", encoding="utf-8") as w:
+            for p in files:
+                try:
+                    with open(p, encoding="utf-8", errors="replace") as f:
+                        w.write(f.read())
+                    w.write("\n\n")
+                except OSError:
+                    continue
+        print(f"[prep] corpus: {os.path.getsize(corpus_path)/1e6:.0f} MB")
+
+    tok_dir = os.path.join(out_dir, "tok")
+    if not os.path.exists(os.path.join(tok_dir, "vocab.json")):
+        # vocab learned by the fast Rust trainer; ARTIFACT is the standard
+        # GPT-2 file format our native BPE loads (data/bpe.BPETokenizer)
+        from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+
+        t0 = time.time()
+        hf = Tokenizer(models.BPE())
+        hf.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        trainer = trainers.BpeTrainer(
+            vocab_size=VOCAB - 1,  # + <|endoftext|> on our side
+            special_tokens=[],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        )
+        hf.train([corpus_path], trainer)
+        os.makedirs(tok_dir, exist_ok=True)
+        hf.model.save(tok_dir)  # vocab.json + merges.txt
+        print(f"[prep] 16k BPE vocabulary trained in {time.time()-t0:.0f}s")
+
+    tokens_path = os.path.join(out_dir, "tokens.npy")
+    if not os.path.exists(tokens_path):
+        import numpy as np
+
+        from distributed_lion_tpu.data.bpe import BPETokenizer
+
+        tok = BPETokenizer.load(tok_dir)
+        assert tok.vocab_size <= VOCAB, tok.vocab_size
+        t0 = time.time()
+        ids: list = []
+        with open(corpus_path, encoding="utf-8") as f:
+            while True:
+                chunk = f.read(4 << 20)
+                if not chunk:
+                    break
+                ids.append(np.asarray(tok.encode(chunk), np.int32))
+        stream = np.concatenate(ids)
+        np.save(tokens_path, stream)
+        mb = os.path.getsize(corpus_path) / 1e6
+        print(f"[prep] {stream.size/1e6:.1f}M tokens in {time.time()-t0:.0f}s "
+              f"({mb/(time.time()-t0):.1f} MB/s native BPE)")
+    else:
+        import numpy as np
+
+        stream = np.load(tokens_path, mmap_mode="r")
+    print(f"[prep] ready: {stream.size/1e6:.1f}M tokens at {tokens_path}")
+
+
+# -------------------------------------------------------------------- run
+
+def _blocks(out_dir: str):
+    import numpy as np
+
+    stream = np.load(os.path.join(out_dir, "tokens.npy"), mmap_mode="r")
+    n_blocks = stream.size // T
+    blocks = stream[: n_blocks * T].reshape(n_blocks, T)
+    n_eval = 64
+    return blocks[n_eval:], blocks[:n_eval]  # train, held-out
+
+
+def run(out_dir: str, mode: str, steps: int, log_every: int,
+        eval_every: int, seed: int, force_cpu: bool = False) -> None:
+    assert mode in ("local", "vote")
+    import jax
+
+    if force_cpu:
+        # the axon sitecustomize force-registers the TPU plugin and a dead
+        # tunnel HANGS jax.devices(); the config knob set before first
+        # backend use is the only reliable override (see bench.py)
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_apply, gpt2_init
+    from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+    from distributed_lion_tpu.ops.lion_math import (
+        apply_signed_update,
+        decay_params,
+        local_lion_leaf,
+        momentum_update,
+        sign_vote_bool,
+    )
+    from distributed_lion_tpu.train.schedule import cosine_schedule_with_warmup
+
+    dev = jax.devices()[0]
+    print(f"[run:{mode}] backend={dev.platform} ({dev.device_kind})")
+    import dataclasses
+
+    if SMOKE:
+        cfg = GPT2Config.tiny(vocab_size=VOCAB, n_ctx=T)
+    else:
+        cfg = GPT2Config.gpt2_124m(vocab_size=VOCAB)
+    cfg = dataclasses.replace(cfg, remat=False, attn_impl="xla",
+                              param_dtype=jnp.bfloat16)
+    params = gpt2_init(jax.random.key(seed), cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    print(f"[run:{mode}] {n_params/1e6:.1f}M params "
+          f"(124M architecture, {VOCAB} local vocab)")
+    schedule = cosine_schedule_with_warmup(LR, WARMUP, steps)
+
+    def loss_fn(p, batch):
+        logits = gpt2_apply(p, batch, cfg, dropout_key=None)
+        loss, _ = clm_loss_and_metrics(logits, batch)
+        return loss
+
+    grad_fn = jax.value_and_grad(loss_fn)
+    gb = WORKERS * ROWS_PER_WORKER
+
+    if mode == "local":
+        moms = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        @jax.jit
+        def step_fn(params, moms, count, batch):  # batch [gb, T]
+            lr = schedule(count)
+            loss, g = grad_fn(params, batch)
+            out = jax.tree.map(
+                lambda p, gg, m: local_lion_leaf(p, gg, m, lr, WD, B1, B2),
+                params, g, moms,
+                is_leaf=lambda x: isinstance(x, jnp.ndarray),
+            )
+            params = jax.tree.map(lambda o: o[0], out,
+                                  is_leaf=lambda x: isinstance(x, tuple))
+            moms = jax.tree.map(lambda o: o[1], out,
+                                is_leaf=lambda x: isinstance(x, tuple))
+            return params, moms, count + 1, loss
+    else:
+        # W=8 virtual vote workers: scan over per-worker (momentum slice,
+        # microbatch); ballots accumulate as an int8 ±1 sum (the sign_psum
+        # election); every worker applies the identical elected update.
+        moms = jax.tree.map(
+            lambda p: jnp.zeros((WORKERS,) + p.shape, jnp.float32), params)
+
+        @jax.jit
+        def step_fn(params, moms, count, batch):  # batch [W, rows, T]
+            lr = schedule(count)
+
+            def worker(ballots, xs):
+                m_w, b = xs
+                loss, g = grad_fn(params, b)
+                ballots = jax.tree.map(
+                    lambda bt, gg, mm: bt + jnp.where(
+                        sign_vote_bool(gg, mm, B1), 1, -1).astype(jnp.int8),
+                    ballots, g, m_w)
+                m_new = jax.tree.map(
+                    lambda gg, mm: momentum_update(gg, mm, B2), g, m_w)
+                return ballots, (m_new, loss)
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.int8), params)
+            ballots, (moms_new, losses) = jax.lax.scan(
+                worker, zeros, (moms, batch))
+            params = jax.tree.map(
+                lambda p, bt: apply_signed_update(
+                    decay_params(p, lr, WD), bt > 0, lr),
+                params, ballots)
+            return params, moms_new, count + 1, losses.mean()
+
+    @jax.jit
+    def eval_loss(params, batch):
+        return loss_fn(params, batch)
+
+    train_blocks, eval_blocks = _blocks(out_dir)
+    eval_dev = jnp.asarray(np.asarray(eval_blocks[:32]), jnp.int32)
+    rng = np.random.default_rng(seed + 1)
+    order = rng.permutation(len(train_blocks))
+    pos = 0
+
+    def next_batch():
+        nonlocal pos, order
+        if pos + gb > len(order):
+            order = rng.permutation(len(train_blocks))
+            pos = 0
+        idx = np.sort(order[pos: pos + gb])
+        pos += gb
+        rows = np.asarray(train_blocks[idx], np.int32)
+        if mode == "vote":
+            return jnp.asarray(rows.reshape(WORKERS, ROWS_PER_WORKER, T))
+        return jnp.asarray(rows)
+
+    log_path = os.path.join(out_dir, f"{mode}.jsonl")
+    count = jnp.int32(0)
+    t0 = time.time()
+    with open(log_path, "w") as logf:
+        for s in range(steps):
+            params, moms, count, loss = step_fn(params, moms, count, next_batch())
+            if s % log_every == 0 or s == steps - 1:
+                lv = float(np.asarray(jax.device_get(loss)))
+                rec = {"step": s, "loss": round(lv, 5),
+                       "lr": float(schedule(s)),
+                       "tokens": (s + 1) * gb * T,
+                       "wall_s": round(time.time() - t0, 1)}
+                logf.write(json.dumps(rec) + "\n")
+                logf.flush()
+                print(f"[run:{mode}] step {s}: loss {lv:.4f} "
+                      f"({rec['tokens']/max(rec['wall_s'],1e-9)/1e3:.1f}k tok/s)")
+            if eval_every and (s + 1) % eval_every == 0:
+                ev = float(np.asarray(jax.device_get(
+                    eval_loss(params, eval_dev))))
+                logf.write(json.dumps(
+                    {"step": s, "eval_loss": round(ev, 5)}) + "\n")
+                logf.flush()
+                print(f"[run:{mode}] step {s}: eval {ev:.4f}")
+    print(f"[run:{mode}] done: {log_path}")
+
+
+# ----------------------------------------------------------------- report
+
+def report(out_dir: str) -> None:
+    def load(mode):
+        tr, ev = {}, {}
+        path = os.path.join(out_dir, f"{mode}.jsonl")
+        with open(path) as f:
+            for line in f:
+                r = json.loads(line)
+                if "eval_loss" in r:
+                    ev[r["step"]] = r["eval_loss"]
+                elif "loss" in r:
+                    tr[r["step"]] = r["loss"]
+        return tr, ev
+
+    tr_l, ev_l = load("local")
+    tr_v, ev_v = load("vote")
+    common = sorted(set(tr_l) & set(tr_v))
+    lines = [
+        "# Loss parity: vote-Lion (W=8) vs local Lion — equal global batch",
+        "",
+        "GPT-2 124M architecture (12L d=768 T=1024, 16,384-token local BPE "
+        "vocab ≈ 98M params), real local text, canonical reference config "
+        "(lr 1e-4, wd 0.1, bf16, cosine+warmup). Generated by "
+        "scripts/loss_parity.py; raw curves in local.jsonl / vote.jsonl.",
+        "",
+        "| step | local loss | vote-W8 loss | Δ |",
+        "|---|---|---|---|",
+    ]
+    show = [s for i, s in enumerate(common)
+            if i % max(1, len(common) // 20) == 0] + common[-1:]
+    for s in dict.fromkeys(show):
+        d = tr_v[s] - tr_l[s]
+        lines.append(f"| {s} | {tr_l[s]:.4f} | {tr_v[s]:.4f} | {d:+.4f} |")
+    tail = [s for s in common if s >= common[-1] * 0.5]
+    mad = sum(abs(tr_v[s] - tr_l[s]) for s in tail) / max(len(tail), 1)
+    lines += ["",
+              f"Mean |Δ| over the last half of training: **{mad:.4f} nats** "
+              f"({len(tail)} logged points).", ""]
+    if ev_l and ev_v:
+        lines += ["| step | local eval | vote-W8 eval |", "|---|---|---|"]
+        for s in sorted(set(ev_l) & set(ev_v)):
+            lines.append(f"| {s} | {ev_l[s]:.4f} | {ev_v[s]:.4f} |")
+        lines.append("")
+    path = os.path.join(out_dir, "REPORT.md")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    print(f"[report] {path}\n" + "\n".join(lines[:14]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", choices=("prep", "run", "report", "all"),
+                    default="all")
+    ap.add_argument("--mode", choices=("local", "vote"), default="local")
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--steps", type=int, default=2000)
+    ap.add_argument("--log_every", type=int, default=10)
+    ap.add_argument("--eval_every", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--corpus_bytes", type=int, default=200_000_000)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + short seq: CPU pipeline check only")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (a dead TPU tunnel hangs "
+                    "backend init otherwise); implied by --smoke")
+    args = ap.parse_args()
+    if args.smoke:
+        global SMOKE, T, ROWS_PER_WORKER
+        SMOKE = True
+        T = 128
+        ROWS_PER_WORKER = 1
+        args.cpu = True
+    if args.phase in ("prep", "all"):
+        prep(args.out, args.corpus_bytes)
+    if args.phase == "run":
+        run(args.out, args.mode, args.steps, args.log_every,
+            args.eval_every, args.seed, force_cpu=args.cpu)
+    elif args.phase == "all":
+        for mode in ("local", "vote"):
+            run(args.out, mode, args.steps, args.log_every,
+                args.eval_every, args.seed, force_cpu=args.cpu)
+        report(args.out)
+    if args.phase == "report":
+        report(args.out)
+
+
+if __name__ == "__main__":
+    main()

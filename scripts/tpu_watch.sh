@@ -1,0 +1,34 @@
+#!/bin/bash
+# TPU-tunnel watcher: poll until jax sees a TPU, then fire a pipeline.
+#
+# The axon tunnel this environment reaches its chip through can hang
+# jax.devices() for HOURS (not error — hang), which is how round 1 lost its
+# perf axis and round 2 recorded a CPU fallback. Run this early in a round,
+# detached, so a transient outage can't erase the TPU evidence:
+#
+#   nohup scripts/tpu_watch.sh 'python bench.py > BENCH_TPU.json' \
+#       > /tmp/tpu_watch.log 2>&1 &
+#
+# Every probe runs in a child process under a hard timeout (never probe
+# in-process). Kill by PID, not pkill -f (which matches your own shell).
+
+set -u
+PIPELINE="${1:?usage: tpu_watch.sh '<command to run when TPU is up>'}"
+INTERVAL="${2:-90}"
+
+while true; do
+  out=$(timeout 120 python -c \
+    "import jax; d=jax.devices(); print(len(d), d[0].platform)" 2>/dev/null)
+  case "$out" in
+    *tpu*)
+      echo "$(date -u +%FT%TZ) TPU up ($out); running pipeline"
+      bash -c "$PIPELINE"
+      exit $?
+      ;;
+    "")
+      echo "$(date -u +%FT%TZ) probe timed out/failed" ;;
+    *)
+      echo "$(date -u +%FT%TZ) backend: $out (not tpu)" ;;
+  esac
+  sleep "$INTERVAL"
+done
