@@ -50,7 +50,9 @@ def run_inner(args) -> None:
     mesh = Mesh(np.array(devs[:w]), ("data",))
     n = args.n
     rng = np.random.default_rng(0)
-    votes_np = rng.random((w, n)) < 0.5
+    # uint8 draw, not rng.random: a float64 [w, n] transient would be ~8 GB
+    # at the default 124M-coordinate size
+    votes_np = rng.integers(0, 2, (w, n), dtype=np.uint8).astype(bool)
 
     for wire in args.wires:
         def body(v):

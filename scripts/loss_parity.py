@@ -153,7 +153,7 @@ def _blocks(out_dir: str):
 
 def run(out_dir: str, mode: str, steps: int, log_every: int,
         eval_every: int, seed: int, force_cpu: bool = False) -> None:
-    assert mode in ("local", "vote")
+    assert mode in ("local", "vote", "lazy")
     import jax
 
     if force_cpu:
@@ -216,7 +216,7 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
             moms = jax.tree.map(lambda o: o[1], out,
                                 is_leaf=lambda x: isinstance(x, tuple))
             return params, moms, count + 1, loss
-    else:
+    elif mode == "vote":
         # W=8 virtual vote workers: scan over per-worker (momentum slice,
         # microbatch); ballots accumulate as an int8 ±1 sum (the sign_psum
         # election); every worker applies the identical elected update.
@@ -247,6 +247,60 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
                     decay_params(p, lr, WD), bt > 0, lr),
                 params, ballots)
             return params, moms_new, count + 1, losses.mean()
+    else:  # mode == "lazy": the budget-meeting wire at realistic scale —
+        # vote_every=4 lazy sign refresh (optim.distributed_lion._elect_lazy
+        # semantics: rotating 1/K slice of the FLAT ballot vector voted each
+        # step, cached elected signs elsewhere, cold-start validity mask).
+        # With the packed_a2a wire this config is ~0.5 bit/param/step.
+        from distributed_lion_tpu.ops.codec import vote_chunk_elems
+
+        K = 4
+        flat_leaves, treedef = jax.tree.flatten(params)
+        sizes = [int(np.prod(p.shape)) for p in flat_leaves]
+        shapes = [p.shape for p in flat_leaves]
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        n_total = int(offsets[-1])
+        chunk = vote_chunk_elems(n_total, K)
+        moms = jax.tree.map(
+            lambda p: jnp.zeros((WORKERS,) + p.shape, jnp.float32), params)
+        cache = jnp.zeros((K * chunk,), bool)
+
+        @jax.jit
+        def step_fn(params, moms, cache, count, batch):  # batch [W, rows, T]
+            lr = schedule(count)
+
+            def worker(ballots, xs):
+                m_w, b = xs
+                loss, g = grad_fn(params, b)
+                votes = jnp.concatenate([
+                    sign_vote_bool(gg, mm, B1).reshape(-1)
+                    for gg, mm in zip(jax.tree.leaves(g), jax.tree.leaves(m_w))
+                ])
+                ballots = ballots + jnp.where(votes, 1, -1).astype(jnp.int8)
+                m_new = jax.tree.map(
+                    lambda gg, mm: momentum_update(gg, mm, B2), g, m_w)
+                return ballots, (m_new, loss)
+
+            ballots, (moms_new, losses) = jax.lax.scan(
+                worker, jnp.zeros((n_total,), jnp.int8), (moms, batch))
+            pad = K * chunk - n_total
+            padded = (jnp.concatenate([ballots, jnp.zeros((pad,), jnp.int8)])
+                      if pad else ballots)
+            slot = jax.lax.rem(count, jnp.int32(K))
+            sl = jax.lax.dynamic_slice(padded, (slot * chunk,), (chunk,))
+            cache = jax.lax.dynamic_update_slice(cache, sl > 0, (slot * chunk,))
+            slot_idx = jnp.arange(K * chunk, dtype=jnp.int32) // chunk
+            valid = (slot_idx <= count)[:n_total].astype(jnp.float32)
+            sign_flat = jnp.where(cache[:n_total], 1.0, -1.0) * valid
+            new_leaves = [
+                decay_params(p, lr, WD)
+                - jnp.asarray(lr, p.dtype)
+                * sign_flat[offsets[i]:offsets[i + 1]].reshape(
+                    shapes[i]).astype(p.dtype)
+                for i, p in enumerate(jax.tree.leaves(params))
+            ]
+            params = jax.tree.unflatten(treedef, new_leaves)
+            return params, moms_new, cache, count + 1, losses.mean()
 
     @jax.jit
     def eval_loss(params, batch):
@@ -266,7 +320,7 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
         idx = np.sort(order[pos: pos + gb])
         pos += gb
         rows = np.asarray(train_blocks[idx], np.int32)
-        if mode == "vote":
+        if mode in ("vote", "lazy"):
             return jnp.asarray(rows.reshape(WORKERS, ROWS_PER_WORKER, T))
         return jnp.asarray(rows)
 
@@ -275,7 +329,12 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
     t0 = time.time()
     with open(log_path, "w") as logf:
         for s in range(steps):
-            params, moms, count, loss = step_fn(params, moms, count, next_batch())
+            if mode == "lazy":
+                params, moms, cache, count, loss = step_fn(
+                    params, moms, cache, count, next_batch())
+            else:
+                params, moms, count, loss = step_fn(
+                    params, moms, count, next_batch())
             if s % log_every == 0 or s == steps - 1:
                 lv = float(np.asarray(jax.device_get(loss)))
                 rec = {"step": s, "loss": round(lv, 5),
@@ -302,6 +361,8 @@ def report(out_dir: str) -> None:
     def load(mode):
         tr, ev = {}, {}
         path = os.path.join(out_dir, f"{mode}.jsonl")
+        if not os.path.exists(path):
+            return None, None
         with open(path) as f:
             for line in f:
                 r = json.loads(line)
@@ -313,32 +374,57 @@ def report(out_dir: str) -> None:
 
     tr_l, ev_l = load("local")
     tr_v, ev_v = load("vote")
+    tr_z, ev_z = load("lazy")  # optional third curve: vote_every=4 wire
+    if not tr_l or not tr_v:
+        raise SystemExit(
+            "[report] need BOTH local.jsonl and vote.jsonl with train "
+            "records; run --phase run --mode local and --mode vote first"
+        )
     common = sorted(set(tr_l) & set(tr_v))
+    if not common:
+        raise SystemExit("[report] local and vote curves share no logged steps")
+    has_lazy = bool(tr_z)
     lines = [
         "# Loss parity: vote-Lion (W=8) vs local Lion — equal global batch",
         "",
         "GPT-2 124M architecture (12L d=768 T=1024, 16,384-token local BPE "
         "vocab ≈ 98M params), real local text, canonical reference config "
         "(lr 1e-4, wd 0.1, bf16, cosine+warmup). Generated by "
-        "scripts/loss_parity.py; raw curves in local.jsonl / vote.jsonl.",
+        "scripts/loss_parity.py; raw curves in local.jsonl / vote.jsonl"
+        + (" / lazy.jsonl (vote_every=4 — the ≤0.5 bit/param wire)"
+           if has_lazy else "") + ".",
         "",
-        "| step | local loss | vote-W8 loss | Δ |",
-        "|---|---|---|---|",
+        "| step | local loss | vote-W8 loss | Δ |"
+        + (" lazy-K4 loss | Δ |" if has_lazy else ""),
+        "|---|---|---|---|" + ("---|---|" if has_lazy else ""),
     ]
     show = [s for i, s in enumerate(common)
             if i % max(1, len(common) // 20) == 0] + common[-1:]
     for s in dict.fromkeys(show):
         d = tr_v[s] - tr_l[s]
-        lines.append(f"| {s} | {tr_l[s]:.4f} | {tr_v[s]:.4f} | {d:+.4f} |")
+        row = f"| {s} | {tr_l[s]:.4f} | {tr_v[s]:.4f} | {d:+.4f} |"
+        if has_lazy and s in tr_z:
+            row += f" {tr_z[s]:.4f} | {tr_z[s] - tr_l[s]:+.4f} |"
+        lines.append(row)
     tail = [s for s in common if s >= common[-1] * 0.5]
     mad = sum(abs(tr_v[s] - tr_l[s]) for s in tail) / max(len(tail), 1)
     lines += ["",
-              f"Mean |Δ| over the last half of training: **{mad:.4f} nats** "
-              f"({len(tail)} logged points).", ""]
+              f"Mean |Δ| (vote − local) over the last half of training: "
+              f"**{mad:.4f} nats** ({len(tail)} logged points).", ""]
+    if has_lazy:
+        tail_z = [s for s in tail if s in tr_z]
+        mad_z = sum(abs(tr_z[s] - tr_l[s]) for s in tail_z) / max(len(tail_z), 1)
+        lines += [f"Mean |Δ| (lazy-K4 − local) over the same span: "
+                  f"**{mad_z:.4f} nats** ({len(tail_z)} points).", ""]
     if ev_l and ev_v:
-        lines += ["| step | local eval | vote-W8 eval |", "|---|---|---|"]
+        lines += ["| step | local eval | vote-W8 eval |"
+                  + (" lazy-K4 eval |" if has_lazy else ""),
+                  "|---|---|---|" + ("---|" if has_lazy else "")]
         for s in sorted(set(ev_l) & set(ev_v)):
-            lines.append(f"| {s} | {ev_l[s]:.4f} | {ev_v[s]:.4f} |")
+            row = f"| {s} | {ev_l[s]:.4f} | {ev_v[s]:.4f} |"
+            if has_lazy and ev_z and s in ev_z:
+                row += f" {ev_z[s]:.4f} |"
+            lines.append(row)
         lines.append("")
     path = os.path.join(out_dir, "REPORT.md")
     with open(path, "w") as f:
@@ -350,7 +436,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", choices=("prep", "run", "report", "all"),
                     default="all")
-    ap.add_argument("--mode", choices=("local", "vote"), default="local")
+    ap.add_argument("--mode", choices=("local", "vote", "lazy"),
+                    default="local")
     ap.add_argument("--out", default=DEFAULT_OUT)
     ap.add_argument("--steps", type=int, default=2000)
     ap.add_argument("--log_every", type=int, default=10)
