@@ -64,6 +64,32 @@ class SFTArguments:
     # (LlamaForCausalLM.from_pretrained-loadable, models/hf_export)
 
 
+def _sp_head_loss(effective, batch, model_cfg, train_cfg, tp_axis=None):
+    """Seq-parallel SFT loss over the (possibly adapted/quantized) effective
+    params — ONE dispatch point for the dense vs chunked-vocab head under
+    ``--seq_parallel``, with or without a tensor axis. ``--vocab_chunks``
+    streams the lm_head per shard (ops/xent.chunked_clm_loss_seq_parallel:
+    the [B, T/sp, V] logits never materialize and the shard-boundary label
+    ppermute is shared with the dense path's protocol)."""
+    from distributed_lion_tpu.models.llama import llama_apply, llama_hidden
+    from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
+    from distributed_lion_tpu.parallel.mesh import SEQ_AXIS
+
+    if train_cfg.vocab_chunks > 0:
+        from distributed_lion_tpu.ops.quant import maybe_dequant
+        from distributed_lion_tpu.ops.xent import chunked_clm_loss_seq_parallel
+
+        hidden = llama_hidden(effective, batch, model_cfg,
+                              tp_axis=tp_axis, seq_axis=SEQ_AXIS)
+        emb = maybe_dequant(effective["lm_head"], model_cfg.compute_dtype)
+        return chunked_clm_loss_seq_parallel(
+            hidden, emb, batch, train_cfg.vocab_chunks, SEQ_AXIS,
+            emb_layout="dv")
+    logits = llama_apply(effective, batch, model_cfg,
+                         tp_axis=tp_axis, seq_axis=SEQ_AXIS)
+    return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+
+
 def main(argv=None):
     from distributed_lion_tpu.utils.argparsing import parse_dataclasses
 
@@ -110,11 +136,6 @@ def main(argv=None):
             raise NotImplementedError(
                 "--seq_parallel needs --packing: padded/masked per-example "
                 "rows are not wired across sequence shards"
-            )
-        if train_cfg.vocab_chunks > 0:
-            raise NotImplementedError(
-                "--vocab_chunks under --seq_parallel is not wired on the SFT "
-                "path (the boundary-label exchange lives in the dense loss)"
             )
     mesh = build_mesh(train_cfg.tensor_parallel, sp)
     tok = load_tokenizer(script_args.tokenizer_name)
@@ -242,7 +263,6 @@ def main(argv=None):
             # and the train loop psums grads over the seq axis.
             from jax.sharding import PartitionSpec as P
 
-            from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
             from distributed_lion_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
             def loss_fn(params, frozen, batch, dropout_key):
@@ -250,10 +270,10 @@ def main(argv=None):
                                            tp_axis=TENSOR_AXIS,
                                            base_specs=base_specs,
                                            dropout_key=dropout_key)
-                logits = llama_apply(effective, batch, model_cfg,
-                                     tp_axis=TENSOR_AXIS, seq_axis=SEQ_AXIS)
-                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+                return _sp_head_loss(effective, batch, model_cfg, train_cfg,
+                                     tp_axis=TENSOR_AXIS)
 
+            loss_fn._vocab_chunked = True
             trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                               param_specs=adapter_specs, loss_fn=loss_fn,
                               frozen_params=base_params,
@@ -275,16 +295,15 @@ def main(argv=None):
     elif sp > 1:
         from jax.sharding import PartitionSpec as P
 
-        from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
         from distributed_lion_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
 
         def loss_fn(params, batch, dropout_key):
             # batch is this shard's contiguous token chunk [B, T/sp]
             effective = apply_adapters(base_params, params, lora_cfg,
                                        dropout_key=dropout_key)
-            logits = llama_apply(effective, batch, model_cfg, seq_axis=SEQ_AXIS)
-            return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+            return _sp_head_loss(effective, batch, model_cfg, train_cfg)
 
+        loss_fn._vocab_chunked = True
         trainer = Trainer(train_cfg, mesh, apply_fn=None, params=adapters,
                           loss_fn=loss_fn,
                           batch_spec=P(DATA_AXIS, SEQ_AXIS))

@@ -187,6 +187,50 @@ def _shifted_clm_metrics(xent_fn, hidden, tokens, loss_mask):
     return loss, {"loss": loss, "accuracy": acc, "n_tokens": mask.sum()}
 
 
+def chunked_clm_loss_seq_parallel(
+    hidden: jnp.ndarray,
+    emb: jnp.ndarray,
+    tokens: jnp.ndarray,
+    n_chunks: int,
+    axis_name: str,
+    emb_layout: str = "vd",
+) -> tuple[jnp.ndarray, dict]:
+    """Chunked-vocab CE under sequence parallelism (inside shard_map) —
+    the composition of :func:`chunked_clm_loss_and_metrics` (no [B, T, V]
+    logits materialized) with models/loss.clm_loss_seq_parallel's
+    shard-boundary protocol (each device holds a contiguous [B, T_local]
+    token chunk; its last position's label arrives from the next shard via
+    one [B, 1] ppermute; only the final shard's final position is masked).
+
+    Long-context × huge-vocab is exactly where both tricks matter at once:
+    at T=128k sharded 8 ways with a 128k vocab, a single shard's dense
+    logits would still be [B, 16k, 128k] f32. Same gradient contract as
+    clm_loss_seq_parallel: returns ``local_nll_sum / global_token_count``
+    whose seq-axis grad psum (done by the train loop) is the full gradient.
+    """
+    from distributed_lion_tpu.models.loss import shift_in_next_shard
+
+    S = jax.lax.psum(1, axis_name)
+    labels, is_last = shift_in_next_shard(tokens, axis_name)  # [B, T_local]
+    mask = jnp.ones(labels.shape, jnp.float32)
+    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+
+    b, t, d = hidden.shape
+    nll, correct = chunked_softmax_xent(
+        hidden.reshape(b * t, d), emb,
+        labels.reshape(-1).astype(jnp.int32), n_chunks, emb_layout)
+    flat_mask = mask.reshape(-1)
+    n_global = jnp.maximum(jax.lax.psum(flat_mask.sum(), axis_name), 1.0)
+    loss_local = (nll * flat_mask).sum() / n_global
+    acc = jax.lax.psum(
+        (correct.astype(jnp.float32) * flat_mask).sum(), axis_name) / n_global
+    return loss_local, {
+        "loss": jax.lax.psum(loss_local, axis_name),
+        "accuracy": acc,
+        "n_tokens": n_global / jnp.maximum(S, 1),
+    }
+
+
 def tp_vocab_clm_loss_and_metrics(
     hidden: jnp.ndarray,
     head_shard: jnp.ndarray,

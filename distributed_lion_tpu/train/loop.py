@@ -766,13 +766,11 @@ class Trainer:
                if "dcn_bits_per_param" in acct else "")
         )
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
-        if cfg.vocab_chunks > 0 and (
-            pp > 1 or model_cfg.moe_experts > 0
-            or dict(mesh.shape).get(SEQ_AXIS, 1) > 1
-        ):
+        if cfg.vocab_chunks > 0 and (pp > 1 or model_cfg.moe_experts > 0):
             raise NotImplementedError(
-                "--vocab_chunks is wired for the dense dp/tp path (those "
-                "branches carry their own loss functions); drop one"
+                "--vocab_chunks is wired for the dense dp/tp/sp paths (the "
+                "pipeline/MoE branches carry their own loss functions); "
+                "drop one"
             )
         if pp > 1:
             from distributed_lion_tpu.models.gpt2_pipe import (
@@ -916,9 +914,29 @@ class Trainer:
             batch_spec = P(DATA_AXIS, SEQ_AXIS)  # rows over data, tokens over seq
             from distributed_lion_tpu.models.loss import clm_loss_seq_parallel
 
-            def loss_fn(params, batch, dropout_key):
-                logits = apply_fn(params, batch, dropout_key)
-                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+            if cfg.vocab_chunks > 0:
+                # long-context x chunked-vocab: stream the tied head over
+                # vocab chunks per shard (ops/xent) — the [B, T/sp, V]
+                # logits never materialize either
+                from distributed_lion_tpu.models.gpt2 import gpt2_hidden
+                from distributed_lion_tpu.ops.xent import (
+                    chunked_clm_loss_seq_parallel,
+                )
+
+                def loss_fn(params, batch, dropout_key):
+                    hidden, _ = gpt2_hidden(params, batch, model_cfg,
+                                            dropout_key=dropout_key,
+                                            tp_axis=tp_axis,
+                                            seq_axis=SEQ_AXIS)
+                    return chunked_clm_loss_seq_parallel(
+                        hidden, params["wte"], batch, cfg.vocab_chunks,
+                        SEQ_AXIS)
+
+                loss_fn._vocab_chunked = True
+            else:
+                def loss_fn(params, batch, dropout_key):
+                    logits = apply_fn(params, batch, dropout_key)
+                    return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
 
         def apply_fn(params, tokens, dropout_key):
             return gpt2_apply(params, tokens, model_cfg, dropout_key=dropout_key,
@@ -1027,11 +1045,6 @@ class Trainer:
                 "--tp_vocab under --seq_parallel is not wired; pick one"
             )
         if seq_axis:
-            if cfg.vocab_chunks > 0:
-                raise NotImplementedError(
-                    "--vocab_chunks under --seq_parallel is not wired (the "
-                    "boundary-label exchange lives in the dense seq loss)"
-                )
             if cfg.block_size % sp:
                 raise ValueError(f"block_size {cfg.block_size} not divisible "
                                  f"by seq axis {sp}")
@@ -1042,10 +1055,26 @@ class Trainer:
                 )
             batch_spec = P(DATA_AXIS, SEQ_AXIS)
 
-            def loss_fn(params, batch, dropout_key):
-                logits = llama_apply(params, batch, model_cfg,
-                                     tp_axis=tp_axis, seq_axis=SEQ_AXIS)
-                return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
+            if cfg.vocab_chunks > 0:
+                # long-context x huge-vocab: stream the lm_head per shard
+                # (ops/xent chunked CE + the shard-boundary label ppermute)
+                from distributed_lion_tpu.ops.xent import (
+                    chunked_clm_loss_seq_parallel,
+                )
+
+                def loss_fn(params, batch, dropout_key):
+                    hidden = llama_hidden(params, batch, model_cfg,
+                                          tp_axis=tp_axis, seq_axis=SEQ_AXIS)
+                    return chunked_clm_loss_seq_parallel(
+                        hidden, params["lm_head"], batch, cfg.vocab_chunks,
+                        SEQ_AXIS, emb_layout="dv")
+
+                loss_fn._vocab_chunked = True
+            else:
+                def loss_fn(params, batch, dropout_key):
+                    logits = llama_apply(params, batch, model_cfg,
+                                         tp_axis=tp_axis, seq_axis=SEQ_AXIS)
+                    return clm_loss_seq_parallel(logits, batch, SEQ_AXIS)
 
         def apply_fn(params, tokens, dropout_key):
             del dropout_key  # our Llama (like HF's) has no dropout

@@ -403,8 +403,9 @@ def report(out_dir: str) -> None:
     for s in dict.fromkeys(show):
         d = tr_v[s] - tr_l[s]
         row = f"| {s} | {tr_l[s]:.4f} | {tr_v[s]:.4f} | {d:+.4f} |"
-        if has_lazy and s in tr_z:
-            row += f" {tr_z[s]:.4f} | {tr_z[s] - tr_l[s]:+.4f} |"
+        if has_lazy:
+            row += (f" {tr_z[s]:.4f} | {tr_z[s] - tr_l[s]:+.4f} |"
+                    if s in tr_z else " — | — |")
         lines.append(row)
     tail = [s for s in common if s >= common[-1] * 0.5]
     mad = sum(abs(tr_v[s] - tr_l[s]) for s in tail) / max(len(tail), 1)
@@ -422,8 +423,9 @@ def report(out_dir: str) -> None:
                   "|---|---|---|" + ("---|" if has_lazy else "")]
         for s in sorted(set(ev_l) & set(ev_v)):
             row = f"| {s} | {ev_l[s]:.4f} | {ev_v[s]:.4f} |"
-            if has_lazy and ev_z and s in ev_z:
-                row += f" {ev_z[s]:.4f} |"
+            if has_lazy:
+                row += (f" {ev_z[s]:.4f} |" if ev_z and s in ev_z
+                        else " — |")
             lines.append(row)
         lines.append("")
     path = os.path.join(out_dir, "REPORT.md")

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Unattended version of TPU_RUNBOOK.md: capture every missing evidence axis
+# in priority order, tolerating individual failures. Outputs land in
+# scripts/SWEEP_r3_raw/ for the operator to fold into the .md evidence files.
+set -u
+cd "$(dirname "$0")/.."
+OUT=scripts/SWEEP_r3_raw
+mkdir -p "$OUT"
+stamp() { date -u +%FT%TZ; }
+
+echo "$(stamp) runbook start" | tee -a "$OUT/log.txt"
+
+# NB: capture rc BEFORE the echo — $(stamp) inside the echo would reset $?
+timeout 2400 python scripts/bench_sweep.py \
+    noremat:4:splash:16:bf16:0 noremat:8:splash:8:bf16:0 \
+    noremat:4:xla:16:bf16:8 noremat:8:xla:8:bf16:8 \
+    noremat:4:splash:16:bf16:8 noremat:4:flash@256x512:16:bf16:0 \
+    noremat:4:flash@512x1024:16:bf16:0 noremat:4:xla:16:bf16:0:bf16 \
+    noremat:8:xla:16:bf16:8 noremat:16:xla:4:bf16:8 \
+    > "$OUT/sweep.jsonl" 2> "$OUT/sweep.err"
+rc=$?; echo "$(stamp) sweep rc=$rc" | tee -a "$OUT/log.txt"
+
+timeout 1200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
+rc=$?; echo "$(stamp) bench rc=$rc" | tee -a "$OUT/log.txt"
+
+timeout 2400 python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
+    > "$OUT/sft7b.jsonl" 2> "$OUT/sft7b.err"
+rc=$?; echo "$(stamp) 7b rc=$rc" | tee -a "$OUT/log.txt"
+
+for mode in local vote lazy; do
+  timeout 3600 python scripts/loss_parity.py --phase run --mode "$mode" \
+      --steps 2000 >> "$OUT/parity_$mode.log" 2>&1
+  rc=$?; echo "$(stamp) parity:$mode rc=$rc" | tee -a "$OUT/log.txt"
+done
+python scripts/loss_parity.py --phase report >> "$OUT/log.txt" 2>&1
+echo "$(stamp) runbook done" | tee -a "$OUT/log.txt"

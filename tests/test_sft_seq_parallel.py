@@ -134,8 +134,13 @@ def test_sft_tp_sp_trajectory_matches_pure_dp():
     np.testing.assert_allclose(l_tpsp, l_dp, rtol=2e-2, atol=2e-2)
 
 
-def test_run_sft_cli_tp_sp_smoke():
-    """CLI wiring: --tensor_parallel 2 --seq_parallel 2 (+ NF4 base) runs."""
+import pytest
+
+
+@pytest.mark.parametrize("vocab_chunks", ["0", "4"])
+def test_run_sft_cli_tp_sp_smoke(vocab_chunks):
+    """CLI wiring: --tensor_parallel 2 --seq_parallel 2 (+ NF4 base) runs,
+    with both the dense and the chunked-vocab seq head."""
     from distributed_lion_tpu.cli.run_sft import main
 
     main([
@@ -146,10 +151,13 @@ def test_run_sft_cli_tp_sp_smoke():
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
         "1000", "--tensor_parallel", "2", "--seq_parallel", "2",
         "--quant", "nf4", "--quant_block", "16",
+        "--vocab_chunks", vocab_chunks,
     ])
 
 
-def test_run_sft_cli_seq_parallel_smoke():
+@pytest.mark.parametrize("vocab_chunks", ["0", "4"])
+def test_run_sft_cli_seq_parallel_smoke(vocab_chunks):
+    """sp-only CLI: dense and chunked-vocab seq heads both run."""
     from distributed_lion_tpu.cli.run_sft import main
 
     main([
@@ -158,7 +166,7 @@ def test_run_sft_cli_seq_parallel_smoke():
         "1", "--gradient_accumulation_steps", "1", "--seq_length", "64",
         "--num_train_samples", "32", "--size_valid_set", "8",
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
-        "1000", "--seq_parallel", "4",
+        "1000", "--seq_parallel", "4", "--vocab_chunks", vocab_chunks,
     ])
 
 
@@ -244,8 +252,6 @@ def test_run_sft_sp_guards():
     ]
     with pytest.raises(NotImplementedError, match="packing"):
         main(common + ["--packing", "false"])
-    with pytest.raises(NotImplementedError, match="vocab_chunks"):
-        main(common + ["--vocab_chunks", "4"])
     with pytest.raises(ValueError, match="divide evenly"):
         # 62 stays under tiny's n_ctx (no clamp) and 62 % 4 != 0
         main([a if a != "64" else "62" for a in common])
