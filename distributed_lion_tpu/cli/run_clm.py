@@ -267,12 +267,11 @@ def main(argv=None):
     if family not in ("gpt2", "llama"):
         raise ValueError(f"unknown model family {family!r}")
     if family == "llama" and (
-        model_args.moe_experts > 0 or train_cfg.pipeline_parallel > 1
-        or train_cfg.expert_parallel > 1
+        model_args.moe_experts > 0 or train_cfg.expert_parallel > 1
     ):
         raise NotImplementedError(
-            "--model_family llama composes with dp x tp x sp; MoE and "
-            "pipeline/expert axes are wired for GPT-2 only"
+            "--model_family llama composes with dp x tp x sp x pp; MoE and "
+            "the expert axis are wired for GPT-2 only"
         )
     if family == "llama" and model_args.dropout > 0.0:
         raise ValueError("our Llama (like HF's) has no dropout; set --dropout 0")
@@ -357,10 +356,17 @@ def main(argv=None):
             trainer.save()
         if train_cfg.output_dir or model_args.hf_export:
             export = trainer.params
-            if train_cfg.pipeline_parallel > 1 and family == "gpt2":
-                from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
+            if train_cfg.pipeline_parallel > 1:
+                if family == "gpt2":
+                    from distributed_lion_tpu.models.gpt2_pipe import (
+                        unpipeline_params)
 
-                export = unpipeline_params(export, model_cfg.n_layer)
+                    export = unpipeline_params(export, model_cfg.n_layer)
+                else:
+                    from distributed_lion_tpu.models.llama_pipe import (
+                        llama_unpipeline_params)
+
+                    export = llama_unpipeline_params(export, model_cfg.n_layer)
         if train_cfg.output_dir:
             # portable single-file export (HF save_pretrained role) —
             # consumed by cli/run_generate

@@ -1,0 +1,143 @@
+"""Pipeline-parallel Llama: blocks as GPipe stages, trainable end-to-end.
+
+The Llama twin of models/gpt2_pipe.py (same generic schedule —
+parallel/pipeline.py's stacked stage params over the ``pipe`` axis,
+activations rotating via ``ppermute``, one ``lax.scan``), so ``run_clm
+--model_family llama --pipeline_parallel N`` trains with the reference's
+second architecture family split into N stages. Differences from the GPT-2
+wiring, all boundary-layer: rotary tables (cos/sin, computed once per step
+from T and closed over — identical on every stage) replace the learned
+positional embedding, RMSNorm replaces LayerNorm, and the head is the
+untied ``lm_head`` rather than the tied embedding.
+
+Gradient contract matches gpt2_pipe: stage leaves carry complete local
+grads; replicated leaves (wte / lm_head / ln_f) carry disjoint per-stage
+partials (stage 0: embedding; last stage: head + final norm) that the train
+loop psums over the pipe axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_lion_tpu.models.llama import (
+    LlamaConfig,
+    _block,
+    _block_remat_for,
+    _rms_norm,
+    rope_angles,
+)
+from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+from distributed_lion_tpu.parallel.mesh import PIPE_AXIS
+from distributed_lion_tpu.parallel.pipeline import (
+    pipeline_apply,
+    stack_stage_params,
+    unstack_stage_params,
+)
+
+
+def llama_pipeline_params(params: dict, pp: int) -> dict:
+    """Standard llama_init layout → pipeline layout with stacked stages."""
+    return {
+        "wte": params["wte"],
+        "lm_head": params["lm_head"],
+        "ln_f": params["ln_f"],
+        "stages": stack_stage_params(params["blocks"], pp),
+    }
+
+
+def llama_unpipeline_params(pparams: dict, n_layer: int) -> dict:
+    """Inverse of :func:`llama_pipeline_params` (export / generation)."""
+    return {
+        "wte": pparams["wte"],
+        "lm_head": pparams["lm_head"],
+        "ln_f": pparams["ln_f"],
+        "blocks": unstack_stage_params(pparams["stages"], n_layer),
+    }
+
+
+def llama_pipeline_param_specs() -> dict:
+    """Replicated embeddings/head/final-norm; stage leaves sharded over
+    ``pipe`` (their stacked leading dim)."""
+    rep = P()
+    stage_rms = {"scale": P(PIPE_AXIS)}
+    stages = {
+        "ln_attn": stage_rms,
+        "attn": {k: P(PIPE_AXIS) for k in ("wq", "wk", "wv", "wo")},
+        "ln_mlp": stage_rms,
+        "mlp": {k: P(PIPE_AXIS) for k in ("w_gate", "w_up", "w_down")},
+    }
+    return {"wte": rep, "lm_head": rep, "ln_f": {"scale": rep},
+            "stages": stages}
+
+
+def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
+                             axis_name: str = PIPE_AXIS):
+    """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
+    the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
+    ``tokens`` [B_local, T] with B_local divisible by ``n_micro``."""
+
+    def loss_fn(params, tokens, dropout_key):
+        del dropout_key  # Llama (like HF's) has no dropout
+        B, T = tokens.shape
+        if T > model_cfg.n_ctx:
+            raise ValueError(f"sequence length {T} exceeds n_ctx "
+                             f"{model_cfg.n_ctx}")
+        cos, sin = rope_angles(T, model_cfg.head_dim, model_cfg.rope_theta)
+        # same remat wrapper as the non-pipelined path (honors remat_policy)
+        block = _block_remat_for(model_cfg) if model_cfg.remat else _block
+
+        def layer_fn(p_layer, h):
+            return block(h, p_layer, model_cfg, cos, sin, None, None)
+
+        x = params["wte"][tokens].astype(model_cfg.compute_dtype)
+        xm = x.reshape((n_micro, B // n_micro, T, x.shape[-1]))
+        # local stage view inside shard_map keeps a leading [1] shard axis
+        stage_local = jax.tree.map(lambda a: a[0], params["stages"])
+        acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
+
+        def head_loss(acc):
+            h = acc.reshape((B, T, x.shape[-1]))
+            h = _rms_norm(h, params["ln_f"], model_cfg.rms_eps)
+            logits = jnp.einsum(
+                "btd,dv->btv", h, params["lm_head"].astype(h.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return clm_loss_and_metrics(logits, tokens)
+
+        def skip_loss(acc):
+            z = jnp.float32(0)
+            return z, {"loss": z, "accuracy": z, "n_tokens": z}
+
+        # only the last stage saw real activations (see gpt2_pipe: cond
+        # skips the vocab projection elsewhere; the psum broadcasts the
+        # value and routes zero cotangent into the skip branch)
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
+        loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
+        loss = lax.psum(loss_local, axis_name)
+        metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}
+        return loss, metrics
+
+    return loss_fn
+
+
+def validate_llama_pipeline(model_cfg: LlamaConfig, cfg, pp: int,
+                            n_micro: int) -> None:
+    """Config-time guards for ``--pipeline_parallel`` on the Llama family."""
+    if model_cfg.n_layer % pp:
+        raise ValueError(f"n_layer {model_cfg.n_layer} not divisible by "
+                         f"pipeline stages {pp}")
+    if cfg.per_device_train_batch_size % n_micro:
+        raise ValueError(
+            f"per_device_train_batch_size {cfg.per_device_train_batch_size} "
+            f"not divisible by pipeline_microbatches {n_micro}"
+        )
+    if cfg.per_device_eval_batch_size % n_micro:
+        raise ValueError(
+            f"per_device_eval_batch_size {cfg.per_device_eval_batch_size} "
+            f"not divisible by pipeline_microbatches {n_micro}"
+        )

@@ -980,8 +980,9 @@ class Trainer:
         """Full-parameter CLM training of a Llama-family model — the
         reference's run_clm is architecture-agnostic (AutoModelForCausalLM,
         run_clm.py:425-444), so ours trains Llama from scratch or from an
-        imported checkpoint too. Composes with dp, tensor (dp×tp) and
-        sequence (dp×sp) parallelism; pipe/expert axes are GPT-2-only."""
+        imported checkpoint too. Composes with dp, tensor (dp×tp), sequence
+        (dp×sp) and pipeline (dp×pp, models/llama_pipe) parallelism; the
+        expert axis is GPT-2-MoE-only."""
         from distributed_lion_tpu.models.llama import (
             llama_apply,
             llama_hidden,
@@ -993,10 +994,10 @@ class Trainer:
             validate_tp,
         )
 
-        if dict(mesh.shape).get(PIPE_AXIS, 1) > 1 or dict(mesh.shape).get(EXPERT_AXIS, 1) > 1:
+        if dict(mesh.shape).get(EXPERT_AXIS, 1) > 1:
             raise NotImplementedError(
-                "pipeline/expert mesh axes are wired for GPT-2 only; Llama "
-                "composes with dp x tp x sp"
+                "an 'expert' mesh axis is wired for GPT-2-MoE only; Llama "
+                "composes with dp x tp x sp x pp"
             )
         params = (initial_params if initial_params is not None else
                   llama_init(jax.random.key(seed if seed is not None else cfg.seed),
@@ -1006,14 +1007,42 @@ class Trainer:
                                     vote_every=cfg.vote_every,
                                     accum_steps=cfg.gradient_accumulation_steps)
         tp = mesh.shape[TENSOR_AXIS]
+        pp = dict(mesh.shape).get(PIPE_AXIS, 1)
         print(
             f"[trainer] Llama {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
-            f"tp={tp} | vote wire={cfg.wire}"
+            f"tp={tp}" + (f" pp={pp}" if pp > 1 else "") + f" | vote wire={cfg.wire}"
             + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
             + f": {acct['bits_per_param']:.2f} bits/param/step"
             + (f" | DCN leg {acct['dcn_bits_per_param']:.3f} bits/param"
                if "dcn_bits_per_param" in acct else "")
         )
+        if pp > 1:
+            from distributed_lion_tpu.models.llama_pipe import (
+                llama_pipeline_param_specs,
+                llama_pipeline_params,
+                make_llama_pipeline_loss,
+                validate_llama_pipeline,
+            )
+
+            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+                raise NotImplementedError(
+                    "pipeline parallelism composes with data parallelism "
+                    "(dp x pp); tensor/seq axes alongside pipe are not wired"
+                )
+            if cfg.vocab_chunks > 0 or cfg.tp_vocab:
+                raise NotImplementedError(
+                    "--vocab_chunks/--tp_vocab under --pipeline_parallel are "
+                    "not wired (the pipeline loss carries its own head)"
+                )
+            n_micro = cfg.pipeline_microbatches or pp
+            validate_llama_pipeline(model_cfg, cfg, pp, n_micro)
+            return Trainer(
+                cfg, mesh,
+                apply_fn=None,
+                params=llama_pipeline_params(params, pp),
+                param_specs=llama_pipeline_param_specs(),
+                loss_fn=make_llama_pipeline_loss(model_cfg, n_micro),
+            )
         if cfg.tp_vocab and tp <= 1:
             raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "
                              "shards the lm_head over the tensor axis)")

@@ -9,6 +9,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from distributed_lion_tpu.models.llama import LlamaConfig, llama_apply, llama_init
@@ -132,9 +133,6 @@ def test_sft_tp_sp_trajectory_matches_pure_dp():
     tr_tpsp.close()
     assert len(l_dp) == len(l_tpsp) > 0
     np.testing.assert_allclose(l_tpsp, l_dp, rtol=2e-2, atol=2e-2)
-
-
-import pytest
 
 
 @pytest.mark.parametrize("vocab_chunks", ["0", "4"])
