@@ -209,10 +209,33 @@ def run_inner() -> None:
                 "n_params": n_params,
                 "backend": backend,
                 "device_kind": device_kind,
+                # comm budget (BASELINE.md §2: ≤0.5 bit/param): what the
+                # flagship wire ships per step at the canonical W=4 world,
+                # and the opt-in config that meets the budget outright
+                "wire_bits_per_param": _wire_bits(n_params, accum),
             }
         ),
         flush=True,
     )
+
+
+def _wire_bits(n_params: int, accum: int) -> dict:
+    """Comm accounting extras for the bench record: the flagship wire's
+    bits/param/step at the reference's canonical W=4 world, plus the
+    budget-meeting opt-in (packed_a2a + vote_every 4, tested in
+    tests/test_vote_every.py and run at scale by scripts/loss_parity.py
+    --mode lazy)."""
+    from distributed_lion_tpu.ops.codec import wire_bytes_per_param
+
+    flagship = wire_bytes_per_param(n_params, 4, "sign_psum",
+                                    accum_steps=accum)
+    budget = wire_bytes_per_param(n_params, 4, "packed_a2a", vote_every=4,
+                                  accum_steps=accum)
+    return {
+        "flagship(sign_psum,W=4)": round(flagship["bits_per_param"], 3),
+        "budget_config(packed_a2a,vote_every=4,W=4)": round(
+            budget["bits_per_param"], 3),
+    }
 
 
 def _extract_json_line(text: str) -> dict | None:
