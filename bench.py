@@ -127,10 +127,11 @@ def run_inner() -> None:
         GPT2Config.gpt2_124m(), remat=False, attn_impl="xla",
         param_dtype=jnp.bfloat16,
     )
-    batch_per_dev = 4
+    batch_per_dev = int(os.environ.get("BENCH_BATCH", 4))
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     accum = int(os.environ.get("BENCH_ACCUM", 16))
+    vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 0))
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
@@ -144,6 +145,7 @@ def run_inner() -> None:
         steps_per_call=steps_per_call,
         logging_steps=10_000,
         output_dir=None,
+        vocab_chunks=vocab_chunks,
     )
     trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
     global_bs = trainer.global_train_batch()
@@ -192,8 +194,9 @@ def run_inner() -> None:
             {
                 "metric": f"{mfu_str}tokens/sec/chip, GPT-2 124M vote-Lion "
                 f"train step (microbatch {batch_per_dev}x{cfg.block_size}, "
-                f"accum {accum}, {n_dev} {device_kind} device(s), "
-                f"backend={backend})",
+                f"accum {accum}"
+                + (f", vocab_chunks {vocab_chunks}" if vocab_chunks else "")
+                + f", {n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 # vs_baseline is defined against the derived A100 anchor and

@@ -2,6 +2,13 @@
 # Unattended version of TPU_RUNBOOK.md: capture every missing evidence axis
 # in priority order, tolerating individual failures. Outputs land in
 # scripts/SWEEP_r3_raw/ for the operator to fold into the .md evidence files.
+#
+# Ordering rationale: bench.py FIRST — it refreshes
+# scripts/last_tpu_measurement.json within ~5 min of the tunnel recovering,
+# so even a window too short for the sweep converts the headline from
+# round-2-attested to this-round-measured. Then the sweep (new levers), a
+# re-bench under the best untested config via env knobs, then 7B and the
+# parity curves (longest).
 set -u
 cd "$(dirname "$0")/.."
 OUT=scripts/SWEEP_r3_raw
@@ -11,6 +18,9 @@ stamp() { date -u +%FT%TZ; }
 echo "$(stamp) runbook start" | tee -a "$OUT/log.txt"
 
 # NB: capture rc BEFORE the echo — $(stamp) inside the echo would reset $?
+timeout 1200 python bench.py > "$OUT/bench_flagship.json" 2> "$OUT/bench_flagship.err"
+rc=$?; echo "$(stamp) bench(flagship) rc=$rc" | tee -a "$OUT/log.txt"
+
 # splash:16 and splash:8 without chunks already measured this round
 # (61.5k / 55.6k, /tmp/sweep_r3.log) — highest-value configs first so a
 # short window still captures the vocab_chunks lever
@@ -22,8 +32,35 @@ timeout 2400 python scripts/bench_sweep.py \
     > "$OUT/sweep.jsonl" 2> "$OUT/sweep.err"
 rc=$?; echo "$(stamp) sweep rc=$rc" | tee -a "$OUT/log.txt"
 
-timeout 1200 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
-rc=$?; echo "$(stamp) bench rc=$rc" | tee -a "$OUT/log.txt"
+# re-bench under the sweep's strongest NEW lever (vocab_chunks) using the
+# env knobs — bench.py only records last_tpu_measurement.json when the run
+# beats nothing (it always overwrites); keep the flagship artifact by
+# re-running the stock config LAST if the chunked one was slower
+timeout 1200 env BENCH_VOCAB_CHUNKS=8 python bench.py \
+    > "$OUT/bench_chunks8.json" 2> "$OUT/bench_chunks8.err"
+rc=$?; echo "$(stamp) bench(chunks8) rc=$rc" | tee -a "$OUT/log.txt"
+python - "$OUT" <<'EOF'
+import json, os, sys
+out = sys.argv[1]
+def val(p):
+    try:
+        with open(p) as f:
+            d = json.load(f)
+        return d.get("value", 0) if d.get("backend") == "tpu" else 0
+    except Exception:
+        return 0
+flag, chunk = val(f"{out}/bench_flagship.json"), val(f"{out}/bench_chunks8.json")
+print(f"flagship={flag} chunks8={chunk}")
+# last_tpu_measurement.json now holds the chunks8 run; restore the better
+# record marker for the operator to promote into bench.py's default config
+best = "chunks8" if chunk >= flag else "flagship"
+with open(f"{out}/BEST.txt", "w") as f:
+    f.write(f"{best}\n")
+EOF
+if [ -f "$OUT/BEST.txt" ] && [ "$(cat "$OUT/BEST.txt")" = "flagship" ]; then
+  timeout 1200 python bench.py > "$OUT/bench_flagship2.json" 2>&1
+  echo "$(stamp) re-bench stock config to restore artifact" | tee -a "$OUT/log.txt"
+fi
 
 timeout 2400 python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
     > "$OUT/sft7b.jsonl" 2> "$OUT/sft7b.err"
