@@ -24,9 +24,10 @@ rc=$?; echo "$(stamp) bench(flagship) rc=$rc" | tee -a "$OUT/log.txt"
 # splash:16 and splash:8 without chunks already measured this round
 # (61.5k / 55.6k, /tmp/sweep_r3.log) — highest-value configs first so a
 # short window still captures the vocab_chunks lever
-timeout 2400 python scripts/bench_sweep.py \
+timeout 3000 python scripts/bench_sweep.py \
     noremat:4:xla:16:bf16:8 noremat:8:xla:8:bf16:8 \
     noremat:8:xla:16:bf16:8 noremat:16:xla:4:bf16:8 \
+    noremat:2:xla:32:bf16:8 noremat:4:xla:16:bf16:8:bf16 \
     noremat:4:xla:16:bf16:0:bf16 noremat:4:splash:16:bf16:8 \
     noremat:4:flash@256x512:16:bf16:0 noremat:4:flash@512x1024:16:bf16:0 \
     > "$OUT/sweep.jsonl" 2> "$OUT/sweep.err"
