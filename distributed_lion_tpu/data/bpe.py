@@ -79,15 +79,20 @@ class _NativeCore:
         from distributed_lion_tpu import native
 
         self._lib = native.load_bpe()
-        n = len(vocab)
+        n = 1 + max(vocab.values(), default=-1)
+        if n > 4 * max(len(vocab), 1):
+            raise ValueError("native BPE: vocab id space too sparse")
         by_id: List[Optional[str]] = [None] * n
         for t, i in vocab.items():
             if not (0 <= i < n) or by_id[i] is not None:
-                raise ValueError("native BPE needs dense, unique vocab ids")
+                raise ValueError("native BPE needs unique, non-negative "
+                                 "vocab ids")
             by_id[i] = t
         u2b = unicode_to_bytes()
 
-        def raw(tok: str) -> bytes:
+        def raw(tok: Optional[str]) -> bytes:
+            if tok is None:  # id-space hole (e.g. tokenizer.json vocab
+                return b""   # with a gap before added tokens): unreachable
             try:
                 return bytes(u2b[c] for c in tok)
             except KeyError:  # specials outside the b2u alphabet
@@ -159,10 +164,13 @@ class BPETokenizer:
             raise RuntimeError("the `regex` module is required for GPT-2 BPE")
         self.vocab = dict(vocab)
         self.ranks = {tuple(m): i for i, m in enumerate(merges)}
-        for s in (specials or [END_OF_TEXT]):
+        # specials=None → the GPT-2 default; an explicit [] means "none"
+        # (the tokenizer.json reader manages added tokens itself)
+        specials = [END_OF_TEXT] if specials is None else specials
+        for s in specials:
             if s not in self.vocab:
                 self.vocab[s] = len(self.vocab)
-        self._special_ids = {self.vocab[s] for s in (specials or [END_OF_TEXT])
+        self._special_ids = {self.vocab[s] for s in specials
                              if s in self.vocab}
         self.inv_vocab = {i: t for t, i in self.vocab.items()}
         self._pat = _re.compile(_PAT)

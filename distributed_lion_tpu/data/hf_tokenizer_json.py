@@ -29,7 +29,11 @@ import json
 import os
 from typing import Iterable, List, Optional
 
-from distributed_lion_tpu.data.bpe import bytes_to_unicode, unicode_to_bytes
+from distributed_lion_tpu.data.bpe import (
+    BPETokenizer,
+    bytes_to_unicode,
+    unicode_to_bytes,
+)
 
 try:
     import regex as _re
@@ -75,11 +79,9 @@ class TokenizerJSON:
                 "byte-level-BPE shape (Llama-3/GPT-2) which has none"
             )
         self.vocab: dict = dict(model["vocab"])
-        merges = model.get("merges") or []
-        self.ranks = {}
-        for i, m in enumerate(merges):
-            pair = tuple(m.split(" ", 1)) if isinstance(m, str) else tuple(m)
-            self.ranks[pair] = i
+        pairs = [tuple(m.split(" ", 1)) if isinstance(m, str) else tuple(m)
+                 for m in (model.get("merges") or [])]
+        self.ranks = {p: i for i, p in enumerate(pairs)}
 
         pres: List[dict] = []
         _collect_pretokenizers(spec.get("pre_tokenizer"), pres)
@@ -116,11 +118,21 @@ class TokenizerJSON:
             if at.get("special"):
                 self.special_ids.add(int(at["id"]))
             self.vocab.setdefault(at["content"], int(at["id"]))
-        self._added_sorted = sorted(self.added, key=len, reverse=True)
+        # one alternation, longest first (same-position ties go to the
+        # earlier alternative, so longest-match greediness is preserved) —
+        # NOT a per-character startswith scan over |added| tokens
+        self._added_re = _re.compile(
+            "|".join(_re.escape(t)
+                     for t in sorted(self.added, key=len, reverse=True))
+        ) if self.added else None
+        self._added_ids = set(self.added.values())
 
         self.inv_vocab = {i: t for t, i in self.vocab.items()}
         self._b2u = bytes_to_unicode()
-        self._cache: dict = {}
+        self._u2b = unicode_to_bytes()
+        # the merge loop (and its C++ native core) live in BPETokenizer;
+        # specials=[] because added tokens are handled here, before BPE
+        self._core = BPETokenizer(self.vocab, pairs, specials=[])
 
         def find(*names):
             for n in names:
@@ -151,40 +163,22 @@ class TokenizerJSON:
         return max(len(self.vocab), 1 + max(self.vocab.values(), default=0))
 
     # ------------------------------------------------------------------ codec
-    def _bpe(self, token: str) -> List[str]:
-        """Greedy lowest-rank merge loop (same procedure as data.bpe)."""
-        if token in self._cache:
-            return self._cache[token]
-        word = tuple(token)
-        while len(word) > 1:
-            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
-            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
-            if best not in self.ranks:
-                break
-            first, second = best
-            out: List[str] = []
-            i = 0
-            while i < len(word):
-                if (i < len(word) - 1 and word[i] == first
-                        and word[i + 1] == second):
-                    out.append(first + second)
-                    i += 2
-                else:
-                    out.append(word[i])
-                    i += 1
-            word = tuple(out)
-        result = list(word)
-        if len(self._cache) < 65536:
-            self._cache[token] = result
-        return result
-
     def _encode_chunk(self, text: str, ids: List[int]) -> None:
+        """Pre-tokenize with OUR pattern, merge via the shared BPETokenizer
+        machinery (C++ native core when buildable, its cached Python merge
+        loop otherwise)."""
         if not text:
             return
         pretoks = self._pat.findall(text) if self._pat else [text]
+        core = self._core._native_core()
+        if core is not None:
+            ids.extend(
+                core.encode_pretoks([t.encode("utf-8") for t in pretoks])
+                .tolist())
+            return
         for tok in pretoks:
             mapped = "".join(self._b2u[b] for b in tok.encode("utf-8"))
-            for piece in self._bpe(mapped):
+            for piece in self._core._bpe(mapped):
                 ids.append(self.vocab[piece])
 
     def encode(self, text: str, add_bos: bool = False,
@@ -193,36 +187,30 @@ class TokenizerJSON:
             text = " " + text
         ids: List[int] = [self.bos_id] if add_bos else []
         # added tokens match greedily before pre-tokenization
-        i = start = 0
-        while i < len(text):
-            for at in self._added_sorted:
-                if text.startswith(at, i):
-                    self._encode_chunk(text[start:i], ids)
-                    ids.append(self.added[at])
-                    i += len(at)
-                    start = i
-                    break
-            else:
-                i += 1
+        start = 0
+        if self._added_re is not None:
+            for m in self._added_re.finditer(text):
+                self._encode_chunk(text[start:m.start()], ids)
+                ids.append(self.added[m.group()])
+                start = m.end()
         self._encode_chunk(text[start:], ids)
         if add_eos:
             ids.append(self.eos_id)
         return ids
 
     def decode(self, ids: Iterable[int]) -> str:
-        u2b = unicode_to_bytes()
+        # NB: no prefix-space stripping — the `tokenizers` ByteLevel decoder
+        # maps chars back to bytes verbatim, so decode(encode(' x')) keeps
+        # the genuine leading space and round-trips
         parts: List[str] = []
         for i in ids:
             i = int(i)
             if i in self.special_ids or i not in self.inv_vocab:
                 continue
             tok = self.inv_vocab[i]
-            if i in self.added.values():
+            if i in self._added_ids:
                 parts.append(tok)
             else:
-                parts.append(bytes(u2b[c] for c in tok if c in u2b)
+                parts.append(bytes(self._u2b[c] for c in tok if c in self._u2b)
                              .decode("utf-8", "replace"))
-        text = "".join(parts)
-        if self._add_prefix_space and text.startswith(" "):
-            text = text[1:]
-        return text
+        return "".join(parts)

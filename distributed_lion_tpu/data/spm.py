@@ -106,17 +106,19 @@ def parse_model_proto(data: bytes) -> dict:
             for f2, wt2, v2 in _fields(v):
                 if wt2 != 0:
                     continue
+                # int32 negatives arrive 64-bit sign-extended; any of the
+                # special-token ids may be -1 (= disabled) in a valid model
+                v2s = v2 - (1 << 64) if v2 >= 1 << 63 else v2
                 if f2 == 3:
-                    out["model_type"] = v2  # 1=unigram 2=bpe
+                    out["model_type"] = v2s  # 1=unigram 2=bpe
                 elif f2 == 40:
-                    out["unk_id"] = v2
+                    out["unk_id"] = v2s
                 elif f2 == 41:
-                    out["bos_id"] = v2
+                    out["bos_id"] = v2s
                 elif f2 == 42:
-                    out["eos_id"] = v2
+                    out["eos_id"] = v2s
                 elif f2 == 43:
-                    # int32 negatives arrive 64-bit sign-extended
-                    out["pad_id"] = v2 - (1 << 64) if v2 >= 1 << 63 else v2
+                    out["pad_id"] = v2s
         elif field == 3 and wt == 2:  # NormalizerSpec
             for f3, wt3, v3 in _fields(v):
                 if f3 == 3 and wt3 == 0:
@@ -162,7 +164,8 @@ class SentencePieceTokenizer:
         self.unk_id = proto["unk_id"]
         self.bos_id = proto["bos_id"]
         self.eos_id = proto["eos_id"]
-        self.pad_id = proto["pad_id"] if proto["pad_id"] >= 0 else proto["eos_id"]
+        self.pad_id = (proto["pad_id"] if proto["pad_id"] >= 0
+                       else max(proto["eos_id"], 0))
 
     @classmethod
     def load(cls, path: str) -> "SentencePieceTokenizer":
@@ -229,14 +232,15 @@ class SentencePieceTokenizer:
         norm = text.replace(" ", _SPACE)
         if self.add_dummy_prefix and norm and not norm.startswith(_SPACE):
             norm = _SPACE + norm
-        ids: List[int] = [self.bos_id] if add_bos else []
+        # a negative id means the model disables that special token
+        ids: List[int] = [self.bos_id] if add_bos and self.bos_id >= 0 else []
         for chunk, literal in self._split_user_defined(norm):
             if literal:
                 ids.append(self._scores[chunk][1])
             else:
                 for piece in self._merge(list(chunk)):
                     self._piece_ids(piece, ids)
-        if add_eos:
+        if add_eos and self.eos_id >= 0:
             ids.append(self.eos_id)
         return ids
 

@@ -59,7 +59,7 @@ with open(f"{out}/BEST.txt", "w") as f:
     f.write(f"{best}\n")
 EOF
 if [ -f "$OUT/BEST.txt" ] && [ "$(cat "$OUT/BEST.txt")" = "flagship" ]; then
-  timeout 1200 python bench.py > "$OUT/bench_flagship2.json" 2>&1
+  timeout 1200 python bench.py > "$OUT/bench_flagship2.json" 2> "$OUT/bench_flagship2.err"
   echo "$(stamp) re-bench stock config to restore artifact" | tee -a "$OUT/log.txt"
 fi
 

@@ -135,6 +135,19 @@ def test_sp_empty_and_space_only(sp_tok):
     assert sp_tok.decode(ids) in (" ", "")  # dummy-prefix strip
 
 
+def test_sp_negative_special_ids():
+    """int32 -1 ids (disabled specials) arrive 64-bit sign-extended on the
+    wire; all four must come back as -1, not ~2^64, and encode must not
+    emit a disabled bos/eos."""
+    blob = write_model_proto(_tiny_sp_pieces(), bos_id=-1, eos_id=-1,
+                             unk_id=0, pad_id=-1)
+    tok = SentencePieceTokenizer(parse_model_proto(blob))
+    assert tok.bos_id == -1 and tok.eos_id == -1
+    assert tok.pad_id == 0  # disabled pad/eos fall back to a valid id
+    ids = tok.encode("hello", add_bos=True, add_eos=True)
+    assert all(0 <= i < tok.vocab_size for i in ids)
+
+
 # ---------------------------------------------------------- tokenizer.json
 
 SAMPLES = [
@@ -218,6 +231,32 @@ def test_tokenizer_json_added_tokens(trained_json, tmp_path):
     assert ours.encode(s) == hf.encode(s).ids
     # specials are dropped on decode
     assert "<|special|>" not in ours.decode(ours.encode(s))
+
+
+def test_tokenizer_json_prefix_space_decode_parity(trained_json, tmp_path):
+    """With ByteLevel add_prefix_space=true, decode must NOT strip a
+    genuine leading space — the tokenizers ByteLevel decoder maps chars
+    back to bytes verbatim (decode(encode(' hi')) keeps the space)."""
+    import json as _json
+
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+
+    path, _ = trained_json
+    with open(path, encoding="utf-8") as f:
+        spec = _json.load(f)
+    hf = Tokenizer.from_str(_json.dumps(spec))
+    hf.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
+    hf.decoder = decoders.ByteLevel()  # the shape real checkpoints ship
+    p2 = tmp_path / "tokenizer.json"
+    hf.save(str(p2))
+
+    from distributed_lion_tpu.data.hf_tokenizer_json import TokenizerJSON
+
+    ours = TokenizerJSON.load(str(p2))
+    for s in (" hi", "hi", "  two"):
+        assert ours.encode(s) == hf.encode(s).ids, s
+        assert ours.decode(ours.encode(s)) == hf.decode(
+            hf.encode(s).ids, skip_special_tokens=True), s
 
 
 def test_tokenizer_json_rejects_unknown_shapes(tmp_path):

@@ -95,6 +95,23 @@ def test_partial_byte_coverage_refused(tok):
     assert t._native_core() is None
 
 
+def test_native_core_tolerates_id_gaps(tok):
+    """A vocab with holes in its id space (tokenizer.json files whose added
+    tokens start past the last BPE id) still gets the native path — holes
+    lower to empty, unreachable blobs — and matches the Python merge loop
+    token-for-token."""
+    merges = [list(k) for k, _ in sorted(tok.ranks.items(),
+                                         key=lambda kv: kv[1])]
+    gapped = dict(tok.vocab)
+    gapped["<|added|>"] = max(gapped.values()) + 17  # hole before this id
+    t = BPETokenizer(gapped, merges, specials=["<|added|>"])
+    assert t._native_core() is not None
+    text = "the quick brown fox! 1234"
+    t_py = BPETokenizer(gapped, merges, specials=["<|added|>"])
+    t_py._native = False
+    assert t.encode(text) == t_py.encode(text)
+
+
 def test_jsonl_robustness(tok, tmp_path):
     """Valid-JSON non-object lines and non-string fields are skipped, not
     fatal."""
