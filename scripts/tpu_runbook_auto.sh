@@ -63,7 +63,10 @@ if [ -f "$OUT/BEST.txt" ] && [ "$(cat "$OUT/BEST.txt")" = "flagship" ]; then
   echo "$(stamp) re-bench stock config to restore artifact" | tee -a "$OUT/log.txt"
 fi
 
-timeout 2400 python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
+# third spec: long-context leg (T=2048 — the attention auto-dispatch's
+# flash regime) at the same 7B NF4 QLoRA shapes
+timeout 3000 python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
+    nf4:1:2:8::2048:dots \
     > "$OUT/sft7b.jsonl" 2> "$OUT/sft7b.err"
 rc=$?; echo "$(stamp) 7b rc=$rc" | tee -a "$OUT/log.txt"
 
