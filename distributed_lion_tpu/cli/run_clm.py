@@ -91,6 +91,31 @@ def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
                      pipe=pipeline_parallel, expert=expert_parallel)
 
 
+def _host_signature() -> str:
+    """Short hash of the host's CPU identity. The cache directory is scoped
+    by it because $HOME persists while sessions migrate across hosts —
+    XLA:CPU AOT executables compiled on one machine SIGILL/abort when
+    loaded on another with different CPU features (observed in practice:
+    a cache populated on a prior host fatally aborted later CLI runs)."""
+    import hashlib
+    import platform
+
+    ident = platform.machine()
+    seen = set()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip()
+                # model name AND flags: same model can expose different
+                # feature sets under different hypervisors/microcode
+                if key in ("flags", "model name", "Features") and key not in seen:
+                    seen.add(key)
+                    ident += line
+    except OSError:
+        pass
+    return hashlib.sha1(ident.encode()).hexdigest()[:10]
+
+
 def enable_compilation_cache() -> None:
     """Persistent XLA compilation cache (~20-40s per TPU compile amortized
     across runs). Opt-out with DLION_COMPILE_CACHE=0; directory override via
@@ -101,7 +126,8 @@ def enable_compilation_cache() -> None:
         return
     cache_dir = os.environ.get(
         "DLION_COMPILE_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "dlion_xla"),
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     f"dlion_xla_{_host_signature()}"),
     )
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
