@@ -72,6 +72,7 @@ def _record_tpu_measurement(result: dict) -> None:
     try:
         with open(LAST_TPU_ARTIFACT, "w") as f:
             json.dump(rec, f, indent=1)
+            f.write("\n")
     except OSError:
         pass
 
@@ -132,6 +133,10 @@ def run_inner() -> None:
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     accum = int(os.environ.get("BENCH_ACCUM", 16))
     vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 0))
+    mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "")
+    attn_impl = os.environ.get("BENCH_ATTN", "xla")
+    if attn_impl != "xla":
+        model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
@@ -146,6 +151,7 @@ def run_inner() -> None:
         logging_steps=10_000,
         output_dir=None,
         vocab_chunks=vocab_chunks,
+        mom_dtype=mom_dtype,
     )
     trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
     global_bs = trainer.global_train_batch()
@@ -196,6 +202,8 @@ def run_inner() -> None:
                 f"train step (microbatch {batch_per_dev}x{cfg.block_size}, "
                 f"accum {accum}"
                 + (f", vocab_chunks {vocab_chunks}" if vocab_chunks else "")
+                + (f", mom_dtype {mom_dtype}" if mom_dtype else "")
+                + (f", attn {attn_impl}" if attn_impl != "xla" else "")
                 + f", {n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
@@ -272,7 +280,11 @@ def main() -> None:
         ("default", min(timeout_s, 300.0), {}),
         ("cpu", timeout_s,
          {"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1",
-          "BENCH_STEPS": "1", "BENCH_CALLS": "1", "BENCH_ACCUM": "1"}),
+          "BENCH_STEPS": "1", "BENCH_CALLS": "1", "BENCH_ACCUM": "1",
+          # reset perf knobs too: a TPU-only attn impl, typo'd dtype, or
+          # malformed int must not take down the evidence-of-life attempt
+          "BENCH_ATTN": "xla", "BENCH_MOM_DTYPE": "",
+          "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4"}),
     )
     errors: list[str] = []
     for label, budget, env_extra in attempts:

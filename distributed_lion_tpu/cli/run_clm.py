@@ -119,7 +119,15 @@ def _host_signature() -> str:
 def enable_compilation_cache() -> None:
     """Persistent XLA compilation cache (~20-40s per TPU compile amortized
     across runs). Opt-out with DLION_COMPILE_CACHE=0; directory override via
-    DLION_COMPILE_CACHE_DIR."""
+    DLION_COMPILE_CACHE_DIR.
+
+    The directory is host-scoped (per-CPU-signature suffix) because XLA:CPU
+    AOT cache entries compiled on one host fatally abort the process when
+    loaded on a host with different CPU features. Trade-off, accepted: a
+    host migration also cold-starts the TPU entries (a ~20-40s recompile,
+    vs a crash) and superseded per-host dirs linger under ~/.cache until
+    cleaned; pin DLION_COMPILE_CACHE_DIR to share a cache across known-
+    identical hosts."""
     import jax
 
     if os.environ.get("DLION_COMPILE_CACHE", "1") == "0":

@@ -42,7 +42,7 @@ class GPT2Config:
     d_model: int = 768
     n_ctx: int = 1024
     dropout: float = 0.0
-    attn_impl: str = "auto"  # ops.attention: auto | xla | flash
+    attn_impl: str = "auto"  # ops.attention: auto | xla | xla_bf16 | flash | splash
     flash_block_q: int = 0   # flash kernel tile overrides (0 = defaults);
     flash_block_kv: int = 0  # see ops.attention.attention_flash
     seq_impl: str = "ring"   # sequence-parallel attention: 'ring' (k/v

@@ -5,9 +5,15 @@
   FASTER than the flash kernel at T=1024 on a real v5e chip (82.3k vs 59.2k
   tokens/s/chip on the GPT-2 124M train step; scripts/SWEEP_v5e.md records
   the sweep) — so it is the default below the ``auto`` threshold.
+- ``xla_bf16`` — ``xla`` with the [B,H,T,T] scores stored in bf16 (softmax
+  still f32 internally): halves the largest attention intermediate's HBM
+  round-trip at ~1e-2 relative error on probs. Opt-in throughput config.
 - ``flash`` — Pallas TPU flash attention (jax's bundled
   ``pallas.ops.tpu.flash_attention``): O(T) memory online-softmax blocking,
   the choice for long sequences where [B,H,T,T] scores would blow HBM.
+- ``splash`` — the newer Pallas TPU splash kernel family (sparse-mask
+  blocking); faster than ``flash`` at moderate T but still behind ``xla``
+  at T=1024 on v5e (scripts/SWEEP_v5e.md).
 - ``auto``  — flash on TPU for T ≥ 2048, else xla.
 
 All take q, k, v as [B, H, T, head_dim] and return [B, H, T, head_dim] in
@@ -22,15 +28,29 @@ import jax
 import jax.numpy as jnp
 
 
-def attention_xla(q, k, v, *, causal: bool = True):
+def attention_xla(q, k, v, *, causal: bool = True,
+                  score_dtype=jnp.float32):
+    """Materialized-scores attention. ``score_dtype=jnp.bfloat16`` is the
+    ``xla_bf16`` impl: the [B, H, T, T] scores tensor — the largest
+    attention intermediate (201 MB/layer at mb4 T=1024 in f32) and pure HBM
+    traffic between the two matmuls — is stored in bf16, halving its
+    round-trip. The softmax always runs in f32 (the upcast fuses into the
+    softmax elementwise chain, costing registers, not HBM), so only the one
+    rounding of the scores differs; max-subtraction bounds the exponent so
+    bf16's 8 mantissa bits cost ~1e-2 relative on probs — an opt-in
+    throughput config, not the parity default."""
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores * scale
+    # accumulate in f32 regardless of score_dtype; only the STORED scores
+    # are rounded (the cast fuses into the matmul/mask epilogue, so the
+    # HBM write is score_dtype-wide) — rounding is the only delta vs f32
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = (scores * scale).astype(score_dtype)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
-        scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, score_dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v, preferred_element_type=jnp.float32)
     return out.astype(q.dtype)
 
@@ -105,4 +125,7 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
                                 block_q=block_q, block_kv=block_kv)
     if impl == "xla":
         return attention_xla(q, k, v, causal=causal)
+    if impl == "xla_bf16":
+        return attention_xla(q, k, v, causal=causal,
+                             score_dtype=jnp.bfloat16)
     raise ValueError(f"unknown attention impl {impl!r}")
