@@ -134,9 +134,14 @@ def run_inner() -> None:
     accum = int(os.environ.get("BENCH_ACCUM", 16))
     vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 0))
     mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "")
-    attn_impl = os.environ.get("BENCH_ATTN", "xla")
-    if attn_impl != "xla":
-        model_cfg = dataclasses.replace(model_cfg, attn_impl=attn_impl)
+    attn_spec = os.environ.get("BENCH_ATTN", "xla")
+    from distributed_lion_tpu.ops.attention import parse_attn_spec
+
+    attn_impl, bq, bkv = parse_attn_spec(attn_spec)
+    if attn_spec != "xla":
+        model_cfg = dataclasses.replace(
+            model_cfg, attn_impl=attn_impl,
+            flash_block_q=bq, flash_block_kv=bkv)
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
@@ -203,7 +208,7 @@ def run_inner() -> None:
                 f"accum {accum}"
                 + (f", vocab_chunks {vocab_chunks}" if vocab_chunks else "")
                 + (f", mom_dtype {mom_dtype}" if mom_dtype else "")
-                + (f", attn {attn_impl}" if attn_impl != "xla" else "")
+                + (f", attn {attn_spec}" if attn_spec != "xla" else "")
                 + f", {n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",

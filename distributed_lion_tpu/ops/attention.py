@@ -1,10 +1,12 @@
 """Attention implementations with a single dispatch point.
 
 - ``xla``   — materialized-scores reference: einsum → masked f32 softmax →
-  einsum. XLA's fusion is already MXU-optimal at moderate T — measured ~1.4x
-  FASTER than the flash kernel at T=1024 on a real v5e chip (82.3k vs 59.2k
-  tokens/s/chip on the GPT-2 124M train step; scripts/SWEEP_v5e.md records
-  the sweep) — so it is the default below the ``auto`` threshold.
+  einsum. Beats the flash kernel's DEFAULT tiles at T=1024 on v5e (82.3k vs
+  59.2k tokens/s/chip, GPT-2 124M train step) — but tile-TUNED flash
+  (``flash_block_q=512, flash_block_kv=1024``) beats xla by ~12% at the
+  same shape (92.2k; scripts/SWEEP_v5e.md round-3 sweep). ``xla`` stays the
+  ``auto`` default below the threshold because the tuned tiles are a
+  per-shape measurement, not a safe generalization.
 - ``xla_bf16`` — ``xla`` with the [B,H,T,T] scores stored in bf16 (softmax
   still f32 internally): halves the largest attention intermediate's HBM
   round-trip at ~1e-2 relative error on probs. Opt-in throughput config.
@@ -111,6 +113,19 @@ def attention_splash(q, k, v, *, causal: bool = True,
     qs = (q * (1.0 / math.sqrt(hd))).astype(q.dtype)
     out = jax.vmap(kernel)(qs, k, v)
     return out.astype(q.dtype)
+
+
+def parse_attn_spec(spec: str) -> tuple[str, int, int]:
+    """Parse an attention spec string ``impl[@BQxBKV]`` into
+    ``(impl, block_q, block_kv)`` — e.g. ``"flash@512x1024"`` →
+    ``("flash", 512, 1024)``; no ``@`` → blocks 0 (kernel defaults).
+    The one grammar shared by bench.py's BENCH_ATTN env knob and
+    scripts/bench_sweep.py's config specs."""
+    if "@" not in spec:
+        return spec, 0, 0
+    impl, blocks = spec.split("@", 1)
+    bq, bkv = (int(x) for x in blocks.split("x"))
+    return impl, bq, bkv
 
 
 def attention(q, k, v, *, causal: bool = True, impl: str = "auto",

@@ -36,12 +36,10 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
 
     n_dev = len(jax.devices())
     mesh = make_mesh()
-    # attn spec "flash@256x512" → flash with block_q=256, block_kv=512
+    from distributed_lion_tpu.ops.attention import parse_attn_spec
+
     attn_spec = attn_impl
-    bq = bkv = 0
-    if "@" in attn_impl:
-        attn_impl, blocks = attn_impl.split("@", 1)
-        bq, bkv = (int(x) for x in blocks.split("x"))
+    attn_impl, bq, bkv = parse_attn_spec(attn_spec)
     model_cfg = dataclasses.replace(
         GPT2Config.gpt2_124m(), remat=remat != "noremat",
         remat_policy="dots" if remat == "dots" else "full",
