@@ -22,12 +22,16 @@ ONE device program (Trainer._train_chunk, lax.scan over staged batches), and
 the timer stops only after a device_get of the final chunk's loss — a value
 data-dependent on every step — so queued-but-unexecuted work can't inflate
 the number (remote/tunneled backends ack dispatch long before execution).
-Config picked by scripts/bench_sweep.py on v5e: remat off (124M activations
-fit HBM), XLA attention (beats Pallas flash at T=1024), bf16 params (the
-reference's canonical bf16 config), microbatch 4 with 16-step grad
-accumulation — small microbatches keep the f32 attention-score traffic per
-pass low while accumulation amortizes the optimizer's full-pytree
-ballot/vote/apply passes over 16x the tokens.
+Config picked by scripts/bench_sweep.py on v5e (SWEEP_v5e.md): remat off
+(124M activations fit HBM), bf16 params (the reference's canonical bf16
+config), microbatch 4 with 16-step grad accumulation — small microbatches
+keep attention-score traffic per pass low while accumulation amortizes the
+optimizer's full-pytree ballot/vote/apply passes over 16x the tokens —
+and chunked-vocab CE (vocab_chunks 8: the round-3 sweep measured the
+streaming logsumexp beating the dense [B,T,V] f32 logits round-trip by
+~2-6% across attention impls; bench.py itself recorded 85.7k tok/s, MFU
+37.4% under it). Attention impl default stays xla pending the tuned-tile
+flash combination sweep (flash@512x1024 alone measured +12%).
 
 MFU = achieved model FLOP/s / chip peak bf16 FLOP/s, with model FLOPs/token =
 6N + 12*L*d*T (fwd+bwd, PaLM appendix-B convention, attention included,
@@ -132,7 +136,7 @@ def run_inner() -> None:
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     accum = int(os.environ.get("BENCH_ACCUM", 16))
-    vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 0))
+    vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 8))
     mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "")
     attn_spec = os.environ.get("BENCH_ATTN", "xla")
     from distributed_lion_tpu.ops.attention import parse_attn_spec

@@ -57,10 +57,36 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         vocab_chunks=vocab_chunks,
     )
 
-    base = llama_init(jax.random.key(0), model_cfg)
-    n_base = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(base))
-    if quant != "none":
-        base = quantize_tree(base, quant)
+    # Init + quantize the frozen base ON HOST CPU, then ship only the packed
+    # codes: a 7B f32 base is 26 GB — bigger than the whole v5e chip — so
+    # on-device init OOMs (or crawls through the tunnel) before quantization
+    # can shrink it. Host RAM holds it easily; the device only ever sees the
+    # ~3.5 GB NF4 codes (+ small dense leaves). Throughput/memory don't
+    # care about weight values (random init either way).
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        # quant "nf4"/"int8" → packed codes from a bf16 host init (absmax
+        # at bf16 precision is irrelevant for a random-init throughput
+        # bench); "bf16" → DENSE bf16 base (13 GB at 7B — fits the chip);
+        # "none" → dense base in the config's own param_dtype (f32: 26 GB,
+        # only viable with an n_layer override on one chip)
+        dense = quant in ("none", "bf16")
+        base_dtype = model_cfg.param_dtype if quant == "none" else jnp.bfloat16
+        host_cfg = _dc.replace(model_cfg, param_dtype=base_dtype)
+        base = llama_init(jax.random.key(0), host_cfg)
+        n_base = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(base))
+        if not dense:
+            base = quantize_tree(base, quant)
+    # explicit target: device_put(x) with no device is the identity for
+    # committed arrays, which would leave the base host-resident; a
+    # replicated sharding (not devices()[0]) keeps the multi-device path
+    # working — every chip holds the frozen base, batches shard over data
+    base = jax.device_put(
+        base, NamedSharding(mesh, P()))
     lora_cfg = LoraConfig(r=8, alpha=16)
     adapters = lora_init(jax.random.key(1), base, lora_cfg)
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
@@ -130,8 +156,13 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
 
 if __name__ == "__main__":
     specs = sys.argv[1:] or ["nf4:1:4:8"]
+    DEFAULTS = ["nf4", "1", "4", "8", "", "1024", "full"]
     for spec in specs:
-        parts = (spec.split(":") + ["1", "4", "8", "", "1024", "full"])[:7]
+        parts = spec.split(":")
+        # pad with the defaults for the MISSING tail fields only (a plain
+        # `parts + DEFAULTS` would splice the default list in positionally:
+        # "nf4:1:4:8" must mean full-depth T=1024, not n_layer=1 seq=4)
+        parts = (parts + DEFAULTS[len(parts):])[:7]
         quant, bs, accum, vc, nl, sl, pol = parts
         try:
             run(quant, int(bs), int(accum), int(vc or 0),
