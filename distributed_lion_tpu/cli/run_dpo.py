@@ -151,6 +151,23 @@ def main(argv=None):
         )
         adapters = lora_init(jax.random.key(train_cfg.seed + 1), base_params, lora_cfg)
 
+    vc = train_cfg.vocab_chunks
+    if vc > 0 and train_cfg.tensor_parallel > 1:
+        raise NotImplementedError(
+            "--vocab_chunks x --tensor_parallel on the DPO path is not "
+            "wired (the TP head is already vocab-sharded; chunking it "
+            "again buys nothing) — drop one"
+        )
+
+    def _hidden_and_head(params, tokens, **kw):
+        # chunked-vocab scoring contract: (hidden, head) instead of logits;
+        # train/dpo streams the label logprobs through ops/xent
+        from distributed_lion_tpu.models.llama import llama_hidden
+        from distributed_lion_tpu.ops.quant import maybe_dequant
+
+        return (llama_hidden(params, tokens, model_cfg, **kw),
+                maybe_dequant(params["lm_head"], model_cfg.compute_dtype))
+
     tp = train_cfg.tensor_parallel
     frozen_params = frozen_specs = None
     if tp > 1:
@@ -193,26 +210,35 @@ def main(argv=None):
         # psum'd before the pairwise sigmoid (train/dpo.py)
         from distributed_lion_tpu.parallel.mesh import SEQ_AXIS
 
-        policy_apply_lora = lora_apply_fn(
-            lambda p, t: llama_apply(p, t, model_cfg, seq_axis=SEQ_AXIS),
-            base_params, lora_cfg,
-        )
+        if vc > 0:
+            base_fwd = lambda p, t: _hidden_and_head(p, t, seq_axis=SEQ_AXIS)  # noqa: E731
+            ref_fwd = lambda t: _hidden_and_head(ref_params, t, seq_axis=SEQ_AXIS)  # noqa: E731
+        else:
+            base_fwd = lambda p, t: llama_apply(p, t, model_cfg, seq_axis=SEQ_AXIS)  # noqa: E731
+            ref_fwd = lambda t: llama_apply(ref_params, t, model_cfg,
+                                            seq_axis=SEQ_AXIS)  # noqa: E731
+        policy_apply_lora = lora_apply_fn(base_fwd, base_params, lora_cfg)
         loss_fn = make_dpo_loss_fn(
             policy_apply=policy_apply_lora,
-            ref_apply=lambda t: llama_apply(ref_params, t, model_cfg,
-                                            seq_axis=SEQ_AXIS),
+            ref_apply=ref_fwd,
             beta=script_args.beta,
             seq_axis=SEQ_AXIS,
+            vocab_chunks=vc,
         )
         adapter_specs = None
     else:
-        policy_apply_lora = lora_apply_fn(
-            lambda p, t: llama_apply(p, t, model_cfg), base_params, lora_cfg
-        )
+        if vc > 0:
+            base_fwd = _hidden_and_head
+            ref_fwd = lambda t: _hidden_and_head(ref_params, t)  # noqa: E731
+        else:
+            base_fwd = lambda p, t: llama_apply(p, t, model_cfg)  # noqa: E731
+            ref_fwd = lambda t: llama_apply(ref_params, t, model_cfg)  # noqa: E731
+        policy_apply_lora = lora_apply_fn(base_fwd, base_params, lora_cfg)
         loss_fn = make_dpo_loss_fn(
             policy_apply=policy_apply_lora,
-            ref_apply=lambda t: llama_apply(ref_params, t, model_cfg),
+            ref_apply=ref_fwd,
             beta=script_args.beta,
+            vocab_chunks=vc,
         )
         adapter_specs = None
 

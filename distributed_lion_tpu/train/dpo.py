@@ -63,6 +63,54 @@ def sequence_logprob_seq_parallel(
     return reduce_from_tp_region((ll * lmask).sum(-1), axis_name)
 
 
+def sequence_logprob_chunked(
+    hidden: jnp.ndarray, head: jnp.ndarray, tokens: jnp.ndarray,
+    mask: jnp.ndarray, n_chunks: int, emb_layout: str = "dv",
+) -> jnp.ndarray:
+    """:func:`sequence_logprob` from HIDDEN STATES via the streaming
+    chunked-vocab logsumexp (ops/xent.chunked_softmax_xent): per-position
+    label logprob is −nll, so the [B, T, V] f32 ``log_softmax`` — ~1.3 GB
+    per microbatch pass at Llama vocab 32k, and DPO runs FOUR such passes
+    (policy/ref × chosen/rejected) — is never materialized. Exact same
+    math (pinned by tests/test_dpo_chunked.py)."""
+    from distributed_lion_tpu.ops.xent import chunked_softmax_xent
+
+    b, t, d = hidden.shape
+    h = hidden[:, :-1].reshape(b * (t - 1), d)
+    labels = tokens[:, 1:].reshape(-1).astype(jnp.int32)
+    nll, _ = chunked_softmax_xent(h, head, labels, n_chunks, emb_layout)
+    ll = -nll.reshape(b, t - 1)
+    return (ll * mask[:, 1:].astype(jnp.float32)).sum(-1)
+
+
+def sequence_logprob_chunked_seq_parallel(
+    hidden: jnp.ndarray, head: jnp.ndarray, tokens: jnp.ndarray,
+    mask: jnp.ndarray, axis_name: str, n_chunks: int,
+    emb_layout: str = "dv",
+) -> jnp.ndarray:
+    """Chunked × sequence-parallel :func:`sequence_logprob`: the boundary
+    protocol of :func:`sequence_logprob_seq_parallel` (labels and their
+    mask bits ppermute in from the next shard; final shard's last position
+    dropped) with the local shard's label logprobs computed by the
+    streaming chunked logsumexp instead of a materialized log_softmax."""
+    from distributed_lion_tpu.models.loss import shift_in_next_shard
+    from distributed_lion_tpu.ops.xent import chunked_softmax_xent
+    from distributed_lion_tpu.parallel.tensor_parallel import reduce_from_tp_region
+
+    labels, is_last = shift_in_next_shard(tokens, axis_name)
+    lmask, _ = shift_in_next_shard(mask, axis_name)
+    lmask = lmask.astype(jnp.float32)
+    lmask = lmask.at[:, -1].set(jnp.where(is_last, 0.0, lmask[:, -1]))
+    b, t, d = hidden.shape
+    nll, _ = chunked_softmax_xent(
+        hidden.reshape(b * t, d), head,
+        labels.reshape(-1).astype(jnp.int32), n_chunks, emb_layout)
+    ll = -nll.reshape(b, t)
+    # replicated consumer ⇒ Megatron g-operator exit (identity backward),
+    # same rationale as sequence_logprob_seq_parallel
+    return reduce_from_tp_region((ll * lmask).sum(-1), axis_name)
+
+
 def _accepts_dropout_key(fn: Callable) -> bool:
     """True when ``fn`` can take a ``dropout_key`` keyword (LoRA adapter
     dropout); plain ``(params, tokens)`` callables keep their signature."""
@@ -81,18 +129,38 @@ def make_dpo_loss_fn(
     ref_apply: Callable,
     beta: float = 0.1,
     seq_axis: str | None = None,
+    vocab_chunks: int = 0,
+    emb_layout: str = "dv",
 ) -> Callable:
     """Build ``loss_fn(params, batch, dropout_key) -> (loss, metrics)`` for
     the Trainer. ``policy_apply(params, tokens)`` and ``ref_apply(tokens)``
     (ref params are frozen/closed-over, mirroring the reference's separate
     4-bit ref model, dpo_llama2.py:146-152). With ``seq_axis``, the batch
     leaves are token-sharded chunks and the apply fns are expected to run
-    the model with the same seq axis (ring attention)."""
+    the model with the same seq axis (ring attention). With
+    ``vocab_chunks > 0``, the apply fns must return ``(hidden, head)``
+    instead of logits and the logprobs stream through the chunked-vocab
+    logsumexp (no [B, T, V] materialization — DPO's four scoring passes
+    make this the biggest activation saving of any workload)."""
 
-    def seqlp(logits, tokens, mask):
+    def seqlp(out, tokens, mask):
+        if vocab_chunks > 0:
+            if not (isinstance(out, tuple) and len(out) == 2):
+                # a [B,T,V] logits array would silently unpack along batch
+                raise TypeError(
+                    "vocab_chunks > 0 requires apply fns returning "
+                    "(hidden, head); got a single array — wire the hidden/"
+                    "head forward (see cli/run_dpo._hidden_and_head)")
+            hidden, head = out
+            if seq_axis is None:
+                return sequence_logprob_chunked(
+                    hidden, head, tokens, mask, vocab_chunks, emb_layout)
+            return sequence_logprob_chunked_seq_parallel(
+                hidden, head, tokens, mask, seq_axis, vocab_chunks,
+                emb_layout)
         if seq_axis is None:
-            return sequence_logprob(logits, tokens, mask)
-        return sequence_logprob_seq_parallel(logits, tokens, mask, seq_axis)
+            return sequence_logprob(out, tokens, mask)
+        return sequence_logprob_seq_parallel(out, tokens, mask, seq_axis)
 
     _accepts_key = _accepts_dropout_key(policy_apply)
 
@@ -130,6 +198,7 @@ def make_dpo_loss_fn(
         }
         return loss, metrics
 
+    loss_fn._vocab_chunked = vocab_chunks > 0  # Trainer guard handshake
     return loss_fn
 
 

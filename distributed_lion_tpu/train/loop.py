@@ -266,12 +266,13 @@ class Trainer:
         if (cfg.vocab_chunks > 0 and loss_fn is not None
                 and not getattr(loss_fn, "_vocab_chunked", False)):
             # vocab_chunks is consumed by losses that opt in (for_gpt2's
-            # dense path, run_sft's SFT losses — marked _vocab_chunked); any
-            # other caller-supplied loss would silently ignore the flag,
-            # e.g. run_dpo, whose CLI auto-exposes it via TrainConfig.
+            # dense path, run_sft's SFT losses, run_dpo's chunked scoring —
+            # marked _vocab_chunked); any other caller-supplied loss would
+            # silently ignore the CLI-auto-exposed flag.
             raise NotImplementedError(
                 "--vocab_chunks is not wired into this entry point's loss "
-                "function (supported: run_clm's dense dp/tp path, run_sft)"
+                "function (supported: run_clm's dense dp/tp path, run_sft, "
+                "run_dpo)"
             )
         if cfg.tp_vocab and not getattr(loss_fn, "_tp_vocab", False):
             # same silent-ignore trap as vocab_chunks: the flag is

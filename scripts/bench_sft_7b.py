@@ -63,12 +63,23 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
     # can shrink it. Host RAM holds it easily; the device only ever sees the
     # ~3.5 GB NF4 codes (+ small dense leaves). Throughput/memory don't
     # care about weight values (random init either way).
+    import contextlib
     import dataclasses as _dc
 
     import jax.numpy as jnp
 
-    cpu = jax.local_devices(backend="cpu")[0]
-    with jax.default_device(cpu):
+    try:
+        # needs "cpu" in JAX_PLATFORMS (the runbook exports "axon,cpu";
+        # the axon env's default is axon-only)
+        cpu = jax.local_devices(backend="cpu")[0]
+        ctx = jax.default_device(cpu)
+    except RuntimeError:
+        # no host backend exposed: init on device — bf16 keeps the dense
+        # tree at 13 GB (fits one v5e chip; the per-leaf quantize peak adds
+        # only the largest single leaf's codes)
+        cpu = None
+        ctx = contextlib.nullcontext()
+    with ctx:
         # quant "nf4"/"int8" → packed codes from a bf16 host init (absmax
         # at bf16 precision is irrelevant for a random-init throughput
         # bench); "bf16" → DENSE bf16 base (13 GB at 7B — fits the chip);

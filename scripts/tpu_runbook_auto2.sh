@@ -89,8 +89,11 @@ else:
 EOF
 fi
 
-# 7B QLoRA evidence with the FIXED spec parser + host-side init
-timeout 3000 python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
+# 7B QLoRA evidence with the FIXED spec parser + host-side init (the
+# "axon,cpu" platform list exposes the host backend the init path uses;
+# axon stays first = default, so compute still runs on the chip)
+timeout 3000 env JAX_PLATFORMS=axon,cpu \
+    python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
     nf4:1:2:8::2048:dots \
     > "$OUT/sft7b2.jsonl" 2> "$OUT/sft7b2.err"
 rc=$?; echo "$(stamp) 7b(fixed) rc=$rc" | tee -a "$OUT/log.txt"
