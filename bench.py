@@ -27,11 +27,12 @@ Config picked by scripts/bench_sweep.py on v5e (SWEEP_v5e.md): remat off
 config), microbatch 4 with 16-step grad accumulation — small microbatches
 keep attention-score traffic per pass low while accumulation amortizes the
 optimizer's full-pytree ballot/vote/apply passes over 16x the tokens —
-and chunked-vocab CE (vocab_chunks 8: the round-3 sweep measured the
-streaming logsumexp beating the dense [B,T,V] f32 logits round-trip by
-~2-6% across attention impls; bench.py itself recorded 85.7k tok/s, MFU
-37.4% under it). Attention impl default stays xla pending the tuned-tile
-flash combination sweep (flash@512x1024 alone measured +12%).
+chunked-vocab CE (vocab_chunks 8: the streaming logsumexp kills the dense
+[B,T,V] f32 logits round-trip), tile-tuned Pallas flash attention
+(flash@512x1024 — the stock tiles LOSE to xla at T=1024, tuned tiles win),
+and bf16 Lion momentum. The round-3 sweep measured the combination at
+98,099 tokens/s/chip (~42.8% MFU) vs 82.8k for the round-2 xla/f32-momentum
+config (scripts/SWEEP_r3_raw/sweep2.jsonl).
 
 MFU = achieved model FLOP/s / chip peak bf16 FLOP/s, with model FLOPs/token =
 6N + 12*L*d*T (fwd+bwd, PaLM appendix-B convention, attention included,
@@ -137,8 +138,8 @@ def run_inner() -> None:
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     accum = int(os.environ.get("BENCH_ACCUM", 16))
     vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 8))
-    mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "")
-    attn_spec = os.environ.get("BENCH_ATTN", "xla")
+    mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "bfloat16")
+    attn_spec = os.environ.get("BENCH_ATTN", "flash@512x1024")
     from distributed_lion_tpu.ops.attention import parse_attn_spec
 
     attn_impl, bq, bkv = parse_attn_spec(attn_spec)

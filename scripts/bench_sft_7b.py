@@ -77,7 +77,6 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         # no host backend exposed: init on device — bf16 keeps the dense
         # tree at 13 GB (fits one v5e chip; the per-leaf quantize peak adds
         # only the largest single leaf's codes)
-        cpu = None
         ctx = contextlib.nullcontext()
     with ctx:
         # quant "nf4"/"int8" → packed codes from a bf16 host init (absmax
