@@ -140,6 +140,10 @@ def run_inner() -> None:
     vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 8))
     mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "bfloat16")
     attn_spec = os.environ.get("BENCH_ATTN", "flash@512x1024")
+    vocab_pad = int(os.environ.get("BENCH_VOCAB_PAD", 0))
+    if vocab_pad:
+        model_cfg = dataclasses.replace(model_cfg,
+                                        vocab_pad_multiple=vocab_pad)
     from distributed_lion_tpu.ops.attention import parse_attn_spec
 
     attn_impl, bq, bkv = parse_attn_spec(attn_spec)
@@ -167,6 +171,11 @@ def run_inner() -> None:
     global_bs = trainer.global_train_batch()
     tokens_per_step = global_bs * cfg.block_size
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(trainer.params))
+    # MFU honesty under a padded-vocab layout: the chip executes the pad
+    # columns' FLOPs, but they are not useful model work — count only the
+    # true-vocab parameters in the 6N model-FLOPs term
+    n_pad = (model_cfg.padded_vocab - model_cfg.vocab_size) * model_cfg.d_model
+    n_params -= n_pad
 
     blocks = synthetic_lm_dataset(
         global_bs * steps_per_call, cfg.block_size, model_cfg.vocab_size, seed=0
@@ -214,6 +223,7 @@ def run_inner() -> None:
                 + (f", vocab_chunks {vocab_chunks}" if vocab_chunks else "")
                 + (f", mom_dtype {mom_dtype}" if mom_dtype else "")
                 + (f", attn {attn_spec}" if attn_spec != "xla" else "")
+                + (f", vocab_pad {vocab_pad}" if vocab_pad else "")
                 + f", {n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
@@ -294,7 +304,8 @@ def main() -> None:
           # reset perf knobs too: a TPU-only attn impl, typo'd dtype, or
           # malformed int must not take down the evidence-of-life attempt
           "BENCH_ATTN": "xla", "BENCH_MOM_DTYPE": "",
-          "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4"}),
+          "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4",
+          "BENCH_VOCAB_PAD": "0"}),
     )
     errors: list[str] = []
     for label, budget, env_extra in attempts:

@@ -60,6 +60,10 @@ class ModelArguments:
     moe_experts: int = 0  # > 0: Switch-MoE FFN every moe_every-th block
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    vocab_pad_multiple: int = 0  # gpt2 only: round the embedding-table rows
+    # up to this multiple (e.g. 1024 → 50257 becomes 51200) so the tied
+    # head / chunked-CE slices are MXU-tile-aligned and --tp_vocab shards
+    # evenly; loss/generation semantics are exact (models/gpt2)
 
 
 @dataclasses.dataclass
@@ -287,6 +291,7 @@ def main(argv=None):
         moe_experts=model_args.moe_experts,
         moe_every=model_args.moe_every,
         moe_capacity_factor=model_args.moe_capacity_factor,
+        vocab_pad_multiple=model_args.vocab_pad_multiple,
     )
     family = model_args.model_family
     if model_args.model_path:
@@ -309,6 +314,11 @@ def main(argv=None):
         )
     if family == "llama" and model_args.dropout > 0.0:
         raise ValueError("our Llama (like HF's) has no dropout; set --dropout 0")
+    if family == "llama" and model_args.vocab_pad_multiple:
+        raise ValueError(
+            "--vocab_pad_multiple is a GPT-2 layout option; Llama vocabs "
+            "(32000/128256) are already 128-multiples"
+        )
     initial_params = None
     if model_args.model_path:
         if family == "llama":
@@ -330,6 +340,14 @@ def main(argv=None):
             )
         print(f"[run_clm] loaded pretrained {family} from {model_args.model_path}: "
               f"{model_cfg.n_layer}L d={model_cfg.d_model} vocab={model_cfg.vocab_size}")
+        if model_args.vocab_pad_multiple:
+            # pad the imported table with zero rows to the aligned layout;
+            # hf_export slices them back off (models/gpt2 vocab_pad_multiple)
+            from distributed_lion_tpu.models.gpt2 import pad_wte
+
+            model_cfg = dataclasses.replace(
+                model_cfg, vocab_pad_multiple=model_args.vocab_pad_multiple)
+            initial_params["wte"] = pad_wte(initial_params["wte"], model_cfg)
     elif family == "llama":
         from distributed_lion_tpu.models.llama import LlamaConfig
 

@@ -62,6 +62,15 @@ class GPT2Config:
                           # net-new vs the reference (data-parallel only)
     moe_every: int = 2    # MoE in blocks with index % moe_every == moe_every-1
     moe_capacity_factor: float = 1.25
+    vocab_pad_multiple: int = 0  # > 0: round the EMBEDDING TABLE rows up to
+    # a multiple (wte becomes [padded_vocab, d]) so the tied-head matmul and
+    # the chunked-CE slices land on MXU-aligned tile boundaries — GPT-2's
+    # 50257 is ragged (Llama vocabs are already 128-multiples). A pure
+    # LAYOUT choice, not a semantics change: logits are sliced back to
+    # vocab_size in gpt2_apply and the chunked loss masks the pad columns,
+    # so loss/generation are exact and the pad rows get zero loss gradient.
+    # (Under vote-Lion the tie→−1 rule still walks zero-gradient pad rows;
+    # they stay out of every consumer and hf_export slices them off.)
 
     def __post_init__(self):
         if self.moe_experts > 0 and self.moe_every < 1:
@@ -69,11 +78,24 @@ class GPT2Config:
                 f"moe_every must be >= 1 when moe_experts is set, got "
                 f"{self.moe_every}"
             )
+        if self.vocab_pad_multiple < 0:
+            raise ValueError(
+                f"vocab_pad_multiple must be >= 0, got {self.vocab_pad_multiple}"
+            )
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_head == 0
         return self.d_model // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        """Embedding-table rows: ``vocab_size`` rounded up to
+        ``vocab_pad_multiple`` (== ``vocab_size`` when padding is off)."""
+        m = self.vocab_pad_multiple
+        if m <= 0:
+            return self.vocab_size
+        return -(-self.vocab_size // m) * m
 
     @staticmethod
     def tiny(**kw) -> "GPT2Config":
@@ -91,6 +113,19 @@ def _normal(key, shape, std, dtype):
     return (jax.random.normal(key, shape) * std).astype(dtype)
 
 
+def pad_wte(wte: jnp.ndarray, cfg: "GPT2Config") -> jnp.ndarray:
+    """Append the zero MXU-alignment rows of ``cfg.vocab_pad_multiple`` to a
+    true-vocab embedding table (no-op when padding is off). The single
+    source of the pad layout — used by :func:`gpt2_init` and by CLI
+    checkpoint import, so fresh inits and imported tables can't drift."""
+    extra = cfg.padded_vocab - wte.shape[0]
+    if extra <= 0:
+        return wte
+    return jnp.concatenate(
+        [wte, jnp.zeros((extra, wte.shape[1]), wte.dtype)]
+    )
+
+
 def is_moe_block(cfg: GPT2Config, i: int) -> bool:
     return cfg.moe_experts > 0 and i % cfg.moe_every == cfg.moe_every - 1
 
@@ -106,8 +141,11 @@ def gpt2_init(key: jax.Array, cfg: GPT2Config) -> dict:
     resid_std = std / math.sqrt(2 * cfg.n_layer)
     keys = iter(jax.random.split(key, 4 + 7 * cfg.n_layer))
 
+    # pad rows are ZEROS appended after the draw, so the true-vocab rows are
+    # bit-identical to the unpadded init under the same key (pinned by
+    # tests/test_vocab_pad.py) and exports can slice the pad back off
     params: dict = {
-        "wte": _normal(next(keys), (cfg.vocab_size, d), std, dt),
+        "wte": pad_wte(_normal(next(keys), (cfg.vocab_size, d), std, dt), cfg),
         "wpe": _normal(next(keys), (cfg.n_ctx, d), std, dt),
         "ln_f": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
         "blocks": [],
@@ -401,6 +439,10 @@ def gpt2_apply(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
+    # padded-vocab layout: the matmul ran MXU-aligned over padded_vocab
+    # columns; slicing back to vocab_size here keeps every downstream
+    # consumer (losses, generation, eval) on exact true-vocab semantics
+    logits = logits[..., : cfg.vocab_size]
     if return_aux:
         return logits, aux_total
     return logits
@@ -503,4 +545,4 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
     x = _layer_norm(x, params["ln_f"])
     logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype),
                         preferred_element_type=jnp.float32)
-    return logits, new_cache
+    return logits[..., : cfg.vocab_size], new_cache

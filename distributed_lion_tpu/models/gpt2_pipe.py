@@ -108,7 +108,10 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
                 "btd,vd->btv", h, params["wte"].astype(h.dtype),
                 preferred_element_type=jnp.float32,
             )
-            return clm_loss_and_metrics(logits, tokens)
+            # padded-vocab layout (models/gpt2 vocab_pad_multiple): drop the
+            # alignment columns before the loss, same as gpt2_apply
+            return clm_loss_and_metrics(logits[..., : model_cfg.vocab_size],
+                                        tokens)
 
         def skip_loss(acc):
             z = jnp.float32(0)

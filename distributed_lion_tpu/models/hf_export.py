@@ -73,7 +73,9 @@ def gpt2_to_hf(params: dict, cfg: Any, path: str) -> None:
     p = _to_numpy(params)
     d = cfg.d_model
     sd = {
-        "transformer.wte.weight": p["wte"],
+        # a vocab_pad_multiple layout carries MXU-alignment rows HF models
+        # don't have; slice back to the true vocab (no-op when unpadded)
+        "transformer.wte.weight": p["wte"][: cfg.vocab_size],
         "transformer.wpe.weight": p["wpe"],
         "transformer.ln_f.weight": p["ln_f"]["scale"],
         "transformer.ln_f.bias": p["ln_f"]["bias"],

@@ -30,6 +30,7 @@ def chunked_softmax_xent(
     labels: jnp.ndarray,
     n_chunks: int = 8,
     emb_layout: str = "vd",
+    valid_v: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Streaming cross entropy against a tied embedding / LM head.
 
@@ -46,6 +47,11 @@ def chunked_softmax_xent(
             in matmul orientation, e.g. Llama).
         labels: [N] int32 target ids (< V by contract).
         n_chunks: number of vocab chunks.
+        valid_v: when > 0, only head columns < ``valid_v`` are real vocab —
+            the rest are MXU-alignment padding (models/gpt2
+            ``vocab_pad_multiple``) masked out of the lse/gather/argmax
+            exactly like tail-chunk overlap columns, so the padded head
+            computes the identical loss and its pad rows get zero gradient.
 
     Returns:
         (nll [N] f32, correct [N] bool) — per-position negative log
@@ -55,6 +61,9 @@ def chunked_softmax_xent(
         raise ValueError(f"emb_layout must be 'vd' or 'dv', got {emb_layout!r}")
     n, d = hidden.shape
     v = emb.shape[0] if emb_layout == "vd" else emb.shape[1]
+    v_real = valid_v if valid_v > 0 else v
+    if v_real > v:
+        raise ValueError(f"valid_v {v_real} > head columns {v}")
     vc = -(-v // n_chunks)  # ceil; vc <= v always
 
     @partial(jax.checkpoint, prevent_cse=False)
@@ -71,7 +80,8 @@ def chunked_softmax_xent(
             ec = lax.dynamic_slice_in_dim(emb, start, vc, axis=1)
             logits = jnp.einsum("nd,dv->nv", hidden, ec.astype(hidden.dtype),
                                 preferred_element_type=jnp.float32)
-        fresh = (start + jnp.arange(vc)) >= cidx * vc
+        cols = start + jnp.arange(vc)
+        fresh = (cols >= cidx * vc) & (cols < v_real)
         logits = jnp.where(fresh[None, :], logits, -jnp.inf)
 
         cm = logits.max(-1)
@@ -115,6 +125,7 @@ def tp_vocab_xent(
     head_shard: jnp.ndarray,
     labels: jnp.ndarray,
     axis_name: str,
+    valid_v: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Megatron-style vocab-parallel cross entropy (inside shard_map).
 
@@ -133,6 +144,12 @@ def tp_vocab_xent(
     across ranks — callers get complete backbone gradients without extra
     plumbing. Returns (nll [N] f32, correct [N] bool), identical on every
     rank.
+
+    ``valid_v`` (> 0) marks global columns >= it as MXU-alignment padding
+    (models/gpt2 ``vocab_pad_multiple``) and masks them out of the
+    normalizer/argmax — shard_map needs the vocab axis to divide evenly, so
+    padding is what makes a ragged vocab (GPT-2's 50257) shardable at all;
+    the mask keeps the padded math exactly equal to the dense loss.
     """
     from distributed_lion_tpu.parallel.tensor_parallel import (
         copy_to_tp_region,
@@ -145,6 +162,12 @@ def tp_vocab_xent(
     logits = jnp.einsum("nd,dv->nv", hidden,
                         head_shard.astype(hidden.dtype),
                         preferred_element_type=jnp.float32)
+    if valid_v > 0:
+        # pad columns: -inf drops them from the normalizer with zero
+        # gradient (m below is a GLOBAL pmax, so even an all-pad rank's
+        # exp(-inf - m) underflows cleanly to 0)
+        real = (start + jnp.arange(vshard)) < valid_v
+        logits = jnp.where(real[None, :], logits, -jnp.inf)
     # the max shift is a constant offset that cancels analytically in the
     # softmax gradient, so detaching it is exact — and the stop_gradient
     # must sit UPSTREAM of the pmax (which defines no differentiation rule)
@@ -194,6 +217,7 @@ def chunked_clm_loss_seq_parallel(
     n_chunks: int,
     axis_name: str,
     emb_layout: str = "vd",
+    valid_v: int = 0,
 ) -> tuple[jnp.ndarray, dict]:
     """Chunked-vocab CE under sequence parallelism (inside shard_map) —
     the composition of :func:`chunked_clm_loss_and_metrics` (no [B, T, V]
@@ -218,7 +242,7 @@ def chunked_clm_loss_seq_parallel(
     b, t, d = hidden.shape
     nll, correct = chunked_softmax_xent(
         hidden.reshape(b * t, d), emb,
-        labels.reshape(-1).astype(jnp.int32), n_chunks, emb_layout)
+        labels.reshape(-1).astype(jnp.int32), n_chunks, emb_layout, valid_v)
     flat_mask = mask.reshape(-1)
     n_global = jnp.maximum(jax.lax.psum(flat_mask.sum(), axis_name), 1.0)
     loss_local = (nll * flat_mask).sum() / n_global
@@ -237,12 +261,13 @@ def tp_vocab_clm_loss_and_metrics(
     tokens: jnp.ndarray,
     axis_name: str,
     loss_mask: jnp.ndarray | None = None,
+    valid_v: int = 0,
 ) -> tuple[jnp.ndarray, dict]:
     """Shift-by-one CLM loss over a vocab-sharded head — the
     tensor-parallel twin of :func:`chunked_clm_loss_and_metrics`, same
-    return contract."""
+    return contract. ``valid_v`` masks a padded head's alignment columns."""
     return _shifted_clm_metrics(
-        lambda h, lab: tp_vocab_xent(h, head_shard, lab, axis_name),
+        lambda h, lab: tp_vocab_xent(h, head_shard, lab, axis_name, valid_v),
         hidden, tokens, loss_mask)
 
 
@@ -253,13 +278,16 @@ def chunked_clm_loss_and_metrics(
     n_chunks: int = 8,
     loss_mask: jnp.ndarray | None = None,
     emb_layout: str = "vd",
+    valid_v: int = 0,
 ) -> tuple[jnp.ndarray, dict]:
     """Shift-by-one CLM loss from FINAL HIDDEN STATES (not logits) — the
     chunked twin of models/loss.clm_loss_and_metrics, same return contract.
 
     ``hidden`` [B, T, d]; positions 0..T-2 predict tokens 1..T-1. ``emb``
-    is the head in either layout (see :func:`chunked_softmax_xent`).
+    is the head in either layout (see :func:`chunked_softmax_xent`);
+    ``valid_v`` masks MXU-alignment pad columns of a padded head.
     """
     return _shifted_clm_metrics(
-        lambda h, lab: chunked_softmax_xent(h, emb, lab, n_chunks, emb_layout),
+        lambda h, lab: chunked_softmax_xent(h, emb, lab, n_chunks, emb_layout,
+                                            valid_v),
         hidden, tokens, loss_mask)

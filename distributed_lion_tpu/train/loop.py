@@ -877,10 +877,11 @@ class Trainer:
         tp_axis = None
         if tp > 1:
             validate_tp(model_cfg, tp, "gpt2")
-            if cfg.tp_vocab and model_cfg.vocab_size % tp:
+            if cfg.tp_vocab and model_cfg.padded_vocab % tp:
                 raise ValueError(
-                    f"--tp_vocab: vocab {model_cfg.vocab_size} not divisible "
-                    f"by tensor axis {tp}"
+                    f"--tp_vocab: embedding rows {model_cfg.padded_vocab} not "
+                    f"divisible by tensor axis {tp}; vocab_pad_multiple "
+                    f"(models/gpt2) pads a ragged vocab so it shards evenly"
                 )
             param_specs = gpt2_param_specs(model_cfg,
                                            vocab_parallel=cfg.tp_vocab)
@@ -931,7 +932,7 @@ class Trainer:
                                             seq_axis=SEQ_AXIS)
                     return chunked_clm_loss_seq_parallel(
                         hidden, params["wte"], batch, cfg.vocab_chunks,
-                        SEQ_AXIS)
+                        SEQ_AXIS, valid_v=model_cfg.vocab_size)
 
                 loss_fn._vocab_chunked = True
             else:
@@ -956,7 +957,8 @@ class Trainer:
                                         tp_axis=tp_axis,
                                         vocab_axis=TENSOR_AXIS)
                 return tp_vocab_clm_loss_and_metrics(
-                    hidden, params["wte"].T, batch, TENSOR_AXIS)
+                    hidden, params["wte"].T, batch, TENSOR_AXIS,
+                    valid_v=model_cfg.vocab_size)
 
             loss_fn._tp_vocab = True  # consumed; don't trip the guard
 
@@ -968,7 +970,8 @@ class Trainer:
                 hidden, _ = gpt2_hidden(params, batch, model_cfg,
                                         dropout_key=dropout_key, tp_axis=tp_axis)
                 return chunked_clm_loss_and_metrics(
-                    hidden, params["wte"], batch, cfg.vocab_chunks)
+                    hidden, params["wte"], batch, cfg.vocab_chunks,
+                    valid_v=model_cfg.vocab_size)
 
             loss_fn._vocab_chunked = True  # consumed; don't trip the guard
 

@@ -23,7 +23,7 @@ N_CHUNKS = 4    # timed dispatches → K * N_CHUNKS steps
 
 def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         accum: int = 1, dtype: str = "f32", vocab_chunks: int = 0,
-        mom_dtype: str = "") -> float:
+        mom_dtype: str = "", vocab_pad: int = 0) -> float:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -45,6 +45,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         remat_policy="dots" if remat == "dots" else "full",
         attn_impl=attn_impl, flash_block_q=bq, flash_block_kv=bkv,
         param_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
+        vocab_pad_multiple=vocab_pad,
     )
     cfg = TrainConfig(
         lion=True, async_grad=True, learning_rate=1e-4, weight_decay=0.1,
@@ -80,7 +81,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
     print(json.dumps({
         "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_spec,
         "accum": accum, "dtype": dtype, "vocab_chunks": vocab_chunks,
-        "mom_dtype": mom_dtype or "f32",
+        "mom_dtype": mom_dtype or "f32", "vocab_pad": vocab_pad,
         "ms_per_step": round(dt / steps * 1e3, 1), "loss": round(final_loss, 3),
         "tokens_per_sec_per_chip": round(tps, 1),
     }), flush=True)
@@ -88,7 +89,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
 
 
 if __name__ == "__main__":
-    # spec: remat:batch[:attn[@bqxbkv][:accum[:dtype[:vocab_chunks[:mom]]]]]
+    # spec: remat:batch[:attn[@bqxbkv][:accum[:dtype[:chunks[:mom[:pad]]]]]]
     DEFAULTS = ["auto", "1", "f32", "0", ""]
     for spec in sys.argv[1:]:
         parts = spec.split(":")
@@ -96,12 +97,14 @@ if __name__ == "__main__":
         remat_s, bs_s, attn, accum_s, dtype = parts[:5]
         vc = int(parts[5]) if len(parts) > 5 else 0
         mom = parts[6] if len(parts) > 6 else ""
+        pad = int(parts[7]) if len(parts) > 7 else 0
         try:
             run(remat_s, int(bs_s), attn, int(accum_s), dtype, vc,
-                "bfloat16" if mom in ("bf16", "bfloat16") else mom)
+                "bfloat16" if mom in ("bf16", "bfloat16") else mom, pad)
         except Exception as e:  # OOM on big configs: report and keep sweeping
             print(json.dumps({
                 "remat": remat_s, "batch_per_dev": int(bs_s),
                 "attn": attn, "accum": int(accum_s), "dtype": dtype,
-                "vocab_chunks": vc, "error": str(e).split("\n")[0][:160],
+                "vocab_chunks": vc, "vocab_pad": pad,
+                "error": str(e).split("\n")[0][:160],
             }), flush=True)

@@ -21,6 +21,8 @@ echo "$(stamp) stage-2 runbook start" | tee -a "$OUT/log.txt"
 # that window did NOT reach run here; flash@1024x1024 is excluded — its
 # remote_compile hung >14 min and had to be killed.
 timeout 2400 python scripts/bench_sweep.py \
+    noremat:4:flash@512x1024:16:bf16:8:bfloat16:1024 \
+    noremat:4:flash@512x1024:16:bf16:0:bfloat16:1024 \
     noremat:8:flash@512x1024:8:bf16:8:bfloat16 \
     noremat:4:flash@512x1024:32:bf16:8:bfloat16 \
     noremat:4:flash@512x512:16:bf16:8:bfloat16 \
@@ -56,6 +58,7 @@ if rows:
     print(f"export BENCH_MOM_DTYPE={'' if md in ('', 'f32') else md}")
     print(f"export BENCH_BATCH={best['batch_per_dev']}")
     print(f"export BENCH_ACCUM={best['accum']}")
+    print(f"export BENCH_VOCAB_PAD={best.get('vocab_pad', 0)}")
 EOF
 if [ ! -s "$OUT/winner.env" ]; then
   echo "$(stamp) sweep2 produced no rows — bench_best would be the STOCK config; skipping re-bench" | tee -a "$OUT/log.txt"
@@ -69,7 +72,7 @@ cat "$OUT/winner.env" | tee -a "$OUT/log.txt"
 cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_best" 2>/dev/null || true
 timeout 1200 python bench.py > "$OUT/bench_best.json" 2> "$OUT/bench_best.err"
 rc=$?; echo "$(stamp) bench(best) rc=$rc" | tee -a "$OUT/log.txt"
-unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM
+unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM BENCH_VOCAB_PAD
 python - "$OUT" >> "$OUT/log.txt" <<'EOF'
 import json, sys
 out = sys.argv[1]
