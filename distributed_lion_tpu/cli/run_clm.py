@@ -89,8 +89,11 @@ def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
     if os.environ.get("DLION_PLATFORM") == "cpu8":
         jax.config.update("jax_platforms", "cpu")
         os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-    enable_compilation_cache()
+    # distributed init FIRST: the cache gate probes jax.default_backend(),
+    # which initializes XLA backends — jax.distributed.initialize() raises
+    # (and multihost_initialize suppresses) if backends already exist
     multihost_initialize()
+    enable_compilation_cache()
     return make_mesh(tensor=tensor_parallel, seq=seq_parallel,
                      pipe=pipeline_parallel, expert=expert_parallel)
 
@@ -125,16 +128,25 @@ def enable_compilation_cache() -> None:
     across runs). Opt-out with DLION_COMPILE_CACHE=0; directory override via
     DLION_COMPILE_CACHE_DIR.
 
-    The directory is host-scoped (per-CPU-signature suffix) because XLA:CPU
-    AOT cache entries compiled on one host fatally abort the process when
-    loaded on a host with different CPU features. Trade-off, accepted: a
-    host migration also cold-starts the TPU entries (a ~20-40s recompile,
-    vs a crash) and superseded per-host dirs linger under ~/.cache until
-    cleaned; pin DLION_COMPILE_CACHE_DIR to share a cache across known-
-    identical hosts."""
+    TPU backend only. XLA:CPU AOT cache entries compiled on one host
+    fatally abort the process when loaded on a host with different CPU
+    features, and the per-CPU-signature directory suffix cannot fully
+    discriminate hosts (XLA feature-detects via cpuid; /proc/cpuinfo can be
+    virtualized identically across different hardware — an abort was still
+    observed under the signature scheme). CPU compiles are fast enough that
+    caching them buys little, so the cache is simply not enabled off-TPU;
+    the signature suffix is kept as defense in depth for session migration
+    between TPU hosts. Pin DLION_COMPILE_CACHE_DIR to share a cache across
+    known-identical hosts."""
     import jax
 
     if os.environ.get("DLION_COMPILE_CACHE", "1") == "0":
+        return
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        return
+    if backend != "tpu":
         return
     cache_dir = os.environ.get(
         "DLION_COMPILE_CACHE_DIR",
