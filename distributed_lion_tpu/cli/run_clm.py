@@ -82,16 +82,19 @@ class DataArguments:
 
 def build_mesh(tensor_parallel: int = 1, seq_parallel: int = 1,
                pipeline_parallel: int = 1, expert_parallel: int = 1):
-    import jax
+    from distributed_lion_tpu.parallel.mesh import (
+        force_cpu_platform,
+        make_mesh,
+        multihost_initialize,
+    )
 
-    from distributed_lion_tpu.parallel.mesh import make_mesh, multihost_initialize
-
-    if os.environ.get("DLION_PLATFORM") == "cpu8":
-        jax.config.update("jax_platforms", "cpu")
-        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    force_cpu_platform()
     # distributed init FIRST: the cache gate probes jax.default_backend(),
-    # which initializes XLA backends — jax.distributed.initialize() raises
-    # (and multihost_initialize suppresses) if backends already exist
+    # which initializes XLA backends — with backends up,
+    # jax.distributed.initialize() raises and multihost_initialize
+    # re-raises it loudly (parallel/mesh.py), failing the launch instead of
+    # training N silently-disconnected replicas. The order is correctness,
+    # not optimization.
     multihost_initialize()
     enable_compilation_cache()
     return make_mesh(tensor=tensor_parallel, seq=seq_parallel,

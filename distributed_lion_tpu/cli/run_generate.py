@@ -115,14 +115,9 @@ def main(argv=None):
 
     import jax
 
-    if os.environ.get("DLION_PLATFORM") == "cpu8":
-        # same contract as the training CLIs (cli/run_clm.build_mesh): force
-        # the virtual-CPU backend BEFORE first device use — the axon
-        # sitecustomize's TPU plugin otherwise hangs backend init when the
-        # tunnel is down
-        jax.config.update("jax_platforms", "cpu")
-        os.environ.setdefault(
-            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
+
+    force_cpu_platform()
     import jax.numpy as jnp
 
     from distributed_lion_tpu.models.generate import generate

@@ -15,7 +15,6 @@ Axis conventions used throughout the framework:
 
 from __future__ import annotations
 
-import contextlib
 import os
 from typing import Sequence
 
@@ -86,6 +85,28 @@ def data_sharded(mesh: Mesh, axis: int = 0) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def force_cpu_platform() -> bool:
+    """Honor ``DLION_PLATFORM=cpu|cpu8``: switch JAX to the host-CPU
+    backend BEFORE first device use (the axon sitecustomize force-registers
+    a TPU plugin and OVERRIDES the ``JAX_PLATFORMS`` env var; a dead tunnel
+    then hangs backend init forever — the config knob is the only reliable
+    override). ``cpu8`` also requests 8 virtual devices, APPENDING to any
+    existing ``XLA_FLAGS`` (a plain setdefault would silently drop the
+    device count when other flags are set). The one shared copy of this
+    workaround — CLIs and bench scripts all route through it. Returns
+    whether the override was applied."""
+    plat = os.environ.get("DLION_PLATFORM")
+    if plat not in ("cpu", "cpu8"):
+        return False
+    if plat == "cpu8":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
 def multihost_initialize() -> None:
     """Initialize JAX's distributed runtime when launched multi-host.
 
@@ -93,5 +114,22 @@ def multihost_initialize() -> None:
     coordinator env vars are absent (single-host / test runs).
     """
     if os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        with contextlib.suppress(RuntimeError):
+        try:
             jax.distributed.initialize()
+        except RuntimeError as e:
+            # double-initialize (e.g. a CLI composed into a larger program
+            # that already called it) is benign; anything else must be LOUD
+            # — swallowing it silently trains N disconnected single-host
+            # replicas instead of one job
+            # ONLY jax's double-initialize message is benign; matching
+            # anything broader (e.g. substring "already") would also match
+            # coordination-service failures like "task ... already
+            # registered" and silently recreate the disconnected-replica bug
+            if "only be called once" in str(e).lower():
+                return
+            raise RuntimeError(
+                "multi-host init failed with coordinator env vars set; "
+                "refusing to continue as a silently-disconnected replica "
+                "(note: jax.distributed.initialize() must run before "
+                "anything initializes the XLA backend)"
+            ) from e

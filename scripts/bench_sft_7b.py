@@ -165,17 +165,9 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
 
 
 if __name__ == "__main__":
-    if os.environ.get("DLION_PLATFORM") in ("cpu", "cpu8"):
-        # the axon sitecustomize force-registers the TPU plugin and
-        # OVERRIDES the JAX_PLATFORMS env var — a dead tunnel then hangs
-        # jax.devices() forever; the config knob set before first backend
-        # use is the only reliable CPU override (same pattern as the CLIs)
-        if os.environ["DLION_PLATFORM"] == "cpu8":
-            os.environ.setdefault(
-                "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-        import jax
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
 
-        jax.config.update("jax_platforms", "cpu")
+    force_cpu_platform()
     specs = sys.argv[1:] or ["nf4:1:4:8"]
     DEFAULTS = ["nf4", "1", "4", "8", "", "1024", "full"]
     for spec in specs:

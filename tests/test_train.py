@@ -299,3 +299,73 @@ def test_mom_dtype_bf16_trains_and_halves_state():
     assert losses[-1] < losses[0]
     for m in jax.tree.leaves(trainer.state.exp_avg):
         assert m.dtype == jnp.bfloat16
+
+
+def test_build_mesh_orders_distributed_init_before_cache(monkeypatch):
+    """jax.distributed.initialize() must run before anything touches the
+    XLA backend; the compile-cache gate probes jax.default_backend(), so
+    build_mesh must call multihost_initialize FIRST (a wrong order trains N
+    silently-disconnected replicas on multi-host launches)."""
+    from distributed_lion_tpu.cli import run_clm
+    from distributed_lion_tpu.parallel import mesh as mesh_mod
+
+    calls = []
+    monkeypatch.setattr(mesh_mod, "multihost_initialize",
+                        lambda: calls.append("multihost"))
+    monkeypatch.setattr(run_clm, "enable_compilation_cache",
+                        lambda: calls.append("cache"))
+    run_clm.build_mesh()
+    assert calls == ["multihost", "cache"]
+
+
+def test_multihost_initialize_raises_loudly_when_backend_up(monkeypatch):
+    """With coordinator env vars set and a failed init that is NOT a benign
+    double-initialize, multihost_initialize must raise (not silently run as
+    a disconnected replica)."""
+    import pytest as _pytest
+
+    from distributed_lion_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "127.0.0.1:9999")
+
+    class _FakeDist:
+        @staticmethod
+        def initialize():
+            raise RuntimeError(
+                "jax.distributed.initialize() must be called before any JAX "
+                "calls that might initialise the XLA backend.")
+
+    monkeypatch.setattr(mesh_mod.jax, "distributed", _FakeDist)
+    with _pytest.raises(RuntimeError, match="disconnected replica"):
+        mesh_mod.multihost_initialize()
+
+    class _FakeDouble:
+        @staticmethod
+        def initialize():
+            raise RuntimeError("should only be called once")
+
+    monkeypatch.setattr(mesh_mod.jax, "distributed", _FakeDouble)
+    mesh_mod.multihost_initialize()  # benign: returns quietly
+
+
+def test_force_cpu_platform_appends_device_count(monkeypatch):
+    """cpu8 must APPEND the virtual-device flag to existing XLA_FLAGS — a
+    setdefault would silently drop it and run 1-device benches as 'cpu8'."""
+    from distributed_lion_tpu.parallel import mesh as mesh_mod
+
+    monkeypatch.setenv("DLION_PLATFORM", "cpu8")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_cpu_enable_fast_math=false")
+    recorded = {}
+    monkeypatch.setattr(
+        mesh_mod.jax.config, "update",
+        lambda k, v: recorded.__setitem__(k, v))
+    assert mesh_mod.force_cpu_platform() is True
+    import os as _os
+
+    flags = _os.environ["XLA_FLAGS"]
+    assert "--xla_cpu_enable_fast_math=false" in flags
+    assert "xla_force_host_platform_device_count=8" in flags
+    assert recorded == {"jax_platforms": "cpu"}
+
+    monkeypatch.setenv("DLION_PLATFORM", "tpu")
+    assert mesh_mod.force_cpu_platform() is False
