@@ -23,7 +23,6 @@ SPMD layout inside the train-step ``shard_map`` (axes data × pipe):
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -31,7 +30,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from distributed_lion_tpu.models.gpt2 import GPT2Config, _block, _layer_norm
+from distributed_lion_tpu.models.gpt2 import (
+    GPT2Config,
+    _block,
+    _block_remat_for,
+    _layer_norm,
+)
 from distributed_lion_tpu.models.loss import clm_loss_and_metrics
 from distributed_lion_tpu.parallel.mesh import PIPE_AXIS
 from distributed_lion_tpu.parallel.pipeline import (
@@ -61,33 +65,67 @@ def unpipeline_params(pparams: dict, n_layer: int) -> dict:
     }
 
 
-def pipeline_param_specs() -> dict:
+def pipeline_param_specs(tensor: bool = False) -> dict:
     """Replicated embeddings/norm; stage leaves sharded over ``pipe`` (the
     stacked-stage leading dim is implied by ``P(PIPE_AXIS)`` alone — no
-    config dependence)."""
+    config dependence).
+
+    ``tensor=True`` ADDITIONALLY shards each stage's weights over the
+    tensor axis (tp × pp, the classic large-model mesh): the per-layer
+    Megatron specs of parallel/tensor_parallel.gpt2_param_specs shift right
+    by the two stacked-stage dims ``[pp, layers/stage, ...]``. Embeddings,
+    final norm, and the tied head stay replicated over tensor (the
+    replicated-head TP layout) — the per-stage LayerNorms stay sharded over
+    pipe only, and their tensor-axis gradients arrive complete through the
+    Megatron copy boundary inside each block, so no extra reduction is
+    needed (same argument as the non-pipelined TP path)."""
     rep = P()
     ln = {"scale": rep, "bias": rep}
     stage_ln = {"scale": P(PIPE_AXIS), "bias": P(PIPE_AXIS)}
-    stages = {
-        "ln_1": stage_ln,
-        "attn": {k: P(PIPE_AXIS) for k in ("qkv", "qkv_b", "proj", "proj_b")},
-        "ln_2": stage_ln,
-        "mlp": {k: P(PIPE_AXIS) for k in ("fc", "fc_b", "proj", "proj_b")},
-    }
+    if not tensor:
+        att = {k: P(PIPE_AXIS) for k in ("qkv", "qkv_b", "proj", "proj_b")}
+        mlp = {k: P(PIPE_AXIS) for k in ("fc", "fc_b", "proj", "proj_b")}
+    else:
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
+        def stage_spec(*tensor_dims):
+            return P(PIPE_AXIS, None, *tensor_dims)
+
+        att = {
+            "qkv": stage_spec(None, None, TENSOR_AXIS),   # [d, 3, d/tp]
+            "qkv_b": stage_spec(None, TENSOR_AXIS),
+            "proj": stage_spec(TENSOR_AXIS, None),        # row-parallel
+            "proj_b": stage_spec(),
+        }
+        mlp = {
+            "fc": stage_spec(None, TENSOR_AXIS),          # column-parallel
+            "fc_b": stage_spec(TENSOR_AXIS),
+            "proj": stage_spec(TENSOR_AXIS, None),        # row-parallel
+            "proj_b": stage_spec(),
+        }
+    stages = {"ln_1": stage_ln, "attn": att, "ln_2": stage_ln, "mlp": mlp}
     return {"wte": rep, "wpe": rep, "ln_f": ln, "stages": stages}
 
 
 def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
-                       axis_name: str = PIPE_AXIS):
+                       axis_name: str = PIPE_AXIS,
+                       tp_axis: Optional[str] = None):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
     ``tokens`` [B_local, T] with B_local divisible by ``n_micro``. Dropout is
-    unsupported under pipelining (guarded at config time)."""
+    unsupported under pipelining (guarded at config time).
+
+    ``tp_axis`` runs each stage's blocks tensor-parallel (tp × pp):
+    activations enter every stage replicated over the tensor axis, each
+    block's column/row-parallel matmuls psum over it (models/gpt2._block),
+    and they exit replicated again — so the ppermute pipeline rotation and
+    the last-stage replicated head are untouched by tensor sharding."""
 
     def layer_fn(p_layer, h):
-        f = (partial(jax.checkpoint, static_argnums=(3, 4, 5))(_block)
-             if model_cfg.remat else _block)
-        return f(h, p_layer, None, model_cfg, None, None)
+        # _block_remat_for honors cfg.remat_policy ('dots' keeps matmul
+        # outputs) — the same wrapper the non-pipelined path uses
+        f = _block_remat_for(model_cfg) if model_cfg.remat else _block
+        return f(h, p_layer, None, model_cfg, tp_axis, None)
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # dropout unsupported under pipelining

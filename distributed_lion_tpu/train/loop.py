@@ -781,26 +781,35 @@ class Trainer:
                 validate_pipeline,
             )
 
-            if (tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1
+            if (dict(mesh.shape).get(SEQ_AXIS, 1) > 1
                     or dict(mesh.shape).get(EXPERT_AXIS, 1) > 1):
                 raise NotImplementedError(
-                    "pipeline parallelism composes with data parallelism "
-                    "(dp x pp); tensor/seq/expert axes alongside pipe are "
-                    "not wired"
+                    "pipeline parallelism composes with data and tensor "
+                    "parallelism (dp x tp x pp); seq/expert axes alongside "
+                    "pipe are not wired"
                 )
             if model_cfg.moe_experts > 0:
                 raise NotImplementedError(
                     "MoE blocks under pipeline parallelism are not wired "
                     "(mixed dense/MoE stage structures); drop one of the two"
                 )
+            if cfg.tp_vocab:
+                raise NotImplementedError(
+                    "--tp_vocab under --pipeline_parallel is not wired (the "
+                    "pipeline loss carries its own replicated head); drop one"
+                )
+            if tp > 1:
+                validate_tp(model_cfg, tp, "gpt2")
             n_micro = cfg.pipeline_microbatches or pp
             validate_pipeline(model_cfg, cfg, pp, n_micro)
             return Trainer(
                 cfg, mesh,
                 apply_fn=None,
                 params=pipeline_params(params, pp),
-                param_specs=pipeline_param_specs(),
-                loss_fn=make_pipeline_loss(model_cfg, n_micro),
+                param_specs=pipeline_param_specs(tensor=tp > 1),
+                loss_fn=make_pipeline_loss(
+                    model_cfg, n_micro,
+                    tp_axis=TENSOR_AXIS if tp > 1 else None),
             )
 
         ep = dict(mesh.shape).get(EXPERT_AXIS, 1)

@@ -89,24 +89,37 @@ def test_pp_forward_matches_sequential():
     np.testing.assert_allclose(got, float(ref_loss), rtol=2e-5, atol=2e-5)
 
 
-def test_pp_dp_matches_pure_dp():
-    """dp=2 x pp=4 training ≡ dp=2 training (same global batch/data/seed).
+@pytest.mark.parametrize(
+    "mesh_kw,cfg_kw",
+    [
+        pytest.param(dict(data=2, pipe=4),
+                     dict(pipeline_parallel=4, pipeline_microbatches=2),
+                     id="dp2xpp4"),
+        pytest.param(dict(data=2, tensor=2, pipe=2),
+                     dict(tensor_parallel=2, pipeline_parallel=2,
+                          pipeline_microbatches=2),
+                     id="dp2xtp2xpp2"),
+    ],
+)
+def test_pipelined_mesh_matches_pure_dp(mesh_kw, cfg_kw):
+    """dp×pp — and the classic large-model mesh dp×tp×pp (Megatron
+    sharding INSIDE each GPipe stage) — must train identically to pure
+    dp=2 at the same global batch/data/seed: both are pure re-schedules.
 
-    Run in f32 compute: pipelining reorders bf16 matmul tiles, and the vote's
-    sign threshold amplifies that noise into ±2·lr param flips on near-zero
-    ballots — in f32 the reordering noise is below any ballot margin, so the
-    schedules must agree to tight tolerance."""
+    Run in f32 compute: pipelining/tp-psum reorder bf16 matmul tiles, and
+    the vote's sign threshold amplifies that noise into ±2·lr param flips
+    on near-zero ballots — in f32 the reordering noise is below any ballot
+    margin, so the schedules must agree to tight tolerance."""
     devs = jax.devices()
     mesh_dp = make_mesh(data=2, devices=devs[:2])
-    mesh_pp = make_mesh(data=2, pipe=4)
+    mesh_x = make_mesh(**mesh_kw)
 
     model_f32 = dataclasses.replace(MODEL, compute_dtype=jax.numpy.float32)
     losses_dp, params_dp = _train(mesh_dp, _cfg(), n_steps=5, model=model_f32)
-    losses_pp, params_pp = _train(
-        mesh_pp, _cfg(pipeline_parallel=4, pipeline_microbatches=2),
-        n_steps=5, model=model_f32)
+    losses_x, params_x = _train(mesh_x, _cfg(**cfg_kw), n_steps=5,
+                                model=model_f32)
 
-    np.testing.assert_allclose(losses_pp, losses_dp, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(losses_x, losses_dp, rtol=1e-4, atol=1e-4)
     # Param comparison, modulo sign-of-zero ballots: coordinates whose
     # gradient is EXACTLY zero by symmetry (e.g. k-bias under softmax shift
     # invariance) vote on the sign of fp noise, which any schedule change
@@ -115,7 +128,7 @@ def test_pp_dp_matches_pure_dp():
     # must be small (the informative coordinates agree exactly).
     from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
 
-    restored = unpipeline_params(params_pp, MODEL.n_layer)
+    restored = unpipeline_params(params_x, MODEL.n_layer)
     total = mismatched = 0
     envelope = 2 * 1e-3 * 5  # 2·lr·n_steps
     for a, b in zip(jax.tree.leaves(params_dp), jax.tree.leaves(restored)):
@@ -150,3 +163,16 @@ def test_pp_guards():
     with pytest.raises(ValueError, match="not divisible by pipeline_microbatches"):
         Trainer.for_gpt2(_cfg(pipeline_parallel=4, per_device_train_batch_size=3,
                               pipeline_microbatches=2), mesh, MODEL)
+
+
+def test_tp_pp_loss_decreases():
+    mesh = make_mesh(data=2, tensor=2, pipe=2)
+    cfg = _cfg(tensor_parallel=2, pipeline_parallel=2,
+               pipeline_microbatches=4, learning_rate=3e-3, max_steps=30)
+    trainer = Trainer.for_gpt2(cfg, mesh, MODEL, seed=1)
+    blocks = synthetic_lm_dataset(trainer.global_train_batch() * 2, 32,
+                                  MODEL.vocab_size, seed=3)
+    hist = trainer.train(batch_iterator(blocks, trainer.global_train_batch(), seed=0))
+    losses = [h["loss"] for h in hist if "loss" in h]
+    assert losses[-1] < losses[0] - 0.3, losses
+    trainer.close()
