@@ -121,11 +121,12 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
     and they exit replicated again — so the ppermute pipeline rotation and
     the last-stage replicated head are untouched by tensor sharding."""
 
+    # _block_remat_for honors cfg.remat_policy ('dots' keeps matmul
+    # outputs) — the same wrapper the non-pipelined path uses
+    block = _block_remat_for(model_cfg) if model_cfg.remat else _block
+
     def layer_fn(p_layer, h):
-        # _block_remat_for honors cfg.remat_policy ('dots' keeps matmul
-        # outputs) — the same wrapper the non-pipelined path uses
-        f = _block_remat_for(model_cfg) if model_cfg.remat else _block
-        return f(h, p_layer, None, model_cfg, tp_axis, None)
+        return block(h, p_layer, None, model_cfg, tp_axis, None)
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # dropout unsupported under pipelining

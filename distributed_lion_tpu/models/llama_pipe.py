@@ -59,26 +59,43 @@ def llama_unpipeline_params(pparams: dict, n_layer: int) -> dict:
     }
 
 
-def llama_pipeline_param_specs() -> dict:
+def llama_pipeline_param_specs(tensor: bool = False) -> dict:
     """Replicated embeddings/head/final-norm; stage leaves sharded over
-    ``pipe`` (their stacked leading dim)."""
+    ``pipe`` (their stacked leading dim).
+
+    ``tensor=True`` ADDITIONALLY shards each stage's weights over the
+    tensor axis (tp × pp): parallel/tensor_parallel.llama_param_specs'
+    per-layer Megatron specs shifted past the two stacked-stage dims.
+    wte / lm_head / ln_f stay replicated over tensor (replicated-head TP);
+    the per-stage RMSNorm scales stay pipe-sharded only, their tensor-axis
+    grads arriving complete through the Megatron copy boundary (same
+    argument as gpt2_pipe)."""
     rep = P()
     stage_rms = {"scale": P(PIPE_AXIS)}
-    stages = {
-        "ln_attn": stage_rms,
-        "attn": {k: P(PIPE_AXIS) for k in ("wq", "wk", "wv", "wo")},
-        "ln_mlp": stage_rms,
-        "mlp": {k: P(PIPE_AXIS) for k in ("w_gate", "w_up", "w_down")},
-    }
+    if not tensor:
+        att = {k: P(PIPE_AXIS) for k in ("wq", "wk", "wv", "wo")}
+        mlp = {k: P(PIPE_AXIS) for k in ("w_gate", "w_up", "w_down")}
+    else:
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
+        col = P(PIPE_AXIS, None, None, TENSOR_AXIS)   # [pp, L/pp, d, k]
+        row = P(PIPE_AXIS, None, TENSOR_AXIS, None)   # [pp, L/pp, k, d]
+        att = {"wq": col, "wk": col, "wv": col, "wo": row}
+        mlp = {"w_gate": col, "w_up": col, "w_down": row}
+    stages = {"ln_attn": stage_rms, "attn": att, "ln_mlp": stage_rms,
+              "mlp": mlp}
     return {"wte": rep, "lm_head": rep, "ln_f": {"scale": rep},
             "stages": stages}
 
 
 def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
-                             axis_name: str = PIPE_AXIS):
+                             axis_name: str = PIPE_AXIS,
+                             tp_axis=None):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
-    ``tokens`` [B_local, T] with B_local divisible by ``n_micro``."""
+    ``tokens`` [B_local, T] with B_local divisible by ``n_micro``.
+    ``tp_axis`` runs each stage's blocks tensor-parallel (tp × pp) — see
+    gpt2_pipe.make_pipeline_loss."""
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # Llama (like HF's) has no dropout
@@ -91,7 +108,7 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
         block = _block_remat_for(model_cfg) if model_cfg.remat else _block
 
         def layer_fn(p_layer, h):
-            return block(h, p_layer, model_cfg, cos, sin, None, None)
+            return block(h, p_layer, model_cfg, cos, sin, tp_axis, None)
 
         x = params["wte"][tokens].astype(model_cfg.compute_dtype)
         xm = x.reshape((n_micro, B // n_micro, T, x.shape[-1]))

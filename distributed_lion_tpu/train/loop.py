@@ -1037,24 +1037,29 @@ class Trainer:
                 validate_llama_pipeline,
             )
 
-            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+            if dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
                 raise NotImplementedError(
-                    "pipeline parallelism composes with data parallelism "
-                    "(dp x pp); tensor/seq axes alongside pipe are not wired"
+                    "pipeline parallelism composes with data and tensor "
+                    "parallelism (dp x tp x pp); a seq axis alongside pipe "
+                    "is not wired"
                 )
             if cfg.vocab_chunks > 0 or cfg.tp_vocab:
                 raise NotImplementedError(
                     "--vocab_chunks/--tp_vocab under --pipeline_parallel are "
                     "not wired (the pipeline loss carries its own head)"
                 )
+            if tp > 1:
+                validate_tp(model_cfg, tp, "llama")
             n_micro = cfg.pipeline_microbatches or pp
             validate_llama_pipeline(model_cfg, cfg, pp, n_micro)
             return Trainer(
                 cfg, mesh,
                 apply_fn=None,
                 params=llama_pipeline_params(params, pp),
-                param_specs=llama_pipeline_param_specs(),
-                loss_fn=make_llama_pipeline_loss(model_cfg, n_micro),
+                param_specs=llama_pipeline_param_specs(tensor=tp > 1),
+                loss_fn=make_llama_pipeline_loss(
+                    model_cfg, n_micro,
+                    tp_axis=TENSOR_AXIS if tp > 1 else None),
             )
         if cfg.tp_vocab and tp <= 1:
             raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "

@@ -92,15 +92,26 @@ def test_llama_pp_roundtrip_params():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_llama_pp_trajectory_matches_dp():
-    """dp=2 x pp=4 ≡ dp=2 at equal global batch."""
+@pytest.mark.parametrize(
+    "mesh_kw,cfg_kw",
+    [
+        pytest.param(dict(data=2, pipe=4),
+                     dict(pipeline_parallel=4, pipeline_microbatches=2),
+                     id="dp2xpp4"),
+        pytest.param(dict(data=2, tensor=2, pipe=2),
+                     dict(tensor_parallel=2, pipeline_parallel=2,
+                          pipeline_microbatches=2),
+                     id="dp2xtp2xpp2"),
+    ],
+)
+def test_llama_pipelined_mesh_trajectory_matches_dp(mesh_kw, cfg_kw):
+    """dp×pp — and dp×tp×pp, Megatron sharding inside the Llama stages —
+    ≡ dp=2 at equal global batch."""
     from distributed_lion_tpu.models.llama_pipe import llama_unpipeline_params
 
     losses_dp, params_dp = _train(
         make_mesh(data=2, devices=jax.devices()[:2]), _cfg())
-    losses_pp, params_pp = _train(
-        make_mesh(data=2, pipe=4),
-        _cfg(pipeline_parallel=4, pipeline_microbatches=2))
+    losses_pp, params_pp = _train(make_mesh(**mesh_kw), _cfg(**cfg_kw))
     np.testing.assert_allclose(losses_pp, losses_dp, rtol=1e-4, atol=1e-4)
     restored = llama_unpipeline_params(params_pp, MODEL.n_layer)
     envelope = 2 * 1e-3 * 5  # 2·lr·n_steps ballot-flip envelope
@@ -114,9 +125,9 @@ def test_llama_pp_guards():
     with pytest.raises(ValueError, match="divisible"):
         Trainer.for_llama(_cfg(pipeline_parallel=4), mesh,
                           LlamaConfig.tiny(n_layer=3))
-    with pytest.raises(NotImplementedError, match="tensor/seq"):
-        Trainer.for_llama(_cfg(pipeline_parallel=2, tensor_parallel=2),
-                          make_mesh(data=2, tensor=2, pipe=2), MODEL)
+    with pytest.raises(NotImplementedError, match="seq axis"):
+        Trainer.for_llama(_cfg(pipeline_parallel=2, seq_parallel=2),
+                          make_mesh(data=2, seq=2, pipe=2), MODEL)
 
 
 def test_run_clm_cli_llama_pp_smoke():
