@@ -109,7 +109,8 @@ def pipeline_param_specs(tensor: bool = False) -> dict:
 
 def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
                        axis_name: str = PIPE_AXIS,
-                       tp_axis: Optional[str] = None):
+                       tp_axis: Optional[str] = None,
+                       vocab_chunks: int = 0):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
     ``tokens`` [B_local, T] with B_local divisible by ``n_micro``. Dropout is
@@ -119,7 +120,12 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
     activations enter every stage replicated over the tensor axis, each
     block's column/row-parallel matmuls psum over it (models/gpt2._block),
     and they exit replicated again — so the ppermute pipeline rotation and
-    the last-stage replicated head are untouched by tensor sharding."""
+    the last-stage replicated head are untouched by tensor sharding.
+
+    ``vocab_chunks`` streams the last stage's tied head through the chunked
+    CE (ops/xent) — the [B, T, V] logits never materialize even on the one
+    stage that computes the loss (and ONLY there: the cond still skips the
+    head on every other stage)."""
 
     # _block_remat_for honors cfg.remat_policy ('dots' keeps matmul
     # outputs) — the same wrapper the non-pipelined path uses
@@ -143,6 +149,14 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
         def head_loss(acc):
             h = acc.reshape((B, T, x.shape[-1]))
             h = _layer_norm(h, params["ln_f"])
+            if vocab_chunks > 0:
+                from distributed_lion_tpu.ops.xent import (
+                    chunked_clm_loss_and_metrics,
+                )
+
+                return chunked_clm_loss_and_metrics(
+                    h, params["wte"], tokens, vocab_chunks,
+                    valid_v=model_cfg.vocab_size)
             logits = jnp.einsum(
                 "btd,vd->btv", h, params["wte"].astype(h.dtype),
                 preferred_element_type=jnp.float32,

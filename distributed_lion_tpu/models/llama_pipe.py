@@ -90,12 +90,14 @@ def llama_pipeline_param_specs(tensor: bool = False) -> dict:
 
 def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
                              axis_name: str = PIPE_AXIS,
-                             tp_axis=None):
+                             tp_axis=None, vocab_chunks: int = 0):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
     ``tokens`` [B_local, T] with B_local divisible by ``n_micro``.
     ``tp_axis`` runs each stage's blocks tensor-parallel (tp × pp) — see
-    gpt2_pipe.make_pipeline_loss."""
+    gpt2_pipe.make_pipeline_loss. ``vocab_chunks`` streams the last stage's
+    untied lm_head through the chunked CE (the win that matters most at
+    Llama-3's 128k vocab: [B, T, 128k] f32 logits never materialize)."""
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # Llama (like HF's) has no dropout
@@ -119,6 +121,14 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
         def head_loss(acc):
             h = acc.reshape((B, T, x.shape[-1]))
             h = _rms_norm(h, params["ln_f"], model_cfg.rms_eps)
+            if vocab_chunks > 0:
+                from distributed_lion_tpu.ops.xent import (
+                    chunked_clm_loss_and_metrics,
+                )
+
+                return chunked_clm_loss_and_metrics(
+                    h, params["lm_head"], tokens, vocab_chunks,
+                    emb_layout="dv")
             logits = jnp.einsum(
                 "btd,dv->btv", h, params["lm_head"].astype(h.dtype),
                 preferred_element_type=jnp.float32,

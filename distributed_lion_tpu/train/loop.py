@@ -767,11 +767,10 @@ class Trainer:
                if "dcn_bits_per_param" in acct else "")
         )
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
-        if cfg.vocab_chunks > 0 and (pp > 1 or model_cfg.moe_experts > 0):
+        if cfg.vocab_chunks > 0 and model_cfg.moe_experts > 0:
             raise NotImplementedError(
-                "--vocab_chunks is wired for the dense dp/tp/sp paths (the "
-                "pipeline/MoE branches carry their own loss functions); "
-                "drop one"
+                "--vocab_chunks is wired for the dense dp/tp/sp/pp paths "
+                "(the MoE branch carries its own loss function); drop one"
             )
         if pp > 1:
             from distributed_lion_tpu.models.gpt2_pipe import (
@@ -802,14 +801,18 @@ class Trainer:
                 validate_tp(model_cfg, tp, "gpt2")
             n_micro = cfg.pipeline_microbatches or pp
             validate_pipeline(model_cfg, cfg, pp, n_micro)
+            loss_fn = make_pipeline_loss(
+                model_cfg, n_micro,
+                tp_axis=TENSOR_AXIS if tp > 1 else None,
+                vocab_chunks=cfg.vocab_chunks)
+            if cfg.vocab_chunks > 0:
+                loss_fn._vocab_chunked = True  # consumed; don't trip the guard
             return Trainer(
                 cfg, mesh,
                 apply_fn=None,
                 params=pipeline_params(params, pp),
                 param_specs=pipeline_param_specs(tensor=tp > 1),
-                loss_fn=make_pipeline_loss(
-                    model_cfg, n_micro,
-                    tp_axis=TENSOR_AXIS if tp > 1 else None),
+                loss_fn=loss_fn,
             )
 
         ep = dict(mesh.shape).get(EXPERT_AXIS, 1)
@@ -1043,23 +1046,27 @@ class Trainer:
                     "parallelism (dp x tp x pp); a seq axis alongside pipe "
                     "is not wired"
                 )
-            if cfg.vocab_chunks > 0 or cfg.tp_vocab:
+            if cfg.tp_vocab:
                 raise NotImplementedError(
-                    "--vocab_chunks/--tp_vocab under --pipeline_parallel are "
-                    "not wired (the pipeline loss carries its own head)"
+                    "--tp_vocab under --pipeline_parallel is not wired (the "
+                    "pipeline loss carries its own replicated head); drop one"
                 )
             if tp > 1:
                 validate_tp(model_cfg, tp, "llama")
             n_micro = cfg.pipeline_microbatches or pp
             validate_llama_pipeline(model_cfg, cfg, pp, n_micro)
+            loss_fn = make_llama_pipeline_loss(
+                model_cfg, n_micro,
+                tp_axis=TENSOR_AXIS if tp > 1 else None,
+                vocab_chunks=cfg.vocab_chunks)
+            if cfg.vocab_chunks > 0:
+                loss_fn._vocab_chunked = True  # consumed; don't trip the guard
             return Trainer(
                 cfg, mesh,
                 apply_fn=None,
                 params=llama_pipeline_params(params, pp),
                 param_specs=llama_pipeline_param_specs(tensor=tp > 1),
-                loss_fn=make_llama_pipeline_loss(
-                    model_cfg, n_micro,
-                    tp_axis=TENSOR_AXIS if tp > 1 else None),
+                loss_fn=loss_fn,
             )
         if cfg.tp_vocab and tp <= 1:
             raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "

@@ -142,3 +142,38 @@ def test_run_clm_cli_llama_pp_smoke():
         "--logging_steps", "10", "--eval_steps", "1000", "--save_steps",
         "1000",
     ])
+
+
+def test_llama_pp_chunked_head_matches_dense():
+    """pp × vocab_chunks on the untied lm_head (dv layout)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_lion_tpu.models.llama_pipe import (
+        llama_pipeline_param_specs,
+        llama_pipeline_params,
+        make_llama_pipeline_loss,
+    )
+    from distributed_lion_tpu.models.loss import clm_loss_and_metrics
+
+    pp = 4
+    params = llama_init(jax.random.key(0), MODEL)
+    tokens = np.random.default_rng(0).integers(
+        0, MODEL.vocab_size, size=(4, 32)).astype(np.int32)
+    mesh = make_mesh(data=1, pipe=pp, devices=jax.devices()[:pp])
+    loss_fn = make_llama_pipeline_loss(MODEL, n_micro=2, vocab_chunks=4)
+    pparams = llama_pipeline_params(params, pp)
+
+    def body(pp_params, toks):
+        loss, m = loss_fn(pp_params, toks, None)
+        return m["loss"]
+
+    loss_pp = shard_map(
+        body, mesh=mesh,
+        in_specs=(llama_pipeline_param_specs(), P()),
+        out_specs=P(), check_vma=False,
+    )(pparams, tokens)
+    loss_seq, _ = clm_loss_and_metrics(
+        llama_apply(params, tokens, MODEL), tokens)
+    np.testing.assert_allclose(float(loss_pp), float(loss_seq),
+                               rtol=2e-4, atol=2e-4)
