@@ -110,7 +110,8 @@ def pipeline_param_specs(tensor: bool = False) -> dict:
 def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
                        axis_name: str = PIPE_AXIS,
                        tp_axis: Optional[str] = None,
-                       vocab_chunks: int = 0):
+                       vocab_chunks: int = 0,
+                       seq_axis: Optional[str] = None):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
     ``tokens`` [B_local, T] with B_local divisible by ``n_micro``. Dropout is
@@ -125,26 +126,65 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
     ``vocab_chunks`` streams the last stage's tied head through the chunked
     CE (ops/xent) — the [B, T, V] logits never materialize even on the one
     stage that computes the loss (and ONLY there: the cond still skips the
-    head on every other stage)."""
+    head on every other stage).
+
+    ``seq_axis`` shards TOKENS over a sequence axis on top of the pipeline
+    (sp × pp, long-context pipelined training): each stage's blocks ring
+    their attention k/v over ``seq_axis`` inside every pipeline tick, the
+    positional rows are offset by the seq shard index, and the last stage's
+    loss runs the seq-parallel CE. Its collectives (boundary-label
+    ppermute, count/metric psums) are hoisted OUTSIDE the lax.cond — XLA
+    aborts on collectives under conditional control flow — so the cond
+    computes only collective-free masked NLL partials
+    (ops/xent.masked_local_nll)."""
 
     # _block_remat_for honors cfg.remat_policy ('dots' keeps matmul
     # outputs) — the same wrapper the non-pipelined path uses
     block = _block_remat_for(model_cfg) if model_cfg.remat else _block
 
     def layer_fn(p_layer, h):
-        return block(h, p_layer, None, model_cfg, tp_axis, None)
+        return block(h, p_layer, None, model_cfg, tp_axis, seq_axis)
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # dropout unsupported under pipelining
         B, T = tokens.shape
-        if T > model_cfg.n_ctx:
-            raise ValueError(f"sequence length {T} exceeds n_ctx {model_cfg.n_ctx}")
+        if seq_axis is None:
+            if T > model_cfg.n_ctx:
+                raise ValueError(
+                    f"sequence length {T} exceeds n_ctx {model_cfg.n_ctx}")
+            pos_start = 0
+        else:
+            pos_start = lax.axis_index(seq_axis) * T
         x = params["wte"][tokens].astype(model_cfg.compute_dtype)
-        x = x + params["wpe"][:T].astype(model_cfg.compute_dtype)
+        x = x + lax.dynamic_slice_in_dim(
+            params["wpe"], pos_start, T, axis=0
+        ).astype(model_cfg.compute_dtype)
         xm = x.reshape((n_micro, B // n_micro, T, x.shape[-1]))
         # local stage view inside shard_map keeps a leading [1] shard axis
         stage_local = jax.tree.map(lambda a: a[0], params["stages"])
         acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
+
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
+
+        if seq_axis is not None:
+            # sp × pp scaffold (collective hoisting + grad contract) lives
+            # in models/loss.pipelined_seq_parallel_loss, shared with
+            # llama_pipe; only the family head is defined here.
+            from distributed_lion_tpu.models.loss import (
+                pipelined_seq_parallel_loss,
+            )
+            from distributed_lion_tpu.ops.xent import masked_local_nll
+
+            def head_partials(acc, labels, mask):
+                h = _layer_norm(acc.reshape((B, T, x.shape[-1])),
+                                params["ln_f"])
+                return masked_local_nll(
+                    h, params["wte"], labels, mask, vocab_chunks,
+                    valid_v=model_cfg.vocab_size)
+
+            return pipelined_seq_parallel_loss(
+                head_partials, acc, tokens, seq_axis, axis_name)
 
         def head_loss(acc):
             h = acc.reshape((B, T, x.shape[-1]))
@@ -174,8 +214,6 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
         # (expensive) vocab projection + loss on the other stages entirely —
         # XLA executes just the taken branch — and the psum then both
         # broadcasts the value and routes zero cotangent to the skip branch
-        stage = lax.axis_index(axis_name)
-        last = lax.psum(1, axis_name) - 1
         loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
         loss = lax.psum(loss_local, axis_name)
         metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}

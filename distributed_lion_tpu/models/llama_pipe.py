@@ -90,33 +90,65 @@ def llama_pipeline_param_specs(tensor: bool = False) -> dict:
 
 def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
                              axis_name: str = PIPE_AXIS,
-                             tp_axis=None, vocab_chunks: int = 0):
+                             tp_axis=None, vocab_chunks: int = 0,
+                             seq_axis=None):
     """Build ``loss_fn(params, tokens, dropout_key) -> (loss, metrics)`` for
     the Trainer. Must run inside ``shard_map`` with ``axis_name`` bound;
     ``tokens`` [B_local, T] with B_local divisible by ``n_micro``.
     ``tp_axis`` runs each stage's blocks tensor-parallel (tp × pp) — see
     gpt2_pipe.make_pipeline_loss. ``vocab_chunks`` streams the last stage's
     untied lm_head through the chunked CE (the win that matters most at
-    Llama-3's 128k vocab: [B, T, 128k] f32 logits never materialize)."""
+    Llama-3's 128k vocab: [B, T, 128k] f32 logits never materialize).
+    ``seq_axis`` shards tokens over a sequence axis on top of the pipeline
+    (sp × pp): rotary angles offset by the seq shard index, ring attention
+    over ``seq_axis`` inside every pipeline tick, seq-parallel CE at the
+    last stage — see gpt2_pipe.make_pipeline_loss for the cond/collective
+    argument."""
 
     def loss_fn(params, tokens, dropout_key):
         del dropout_key  # Llama (like HF's) has no dropout
         B, T = tokens.shape
-        if T > model_cfg.n_ctx:
-            raise ValueError(f"sequence length {T} exceeds n_ctx "
-                             f"{model_cfg.n_ctx}")
-        cos, sin = rope_angles(T, model_cfg.head_dim, model_cfg.rope_theta)
+        if seq_axis is None:
+            if T > model_cfg.n_ctx:
+                raise ValueError(f"sequence length {T} exceeds n_ctx "
+                                 f"{model_cfg.n_ctx}")
+            offset = 0
+        else:
+            offset = lax.axis_index(seq_axis) * T
+        cos, sin = rope_angles(T, model_cfg.head_dim, model_cfg.rope_theta,
+                               offset=offset)
         # same remat wrapper as the non-pipelined path (honors remat_policy)
         block = _block_remat_for(model_cfg) if model_cfg.remat else _block
 
         def layer_fn(p_layer, h):
-            return block(h, p_layer, model_cfg, cos, sin, tp_axis, None)
+            return block(h, p_layer, model_cfg, cos, sin, tp_axis, seq_axis)
 
         x = params["wte"][tokens].astype(model_cfg.compute_dtype)
         xm = x.reshape((n_micro, B // n_micro, T, x.shape[-1]))
         # local stage view inside shard_map keeps a leading [1] shard axis
         stage_local = jax.tree.map(lambda a: a[0], params["stages"])
         acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
+
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
+
+        if seq_axis is not None:
+            # sp × pp scaffold (collective hoisting + grad contract) shared
+            # with gpt2_pipe: models/loss.pipelined_seq_parallel_loss.
+            from distributed_lion_tpu.models.loss import (
+                pipelined_seq_parallel_loss,
+            )
+            from distributed_lion_tpu.ops.xent import masked_local_nll
+
+            def head_partials(acc, labels, mask):
+                h = _rms_norm(acc.reshape((B, T, x.shape[-1])),
+                              params["ln_f"], model_cfg.rms_eps)
+                return masked_local_nll(
+                    h, params["lm_head"], labels, mask, vocab_chunks,
+                    emb_layout="dv")
+
+            return pipelined_seq_parallel_loss(
+                head_partials, acc, tokens, seq_axis, axis_name)
 
         def head_loss(acc):
             h = acc.reshape((B, T, x.shape[-1]))
@@ -142,8 +174,6 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
         # only the last stage saw real activations (see gpt2_pipe: cond
         # skips the vocab projection elsewhere; the psum broadcasts the
         # value and routes zero cotangent into the skip branch)
-        stage = lax.axis_index(axis_name)
-        last = lax.psum(1, axis_name) - 1
         loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
         loss = lax.psum(loss_local, axis_name)
         metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}

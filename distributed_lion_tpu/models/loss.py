@@ -148,3 +148,47 @@ def clm_loss_seq_parallel(
         "n_tokens": n_global / jnp.maximum(S, 1),  # per-shard average, matches
         # the replicated path's per-device count convention for logging
     }
+
+
+def pipelined_seq_parallel_loss(head_partials, acc, tokens, seq_axis: str,
+                                pipe_axis: str):
+    """The sp × pp loss scaffold, shared by gpt2_pipe and llama_pipe so the
+    trickiest contracts live in ONE place:
+
+    - collective hoisting: XLA aborts on collectives under conditional
+      control flow, so the boundary-label ``ppermute`` (tokens-only — free
+      to hoist) and every psum run OUT here while the ``lax.cond`` over
+      pipeline stages wraps only ``head_partials(acc, labels, mask) ->
+      (masked nll sum, masked correct sum)``, which must be
+      collective-free (ops/xent.masked_local_nll);
+    - grad contract: the returned loss differentiates as
+      ``local_nll_sum / global_token_count`` per (seq, pipe) rank — the
+      train loop psums grads over the seq axis and (for replicated leaves)
+      the pipe axis, completing the sum.
+
+    Returns ``(loss, metrics)`` in the Trainer's contract; metrics are
+    globally reduced, ``n_tokens`` is the per-seq-shard average (the seq
+    loss's logging convention, uniform across pipe)."""
+    labels, is_last = shift_in_next_shard(tokens, seq_axis)
+    mask = jnp.ones(labels.shape, jnp.float32)
+    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+    S = jax.lax.psum(1, seq_axis)
+    n_global = jnp.maximum(jax.lax.psum(mask.sum(), seq_axis), 1.0)
+
+    stage = jax.lax.axis_index(pipe_axis)
+    last = jax.lax.psum(1, pipe_axis) - 1
+    nll_sum, correct_sum = jax.lax.cond(
+        stage == last,
+        lambda a: head_partials(a, labels, mask),
+        lambda a: (jnp.float32(0), jnp.float32(0)),
+        acc,
+    )
+    loss_local = nll_sum / n_global
+    loss = jax.lax.psum(loss_local, pipe_axis)
+    metrics = {
+        "loss": jax.lax.psum(jax.lax.psum(loss_local, seq_axis), pipe_axis),
+        "accuracy": jax.lax.psum(
+            jax.lax.psum(correct_sum, seq_axis), pipe_axis) / n_global,
+        "n_tokens": n_global / S,
+    }
+    return loss, metrics

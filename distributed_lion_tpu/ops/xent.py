@@ -255,6 +255,45 @@ def chunked_clm_loss_seq_parallel(
     }
 
 
+def masked_local_nll(
+    hidden: jnp.ndarray,
+    head: jnp.ndarray,
+    labels: jnp.ndarray,
+    mask: jnp.ndarray,
+    n_chunks: int = 0,
+    emb_layout: str = "vd",
+    valid_v: int = 0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """COLLECTIVE-FREE masked NLL partials: ``hidden`` [B, T, d] with
+    per-position ``labels``/``mask`` [B, T] → (masked nll sum, masked
+    correct sum), both f32 scalars. ``n_chunks > 0`` streams the head
+    through :func:`chunked_softmax_xent`; otherwise a dense log_softmax
+    (``valid_v`` slices a padded head's columns first).
+
+    Exists for losses that must run inside ``lax.cond`` — the pipelined
+    seq-parallel head computes only these local partials on the last stage
+    and leaves every psum/ppermute OUTSIDE the cond (XLA aborts on
+    collectives under conditional control flow even when all participants
+    agree on the branch)."""
+    b, t, d = hidden.shape
+    flat_labels = labels.reshape(-1).astype(jnp.int32)
+    if n_chunks > 0:
+        nll, correct = chunked_softmax_xent(
+            hidden.reshape(b * t, d), head, flat_labels, n_chunks,
+            emb_layout, valid_v)
+    else:
+        eq = "btd,vd->btv" if emb_layout == "vd" else "btd,dv->btv"
+        logits = jnp.einsum(eq, hidden, head.astype(hidden.dtype),
+                            preferred_element_type=jnp.float32)
+        if valid_v > 0:
+            logits = logits[..., :valid_v]
+        logp = jax.nn.log_softmax(logits.reshape(b * t, -1), axis=-1)
+        nll = -jnp.take_along_axis(logp, flat_labels[:, None], 1)[:, 0]
+        correct = logp.argmax(-1) == flat_labels
+    fm = mask.reshape(-1).astype(jnp.float32)
+    return (nll * fm).sum(), (correct.astype(jnp.float32) * fm).sum()
+
+
 def tp_vocab_clm_loss_and_metrics(
     hidden: jnp.ndarray,
     head_shard: jnp.ndarray,

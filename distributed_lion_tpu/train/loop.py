@@ -155,6 +155,23 @@ class TrainConfig:
         return constant_schedule(self.learning_rate)
 
 
+def validate_seq_block(cfg: "TrainConfig", model_cfg, sp: int) -> None:
+    """Config-time guards shared by every sequence-parallel path (plain,
+    pipelined, both families): tokens must split evenly over the seq axis,
+    and the TOTAL sequence must fit the positional scheme — without the
+    n_ctx check the wpe dynamic_slice clamps at the table end (later shards
+    silently duplicate positional rows) and rope offsets extrapolate."""
+    if cfg.block_size % sp:
+        raise ValueError(f"block_size {cfg.block_size} not divisible by "
+                         f"seq axis {sp}")
+    if cfg.block_size > model_cfg.n_ctx:
+        raise ValueError(
+            f"seq-parallel block_size {cfg.block_size} (total tokens across "
+            f"the {sp}-way seq axis) exceeds n_ctx {model_cfg.n_ctx}: the "
+            f"positional scheme (wpe table / rope range) is too small"
+        )
+
+
 def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
     """The reference's optimizer wiring (run_clm.py:580-585): ``--lion`` →
     Lion(lr, wd) else AdamW(wd=0.1 hardcoded); both under a cosine-warmup
@@ -780,12 +797,11 @@ class Trainer:
                 validate_pipeline,
             )
 
-            if (dict(mesh.shape).get(SEQ_AXIS, 1) > 1
-                    or dict(mesh.shape).get(EXPERT_AXIS, 1) > 1):
+            if dict(mesh.shape).get(EXPERT_AXIS, 1) > 1:
                 raise NotImplementedError(
-                    "pipeline parallelism composes with data and tensor "
-                    "parallelism (dp x tp x pp); seq/expert axes alongside "
-                    "pipe are not wired"
+                    "pipeline parallelism composes with data, tensor and "
+                    "sequence parallelism (dp x tp x sp x pp); an expert "
+                    "axis alongside pipe is not wired"
                 )
             if model_cfg.moe_experts > 0:
                 raise NotImplementedError(
@@ -799,12 +815,16 @@ class Trainer:
                 )
             if tp > 1:
                 validate_tp(model_cfg, tp, "gpt2")
+            sp_pipe = dict(mesh.shape).get(SEQ_AXIS, 1)
+            if sp_pipe > 1:
+                validate_seq_block(cfg, model_cfg, sp_pipe)
             n_micro = cfg.pipeline_microbatches or pp
             validate_pipeline(model_cfg, cfg, pp, n_micro)
             loss_fn = make_pipeline_loss(
                 model_cfg, n_micro,
                 tp_axis=TENSOR_AXIS if tp > 1 else None,
-                vocab_chunks=cfg.vocab_chunks)
+                vocab_chunks=cfg.vocab_chunks,
+                seq_axis=SEQ_AXIS if sp_pipe > 1 else None)
             if cfg.vocab_chunks > 0:
                 loss_fn._vocab_chunked = True  # consumed; don't trip the guard
             return Trainer(
@@ -813,6 +833,7 @@ class Trainer:
                 params=pipeline_params(params, pp),
                 param_specs=pipeline_param_specs(tensor=tp > 1),
                 loss_fn=loss_fn,
+                batch_spec=(P(DATA_AXIS, SEQ_AXIS) if sp_pipe > 1 else None),
             )
 
         ep = dict(mesh.shape).get(EXPERT_AXIS, 1)
@@ -904,19 +925,7 @@ class Trainer:
         batch_spec = None
         loss_fn = None
         if seq_axis:
-            if cfg.block_size % sp:
-                raise ValueError(f"block_size {cfg.block_size} not divisible by "
-                                 f"seq axis {sp}")
-            if cfg.block_size > model_cfg.n_ctx:
-                # each shard holds block_size/sp tokens at positions
-                # [sidx*T_local, ...); without this check the wpe
-                # dynamic_slice clamps at the table end and later shards get
-                # silently duplicated positional embeddings.
-                raise ValueError(
-                    f"seq-parallel block_size {cfg.block_size} (total tokens "
-                    f"across the {sp}-way seq axis) exceeds n_ctx "
-                    f"{model_cfg.n_ctx}: positional table too small"
-                )
+            validate_seq_block(cfg, model_cfg, sp)
             if model_cfg.dropout > 0.0:
                 print(
                     "[trainer] WARNING: attention-probability dropout is "
@@ -1040,12 +1049,6 @@ class Trainer:
                 validate_llama_pipeline,
             )
 
-            if dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
-                raise NotImplementedError(
-                    "pipeline parallelism composes with data and tensor "
-                    "parallelism (dp x tp x pp); a seq axis alongside pipe "
-                    "is not wired"
-                )
             if cfg.tp_vocab:
                 raise NotImplementedError(
                     "--tp_vocab under --pipeline_parallel is not wired (the "
@@ -1053,12 +1056,16 @@ class Trainer:
                 )
             if tp > 1:
                 validate_tp(model_cfg, tp, "llama")
+            sp_pipe = dict(mesh.shape).get(SEQ_AXIS, 1)
+            if sp_pipe > 1:
+                validate_seq_block(cfg, model_cfg, sp_pipe)
             n_micro = cfg.pipeline_microbatches or pp
             validate_llama_pipeline(model_cfg, cfg, pp, n_micro)
             loss_fn = make_llama_pipeline_loss(
                 model_cfg, n_micro,
                 tp_axis=TENSOR_AXIS if tp > 1 else None,
-                vocab_chunks=cfg.vocab_chunks)
+                vocab_chunks=cfg.vocab_chunks,
+                seq_axis=SEQ_AXIS if sp_pipe > 1 else None)
             if cfg.vocab_chunks > 0:
                 loss_fn._vocab_chunked = True  # consumed; don't trip the guard
             return Trainer(
@@ -1067,6 +1074,7 @@ class Trainer:
                 params=llama_pipeline_params(params, pp),
                 param_specs=llama_pipeline_param_specs(tensor=tp > 1),
                 loss_fn=loss_fn,
+                batch_spec=(P(DATA_AXIS, SEQ_AXIS) if sp_pipe > 1 else None),
             )
         if cfg.tp_vocab and tp <= 1:
             raise ValueError("--tp_vocab needs --tensor_parallel > 1 (it "
@@ -1099,14 +1107,7 @@ class Trainer:
                 "--tp_vocab under --seq_parallel is not wired; pick one"
             )
         if seq_axis:
-            if cfg.block_size % sp:
-                raise ValueError(f"block_size {cfg.block_size} not divisible "
-                                 f"by seq axis {sp}")
-            if cfg.block_size > model_cfg.n_ctx:
-                raise ValueError(
-                    f"seq-parallel block_size {cfg.block_size} exceeds n_ctx "
-                    f"{model_cfg.n_ctx}: rope offsets would extrapolate"
-                )
+            validate_seq_block(cfg, model_cfg, sp)
             batch_spec = P(DATA_AXIS, SEQ_AXIS)
 
             if cfg.vocab_chunks > 0:

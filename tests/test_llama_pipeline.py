@@ -125,9 +125,31 @@ def test_llama_pp_guards():
     with pytest.raises(ValueError, match="divisible"):
         Trainer.for_llama(_cfg(pipeline_parallel=4), mesh,
                           LlamaConfig.tiny(n_layer=3))
-    with pytest.raises(NotImplementedError, match="seq axis"):
-        Trainer.for_llama(_cfg(pipeline_parallel=2, seq_parallel=2),
-                          make_mesh(data=2, seq=2, pipe=2), MODEL)
+    with pytest.raises(NotImplementedError, match="tp_vocab"):
+        Trainer.for_llama(_cfg(pipeline_parallel=2, tensor_parallel=2,
+                               tp_vocab=True),
+                          make_mesh(data=2, tensor=2, pipe=2), MODEL)
+
+
+@pytest.mark.parametrize("chunks", [0, 4], ids=["dense", "chunked"])
+def test_llama_sp_pp_trajectory_matches_dp(chunks):
+    """dp=2 x sp=2 x pp=2 ≡ dp=2: ring attention inside every pipeline
+    tick, rope offsets per seq shard, seq-parallel CE at the last stage —
+    dense AND chunked (dv-layout) heads."""
+    from distributed_lion_tpu.models.llama_pipe import llama_unpipeline_params
+
+    losses_dp, params_dp = _train(
+        make_mesh(data=2, devices=jax.devices()[:2]), _cfg(vocab_chunks=chunks))
+    losses_sp, params_sp = _train(
+        make_mesh(data=2, seq=2, pipe=2),
+        _cfg(seq_parallel=2, pipeline_parallel=2, pipeline_microbatches=2,
+             vocab_chunks=chunks))
+    np.testing.assert_allclose(losses_sp, losses_dp, rtol=1e-4, atol=1e-4)
+    restored = llama_unpipeline_params(params_sp, MODEL.n_layer)
+    envelope = 2 * 1e-3 * 5
+    for a, b in zip(jax.tree.leaves(params_dp), jax.tree.leaves(restored)):
+        assert np.abs(a.astype(np.float64) - b.astype(np.float64)).max() \
+            <= envelope
 
 
 def test_run_clm_cli_llama_pp_smoke():
