@@ -164,9 +164,6 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
         stage_local = jax.tree.map(lambda a: a[0], params["stages"])
         acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
 
-        stage = lax.axis_index(axis_name)
-        last = lax.psum(1, axis_name) - 1
-
         if seq_axis is not None:
             # sp × pp scaffold (collective hoisting + grad contract) lives
             # in models/loss.pipelined_seq_parallel_loss, shared with
@@ -214,6 +211,8 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
         # (expensive) vocab projection + loss on the other stages entirely —
         # XLA executes just the taken branch — and the psum then both
         # broadcasts the value and routes zero cotangent to the skip branch
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
         loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
         loss = lax.psum(loss_local, axis_name)
         metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}

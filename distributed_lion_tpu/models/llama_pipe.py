@@ -129,9 +129,6 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
         stage_local = jax.tree.map(lambda a: a[0], params["stages"])
         acc = pipeline_apply(layer_fn, stage_local, xm, axis_name=axis_name)
 
-        stage = lax.axis_index(axis_name)
-        last = lax.psum(1, axis_name) - 1
-
         if seq_axis is not None:
             # sp × pp scaffold (collective hoisting + grad contract) shared
             # with gpt2_pipe: models/loss.pipelined_seq_parallel_loss.
@@ -174,6 +171,8 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
         # only the last stage saw real activations (see gpt2_pipe: cond
         # skips the vocab projection elsewhere; the psum broadcasts the
         # value and routes zero cotangent into the skip branch)
+        stage = lax.axis_index(axis_name)
+        last = lax.psum(1, axis_name) - 1
         loss_local, metrics = lax.cond(stage == last, head_loss, skip_loss, acc)
         loss = lax.psum(loss_local, axis_name)
         metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}

@@ -107,6 +107,19 @@ def shift_in_next_shard(
     return jnp.concatenate([x[:, 1:], nxt], axis=1), sidx == S - 1
 
 
+def shifted_labels_and_mask(
+    tokens: jnp.ndarray, axis_name: str
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`shift_in_next_shard` plus the boundary MASK — the other half
+    of the shard-boundary protocol (the final shard's last position has no
+    next token and must not count), in one place so no caller hand-rolls
+    it. Returns ``(labels [B, T_local], mask [B, T_local] f32)``."""
+    labels, is_last = shift_in_next_shard(tokens, axis_name)
+    mask = jnp.ones(labels.shape, jnp.float32)
+    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+    return labels, mask
+
+
 def clm_loss_seq_parallel(
     logits: jnp.ndarray,
     tokens: jnp.ndarray,
@@ -130,9 +143,7 @@ def clm_loss_seq_parallel(
     S = jax.lax.psum(1, axis_name)
     # my last position's label = next shard's first token (shard i gets it
     # from shard i+1; shard S-1 receives garbage from shard 0 and masks it)
-    labels, is_last = shift_in_next_shard(tokens, axis_name)  # [B, T_local]
-    mask = jnp.ones(labels.shape, jnp.float32)
-    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+    labels, mask = shifted_labels_and_mask(tokens, axis_name)  # [B, T_local]
 
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
@@ -169,9 +180,7 @@ def pipelined_seq_parallel_loss(head_partials, acc, tokens, seq_axis: str,
     Returns ``(loss, metrics)`` in the Trainer's contract; metrics are
     globally reduced, ``n_tokens`` is the per-seq-shard average (the seq
     loss's logging convention, uniform across pipe)."""
-    labels, is_last = shift_in_next_shard(tokens, seq_axis)
-    mask = jnp.ones(labels.shape, jnp.float32)
-    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+    labels, mask = shifted_labels_and_mask(tokens, seq_axis)
     S = jax.lax.psum(1, seq_axis)
     n_global = jnp.maximum(jax.lax.psum(mask.sum(), seq_axis), 1.0)
 

@@ -232,25 +232,18 @@ def chunked_clm_loss_seq_parallel(
     clm_loss_seq_parallel: returns ``local_nll_sum / global_token_count``
     whose seq-axis grad psum (done by the train loop) is the full gradient.
     """
-    from distributed_lion_tpu.models.loss import shift_in_next_shard
+    from distributed_lion_tpu.models.loss import shifted_labels_and_mask
 
     S = jax.lax.psum(1, axis_name)
-    labels, is_last = shift_in_next_shard(tokens, axis_name)  # [B, T_local]
-    mask = jnp.ones(labels.shape, jnp.float32)
-    mask = mask.at[:, -1].set(jnp.where(is_last, 0.0, 1.0))
+    labels, mask = shifted_labels_and_mask(tokens, axis_name)  # [B, T_local]
 
-    b, t, d = hidden.shape
-    nll, correct = chunked_softmax_xent(
-        hidden.reshape(b * t, d), emb,
-        labels.reshape(-1).astype(jnp.int32), n_chunks, emb_layout, valid_v)
-    flat_mask = mask.reshape(-1)
-    n_global = jnp.maximum(jax.lax.psum(flat_mask.sum(), axis_name), 1.0)
-    loss_local = (nll * flat_mask).sum() / n_global
-    acc = jax.lax.psum(
-        (correct.astype(jnp.float32) * flat_mask).sum(), axis_name) / n_global
+    nll_sum, correct_sum = masked_local_nll(
+        hidden, emb, labels, mask, n_chunks, emb_layout, valid_v)
+    n_global = jnp.maximum(jax.lax.psum(mask.sum(), axis_name), 1.0)
+    loss_local = nll_sum / n_global
     return loss_local, {
         "loss": jax.lax.psum(loss_local, axis_name),
-        "accuracy": acc,
+        "accuracy": jax.lax.psum(correct_sum, axis_name) / n_global,
         "n_tokens": n_global / jnp.maximum(S, 1),
     }
 

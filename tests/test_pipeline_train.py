@@ -259,3 +259,32 @@ def test_sp_pp_chunked_trajectory_matches_dp(chunks):
         mismatched += int((d > 1e-6).sum())
         total += d.size
     assert mismatched / total < 0.02, f"{mismatched}/{total} params flipped"
+
+
+def test_tp_sp_pp_full_composition_matches_dp():
+    """The whole mesh at once — tp=2 x sp=2 x pp=2 (+ chunked CE) ≡ plain
+    single-device training: Megatron sharding inside GPipe stages whose
+    attention rings over the seq axis, streamed CE at the last stage."""
+    from distributed_lion_tpu.models.gpt2_pipe import unpipeline_params
+
+    model_f32 = dataclasses.replace(MODEL, compute_dtype=jax.numpy.float32)
+    losses_dp, params_dp = _train(
+        make_mesh(data=1, devices=jax.devices()[:1]),
+        _cfg(vocab_chunks=4, per_device_train_batch_size=8),
+        n_steps=5, model=model_f32)
+    losses_x, params_x = _train(
+        make_mesh(data=1, tensor=2, seq=2, pipe=2),
+        _cfg(tensor_parallel=2, seq_parallel=2, pipeline_parallel=2,
+             pipeline_microbatches=2, vocab_chunks=4,
+             per_device_train_batch_size=8),
+        n_steps=5, model=model_f32)
+    np.testing.assert_allclose(losses_x, losses_dp, rtol=1e-4, atol=1e-4)
+    restored = unpipeline_params(params_x, MODEL.n_layer)
+    envelope = 2 * 1e-3 * 5
+    total = mismatched = 0
+    for a, b in zip(jax.tree.leaves(params_dp), jax.tree.leaves(restored)):
+        d = np.abs(a.astype(np.float64) - b.astype(np.float64))
+        assert d.max() <= envelope, d.max()
+        mismatched += int((d > 1e-6).sum())
+        total += d.size
+    assert mismatched / total < 0.02, f"{mismatched}/{total} params flipped"
