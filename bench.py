@@ -146,11 +146,12 @@ def run_inner() -> None:
                                         vocab_pad_multiple=vocab_pad)
     from distributed_lion_tpu.ops.attention import parse_attn_spec
 
-    attn_impl, bq, bkv = parse_attn_spec(attn_spec)
+    attn_impl, bq, bkv, bqb, bkvb = parse_attn_spec(attn_spec)
     if attn_spec != "xla":
         model_cfg = dataclasses.replace(
             model_cfg, attn_impl=attn_impl,
-            flash_block_q=bq, flash_block_kv=bkv)
+            flash_block_q=bq, flash_block_kv=bkv,
+            flash_block_q_bwd=bqb, flash_block_kv_bwd=bkvb)
     cfg = TrainConfig(
         lion=True,
         async_grad=True,

@@ -45,6 +45,8 @@ class GPT2Config:
     attn_impl: str = "auto"  # ops.attention: auto | xla | xla_bf16 | flash | splash
     flash_block_q: int = 0   # flash kernel tile overrides (0 = defaults);
     flash_block_kv: int = 0  # see ops.attention.attention_flash
+    flash_block_q_bwd: int = 0   # backward-pass tile overrides (0 = inherit
+    flash_block_kv_bwd: int = 0  # the fwd tiles); spec impl@FWD@BWD
     seq_impl: str = "ring"   # sequence-parallel attention: 'ring' (k/v
     # blocks rotate over the seq axis — O(T/S) memory, any head count) or
     # 'ulysses' (all_to_all to head sharding — needs n_head % sp == 0,
@@ -258,7 +260,9 @@ def _attention(x, p, cfg: GPT2Config, key, tp_axis=None, seq_axis=None):
     else:
         out = shared_attention(q, k, v, causal=True, impl=cfg.attn_impl,
                                block_q=cfg.flash_block_q,
-                               block_kv=cfg.flash_block_kv)
+                               block_kv=cfg.flash_block_kv,
+                               block_q_bwd=cfg.flash_block_q_bwd,
+                               block_kv_bwd=cfg.flash_block_kv_bwd)
     out = out.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     out = _proj(out, p["proj"])
     if tp_axis is not None:

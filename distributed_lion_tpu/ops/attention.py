@@ -58,11 +58,15 @@ def attention_xla(q, k, v, *, causal: bool = True,
 
 
 def attention_flash(q, k, v, *, causal: bool = True,
-                    block_q: int = 0, block_kv: int = 0):
+                    block_q: int = 0, block_kv: int = 0,
+                    block_q_bwd: int = 0, block_kv_bwd: int = 0):
     """Pallas TPU flash attention. ``block_q``/``block_kv`` override the
     kernel's VMEM tile sizes (0 = library defaults); exposed because the
     default blocking lost to XLA at T=1024 on v5e (scripts/SWEEP_v5e.md) and
-    tile shape is the first knob to turn."""
+    tile shape is the first knob to turn. ``block_q_bwd``/``block_kv_bwd``
+    tune the dq/dkv backward passes independently (0 = inherit fwd) — the
+    backward is ~2× the fwd FLOPs with different operand shapes, so its
+    optimum tile need not match the forward's."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         flash_attention,
@@ -70,14 +74,16 @@ def attention_flash(q, k, v, *, causal: bool = True,
 
     T = q.shape[2]
     bs = None
-    if block_q or block_kv:
+    if block_q or block_kv or block_q_bwd or block_kv_bwd:
         bq = min(block_q or 512, T)
         bkv = min(block_kv or 512, T)
+        bqb = min(block_q_bwd or bq, T)
+        bkvb = min(block_kv_bwd or bkv, T)
         bs = BlockSizes(
             block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
-            block_q_major_dkv=bq, block_k_major_dkv=bkv, block_k_dkv=bkv,
-            block_q_dkv=bq, block_k_major_dq=bkv, block_k_dq=bkv,
-            block_q_dq=bq,
+            block_q_major_dkv=bqb, block_k_major_dkv=bkvb, block_k_dkv=bkvb,
+            block_q_dkv=bqb, block_k_major_dq=bkvb, block_k_dq=bkvb,
+            block_q_dq=bqb,
         )
     return flash_attention(
         q, k, v, causal=causal, sm_scale=1.0 / math.sqrt(q.shape[-1]),
@@ -115,26 +121,40 @@ def attention_splash(q, k, v, *, causal: bool = True,
     return out.astype(q.dtype)
 
 
-def parse_attn_spec(spec: str) -> tuple[str, int, int]:
-    """Parse an attention spec string ``impl[@BQxBKV]`` into
-    ``(impl, block_q, block_kv)`` — e.g. ``"flash@512x1024"`` →
-    ``("flash", 512, 1024)``; no ``@`` → blocks 0 (kernel defaults).
-    The one grammar shared by bench.py's BENCH_ATTN env knob and
-    scripts/bench_sweep.py's config specs."""
+def parse_attn_spec(spec: str) -> tuple[str, int, int, int, int]:
+    """Parse an attention spec ``impl[@BQxBKV[@BQBxBKVB]]`` into
+    ``(impl, block_q, block_kv, block_q_bwd, block_kv_bwd)`` — e.g.
+    ``"flash@512x1024"`` → ``("flash", 512, 1024, 0, 0)`` and
+    ``"flash@512x1024@256x512"`` tunes the BACKWARD tiles independently
+    (the bwd passes are ~2× the fwd FLOPs with different operand shapes,
+    so their optimum need not match; 0 = inherit the fwd tiles). No ``@``
+    → all 0 (kernel defaults). The one grammar shared by bench.py's
+    BENCH_ATTN env knob and scripts/bench_sweep.py's config specs."""
     if "@" not in spec:
-        return spec, 0, 0
-    impl, blocks = spec.split("@", 1)
-    bq, bkv = (int(x) for x in blocks.split("x"))
-    return impl, bq, bkv
+        return spec, 0, 0, 0, 0
+    impl, _, blocks = spec.partition("@")
+    fwd, _, bwd = blocks.partition("@")
+    bq, bkv = (int(x) for x in fwd.split("x"))
+    bqb, bkvb = (int(x) for x in bwd.split("x")) if bwd else (0, 0)
+    return impl, bq, bkv, bqb, bkvb
 
 
 def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
-              block_q: int = 0, block_kv: int = 0):
+              block_q: int = 0, block_kv: int = 0,
+              block_q_bwd: int = 0, block_kv_bwd: int = 0):
     if impl == "auto":
         impl = "flash" if (jax.default_backend() == "tpu" and q.shape[2] >= 2048) else "xla"
     if impl == "flash":
         return attention_flash(q, k, v, causal=causal,
-                               block_q=block_q, block_kv=block_kv)
+                               block_q=block_q, block_kv=block_kv,
+                               block_q_bwd=block_q_bwd,
+                               block_kv_bwd=block_kv_bwd)
+    if block_q_bwd or block_kv_bwd:
+        # fail loudly: a sweep config like splash@128x256@64x128 would
+        # otherwise run, report numbers, and silently tune nothing
+        raise ValueError(
+            f"backward-tile overrides (@BQBxBKVB) are a flash-kernel knob; "
+            f"impl {impl!r} does not consume them")
     if impl == "splash":
         return attention_splash(q, k, v, causal=causal,
                                 block_q=block_q, block_kv=block_kv)

@@ -39,11 +39,12 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
     from distributed_lion_tpu.ops.attention import parse_attn_spec
 
     attn_spec = attn_impl
-    attn_impl, bq, bkv = parse_attn_spec(attn_spec)
+    attn_impl, bq, bkv, bqb, bkvb = parse_attn_spec(attn_spec)
     model_cfg = dataclasses.replace(
         GPT2Config.gpt2_124m(), remat=remat != "noremat",
         remat_policy="dots" if remat == "dots" else "full",
         attn_impl=attn_impl, flash_block_q=bq, flash_block_kv=bkv,
+        flash_block_q_bwd=bqb, flash_block_kv_bwd=bkvb,
         param_dtype=jnp.bfloat16 if dtype == "bf16" else jnp.float32,
         vocab_pad_multiple=vocab_pad,
     )

@@ -29,6 +29,8 @@ timeout 2400 python scripts/bench_sweep.py \
     noremat:4:flash@256x1024:16:bf16:8:bfloat16 \
     noremat:4:xla_bf16:16:bf16:8:bfloat16 \
     noremat:4:flash@512x1024:16:bf16:16:bfloat16 \
+    noremat:4:flash@512x1024@256x512:16:bf16:8:bfloat16 \
+    noremat:4:flash@512x1024@512x512:16:bf16:8:bfloat16 \
     >> "$OUT/sweep2.jsonl" 2>> "$OUT/sweep2.err"
 rc=$?; echo "$(stamp) sweep2 rc=$rc" | tee -a "$OUT/log.txt"
 

@@ -85,3 +85,24 @@ def test_xla_bf16_close_to_xla():
         assert bool(jnp.all(jnp.isfinite(b)))
         rel = float(jnp.abs(a - b).max() / (jnp.abs(a).max() + 1e-9))
         assert rel < 5e-2, rel
+
+
+def test_parse_attn_spec_grammar():
+    """impl[@BQxBKV[@BQBxBKVB]] — fwd-only, fwd+bwd, and bare forms."""
+    from distributed_lion_tpu.ops.attention import parse_attn_spec
+
+    assert parse_attn_spec("xla") == ("xla", 0, 0, 0, 0)
+    assert parse_attn_spec("flash@512x1024") == ("flash", 512, 1024, 0, 0)
+    assert parse_attn_spec("flash@512x1024@256x512") == \
+        ("flash", 512, 1024, 256, 512)
+    assert parse_attn_spec("splash@128x256") == ("splash", 128, 256, 0, 0)
+
+
+def test_bwd_tiles_refused_off_flash():
+    from distributed_lion_tpu.ops.attention import attention
+
+    q = k = v = jnp.zeros((1, 2, 8, 4), jnp.float32)
+    with pytest.raises(ValueError, match="flash-kernel knob"):
+        attention(q, k, v, impl="splash", block_q_bwd=64)
+    with pytest.raises(ValueError, match="flash-kernel knob"):
+        attention(q, k, v, impl="xla", block_kv_bwd=128)

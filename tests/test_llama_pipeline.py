@@ -199,3 +199,25 @@ def test_llama_pp_chunked_head_matches_dense():
         llama_apply(params, tokens, MODEL), tokens)
     np.testing.assert_allclose(float(loss_pp), float(loss_seq),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_llama_tp_sp_pp_full_composition_matches_dp():
+    """The Llama twin of the full-mesh pin: tp=2 x sp=2 x pp=2 + chunked
+    dv-head CE ≡ plain single-device training (rotary offsets composing
+    with Megatron sharding inside ring-attention GPipe ticks)."""
+    from distributed_lion_tpu.models.llama_pipe import llama_unpipeline_params
+
+    losses_dp, params_dp = _train(
+        make_mesh(data=1, devices=jax.devices()[:1]),
+        _cfg(vocab_chunks=4, per_device_train_batch_size=8))
+    losses_x, params_x = _train(
+        make_mesh(data=1, tensor=2, seq=2, pipe=2),
+        _cfg(tensor_parallel=2, seq_parallel=2, pipeline_parallel=2,
+             pipeline_microbatches=2, vocab_chunks=4,
+             per_device_train_batch_size=8))
+    np.testing.assert_allclose(losses_x, losses_dp, rtol=1e-4, atol=1e-4)
+    restored = llama_unpipeline_params(params_x, MODEL.n_layer)
+    envelope = 2 * 1e-3 * 5
+    for a, b in zip(jax.tree.leaves(params_dp), jax.tree.leaves(restored)):
+        assert np.abs(a.astype(np.float64) - b.astype(np.float64)).max() \
+            <= envelope
