@@ -1,0 +1,54 @@
+#!/bin/bash
+# Re-arming TPU watcher: keep firing the (idempotent) stage-2 runbook on
+# every tunnel recovery until ALL round-3 evidence exists, so a mid-run
+# tunnel drop costs one partial window instead of the whole round.
+#
+#   nohup scripts/tpu_watch_loop.sh > /tmp/tpu_watch_loop.log 2>&1 &
+#
+# Evidence-complete = 7B rows in sft7b2.jsonl AND all three 2000-step
+# parity legs (the runbook's own per-stage guards skip captured stages).
+set -u
+cd "$(dirname "$0")/.."
+stamp() { date -u +%FT%TZ; }
+
+complete() {
+  grep -q tokens_per_sec scripts/SWEEP_r3_raw/sft7b2.jsonl 2>/dev/null || return 1
+  for mode in local vote lazy; do
+    python - "$mode" <<'EOF' || return 1
+import json, sys
+try:
+    with open(f"runs/parity/{sys.argv[1]}.jsonl") as f:
+        last = 0
+        for line in f:
+            try:
+                last = max(last, json.loads(line).get("step", 0))
+            except json.JSONDecodeError:
+                pass
+    sys.exit(0 if last >= 1900 else 1)
+except OSError:
+    sys.exit(1)
+EOF
+  done
+  return 0
+}
+
+while true; do
+  if complete; then
+    echo "$(stamp) all round-3 evidence captured; watcher exiting"
+    exit 0
+  fi
+  out=$(timeout 120 python -c \
+    "import jax; d=jax.devices(); print(len(d), d[0].platform)" 2>/dev/null)
+  case "$out" in
+    *tpu*)
+      echo "$(stamp) TPU up ($out); running stage-2 runbook"
+      bash scripts/tpu_runbook_auto2.sh
+      echo "$(stamp) runbook exited; re-checking evidence"
+      ;;
+    "")
+      echo "$(stamp) probe timed out/failed" ;;
+    *)
+      echo "$(stamp) backend: $out (not tpu)" ;;
+  esac
+  sleep 120
+done
