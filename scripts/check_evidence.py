@@ -1,0 +1,83 @@
+"""Single source of truth for "is this round-3 TPU evidence captured?" —
+shared by the idempotent runbook (scripts/tpu_runbook_auto2.sh, per-stage
+skip guards) and the re-arming watcher (scripts/tpu_watch_loop.sh, exit
+condition), so the two can never disagree about what "captured" means.
+
+    python scripts/check_evidence.py parity local   # exit 0 = captured
+    python scripts/check_evidence.py sweep2
+    python scripts/check_evidence.py sft7b
+    python scripts/check_evidence.py bench_best
+    python scripts/check_evidence.py all
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "scripts", "SWEEP_r3_raw")
+PARITY_MIN_STEP = 1900
+
+# the LAST config of the runbook's sweep window / 7B spec list: the stages
+# run sequentially and bench_sweep/bench_sft_7b emit a row (result OR
+# error) per config before moving on, so the last config's row implies the
+# whole window executed
+SWEEP2_LAST_CONFIG = "512x1024@512x512"
+SFT7B_LAST_SPEC = "2048"
+
+
+def parity(mode: str) -> bool:
+    try:
+        last = 0
+        with open(os.path.join(REPO, "runs", "parity", f"{mode}.jsonl")) as f:
+            for line in f:
+                try:
+                    last = max(last, json.loads(line).get("step", 0))
+                except json.JSONDecodeError:
+                    pass
+        return last >= PARITY_MIN_STEP
+    except OSError:
+        return False
+
+
+def _file_contains(path: str, needle: str) -> bool:
+    try:
+        with open(path) as f:
+            return needle in f.read()
+    except OSError:
+        return False
+
+
+def sweep2() -> bool:
+    return _file_contains(os.path.join(OUT, "sweep2.jsonl"),
+                          SWEEP2_LAST_CONFIG)
+
+
+def sft7b() -> bool:
+    return _file_contains(os.path.join(OUT, "sft7b2.jsonl"), SFT7B_LAST_SPEC)
+
+
+def bench_best() -> bool:
+    return os.path.exists(os.path.join(OUT, "bench_best.done"))
+
+
+def check(what: str, arg: str | None = None) -> bool:
+    if what == "parity":
+        return parity(arg or "local")
+    if what == "sweep2":
+        return sweep2()
+    if what == "sft7b":
+        return sft7b()
+    if what == "bench_best":
+        return bench_best()
+    if what == "all":
+        return (sweep2() and bench_best() and sft7b()
+                and all(parity(m) for m in ("local", "vote", "lazy")))
+    raise SystemExit(f"unknown evidence check {what!r}")
+
+
+if __name__ == "__main__":
+    ok = check(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    sys.exit(0 if ok else 1)

@@ -24,10 +24,12 @@ echo "$(stamp) stage-2 runbook start" | tee -a "$OUT/log.txt"
 # APPEND (>>): sweep2.jsonl already holds the first combo window's banked
 # winner (flash@512x1024+chunks8+bf16mom = 98,099 tok/s). Only the configs
 # that window did NOT reach run here; flash@1024x1024 is excluded — its
-# remote_compile hung >14 min and had to be killed. Completion marker: a
-# result row with vocab_pad 1024 (this window's first config).
-if grep -q '"vocab_pad": 1024.*tokens_per_sec' "$OUT/sweep2.jsonl" 2>/dev/null; then
-  echo "$(stamp) sweep2 already captured (vocab_pad row present) — skip" | tee -a "$OUT/log.txt"
+# remote_compile hung >14 min and had to be killed. Completion marker
+# (check_evidence.py sweep2): the LAST window config's row — stages run
+# sequentially and every config emits a row (result or error), so the last
+# row implies the whole window executed.
+if python scripts/check_evidence.py sweep2; then
+  echo "$(stamp) sweep2 already captured (last window config present) — skip" | tee -a "$OUT/log.txt"
 else
   timeout 2400 python scripts/bench_sweep.py \
       noremat:4:flash@512x1024:16:bf16:8:bfloat16:1024 \
@@ -45,8 +47,14 @@ else
 fi
 
 # pick the sweep2 winner and re-bench bench.py under it via env knobs so
-# last_tpu_measurement.json reflects the best measured config. Skip when
-# the recorded headline already beats every sweep row (re-bench captured).
+# last_tpu_measurement.json reflects the best measured config. The
+# bench_best.done marker (written after any successful TPU re-bench) makes
+# this stage run at most once: without it, a re-bench that measures BELOW
+# its sweep row would leave recorded < best forever and re-burn ~20 min of
+# chip on every watcher recovery.
+if python scripts/check_evidence.py bench_best; then
+  echo "$(stamp) bench(best) already captured — skip" | tee -a "$OUT/log.txt"
+else
 python - "$OUT" > "$OUT/winner.env" <<'EOF'
 import json, sys
 rows = []
@@ -93,6 +101,9 @@ cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_best" 2>/dev/null || tru
 timeout 1200 python bench.py > "$OUT/bench_best.json" 2> "$OUT/bench_best.err"
 rc=$?; echo "$(stamp) bench(best) rc=$rc" | tee -a "$OUT/log.txt"
 unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM BENCH_VOCAB_PAD
+if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' "$OUT/bench_best.json"; then
+  date -u +%FT%TZ > "$OUT/bench_best.done"
+fi
 python - "$OUT" >> "$OUT/log.txt" <<'EOF'
 import json, sys
 out = sys.argv[1]
@@ -113,12 +124,13 @@ else:
     print(f"bench(best) {new} >= prior {old}: new headline artifact kept")
 EOF
 fi
+fi
 
 # 7B QLoRA evidence with the FIXED spec parser + host-side init (the
 # "axon,cpu" platform list exposes the host backend the init path uses;
 # axon stays first = default, so compute still runs on the chip)
-if grep -q tokens_per_sec "$OUT/sft7b2.jsonl" 2>/dev/null; then
-  echo "$(stamp) 7B already captured — skip" | tee -a "$OUT/log.txt"
+if python scripts/check_evidence.py sft7b; then
+  echo "$(stamp) 7B already captured (last spec row present) — skip" | tee -a "$OUT/log.txt"
 else
   timeout 3000 env JAX_PLATFORMS=axon,cpu \
       python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
@@ -127,25 +139,8 @@ else
   rc=$?; echo "$(stamp) 7b(fixed) rc=$rc" | tee -a "$OUT/log.txt"
 fi
 
-parity_done() {  # a leg counts as captured at >= 1900 logged steps
-  python - "$1" <<'EOF'
-import json, sys
-try:
-    with open(f"runs/parity/{sys.argv[1]}.jsonl") as f:
-        last = 0
-        for line in f:
-            try:
-                last = max(last, json.loads(line).get("step", 0))
-            except json.JSONDecodeError:
-                pass
-    sys.exit(0 if last >= 1900 else 1)
-except OSError:
-    sys.exit(1)
-EOF
-}
-
 for mode in local vote lazy; do
-  if parity_done "$mode"; then
+  if python scripts/check_evidence.py parity "$mode"; then
     echo "$(stamp) parity:$mode already captured — skip" | tee -a "$OUT/log.txt"
     continue
   fi

@@ -5,31 +5,16 @@
 #
 #   nohup scripts/tpu_watch_loop.sh > /tmp/tpu_watch_loop.log 2>&1 &
 #
-# Evidence-complete = 7B rows in sft7b2.jsonl AND all three 2000-step
-# parity legs (the runbook's own per-stage guards skip captured stages).
+# Evidence-complete per scripts/check_evidence.py `all` — the ONE shared
+# definition the runbook's per-stage skip guards also use: the sweep
+# window's last config, the bench_best.done marker, the 7B spec list's
+# last spec, and all three 2000-step parity legs.
 set -u
 cd "$(dirname "$0")/.."
 stamp() { date -u +%FT%TZ; }
 
 complete() {
-  grep -q tokens_per_sec scripts/SWEEP_r3_raw/sft7b2.jsonl 2>/dev/null || return 1
-  for mode in local vote lazy; do
-    python - "$mode" <<'EOF' || return 1
-import json, sys
-try:
-    with open(f"runs/parity/{sys.argv[1]}.jsonl") as f:
-        last = 0
-        for line in f:
-            try:
-                last = max(last, json.loads(line).get("step", 0))
-            except json.JSONDecodeError:
-                pass
-    sys.exit(0 if last >= 1900 else 1)
-except OSError:
-    sys.exit(1)
-EOF
-  done
-  return 0
+  python scripts/check_evidence.py all
 }
 
 while true; do
