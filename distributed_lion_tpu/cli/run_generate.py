@@ -30,6 +30,7 @@ class GenerateArguments:
     max_new_tokens: int = 64
     temperature: float = 0.8
     top_k: Optional[int] = 40
+    top_p: Optional[float] = None  # nucleus sampling mass (e.g. 0.95)
     seed: int = 0
     vocab_size: Optional[int] = None
 
@@ -130,7 +131,8 @@ def main(argv=None):
     out = generate(
         decode, init_cache, params, prompt, args.max_new_tokens,
         key=jax.random.key(args.seed), temperature=args.temperature,
-        top_k=args.top_k, eos_id=getattr(tok, "eos_id", None),
+        top_k=args.top_k, top_p=args.top_p,
+        eos_id=getattr(tok, "eos_id", None),
     )
     text = tok.decode([int(t) for t in out[0]])
     print(args.prompt + text)

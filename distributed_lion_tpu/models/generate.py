@@ -5,8 +5,8 @@ its three scripts only train). TPU-first design: the whole generation runs
 as ONE jitted ``lax.scan`` over decode steps — static shapes (fixed-size KV
 cache written at a position index), no host round-trip per token.
 
-Sampling: greedy (``temperature=0``), temperature, and top-k, with explicit
-PRNG keys. EOS handling: once a row emits ``eos_id`` every later position is
+Sampling: greedy (``temperature=0``), temperature, top-k, and top-p
+(nucleus), with explicit PRNG keys. EOS handling: once a row emits ``eos_id`` every later position is
 padded with ``pad_id`` (the sampled token is masked), so finished rows cost
 no extra host logic.
 """
@@ -22,23 +22,52 @@ from jax import lax
 
 
 def sample_logits(logits: jnp.ndarray, key, temperature: float = 1.0,
-                  top_k: Optional[int] = None) -> jnp.ndarray:
-    """[B, V] logits → [B] sampled token ids."""
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """[B, V] logits → [B] sampled token ids.
+
+    ``top_p`` is nucleus sampling (HF ``generate`` convention): keep the
+    smallest descending-probability prefix whose mass reaches ``top_p``
+    (the EXCLUSIVE-cumulative test below always keeps the top token, so
+    top_p → 0 degrades to greedy, not to an empty support). Composes with
+    top_k (filter intersection) and temperature (applied first, as HF's
+    logits-processor ordering does)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    if top_k is not None:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_k is not None or top_p is not None:
+        # ONE descending argsort serves both filters (each runs inside the
+        # jitted per-token decode step — no duplicated O(B·V log V) sort)
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        v = logits.shape[-1]
+        keep_sorted = jnp.ones(sorted_logits.shape, bool)
+        if top_k is not None:
+            keep_sorted &= jnp.arange(v)[None, :] < top_k
+        if top_p is not None:
+            # HF warper ordering: nucleus mass over the top-k-FILTERED
+            # distribution; exclusive cumulative mass BEFORE each token
+            probs = jax.nn.softmax(
+                jnp.where(keep_sorted, sorted_logits, -jnp.inf), axis=-1)
+            before = jnp.cumsum(probs, axis=-1) - probs
+            keep_sorted &= before < top_p
+        # the best token ALWAYS survives — top_p <= 0 (or top_k <= 0)
+        # degrades to greedy instead of an all-masked row that categorical
+        # would silently turn into token id 0
+        keep_sorted = keep_sorted.at[:, 0].set(True)
+        keep = jnp.zeros_like(keep_sorted).at[
+            jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return jax.random.categorical(key, logits, axis=-1)
 
 
 @partial(jax.jit, static_argnames=("decode_fn", "init_cache_fn", "max_new_tokens",
-                                   "temperature", "top_k", "eos_id", "pad_id",
-                                   "max_len"))
+                                   "temperature", "top_k", "top_p", "eos_id",
+                                   "pad_id", "max_len"))
 def generate(decode_fn, init_cache_fn, params, prompt: jnp.ndarray,
              max_new_tokens: int, *, key=None, temperature: float = 0.0,
-             top_k: Optional[int] = None, eos_id: Optional[int] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             eos_id: Optional[int] = None,
              pad_id: int = 0, max_len: Optional[int] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for ``prompt`` [B, T].
 
@@ -53,14 +82,14 @@ def generate(decode_fn, init_cache_fn, params, prompt: jnp.ndarray,
     key = key if key is not None else jax.random.key(0)
 
     logits, cache = decode_fn(params, prompt, cache, 0)  # prefill
-    tok = sample_logits(logits[:, -1], key, temperature, top_k)
+    tok = sample_logits(logits[:, -1], key, temperature, top_k, top_p)
     finished = jnp.zeros((B,), bool) if eos_id is None else tok == eos_id
 
     def step(carry, i):
         tok, cache, finished, key = carry
         key, sub = jax.random.split(key)
         logits, cache = decode_fn(params, tok[:, None], cache, T + i)
-        nxt = sample_logits(logits[:, -1], sub, temperature, top_k)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(finished, pad_id, nxt)
             finished = finished | (nxt == eos_id)

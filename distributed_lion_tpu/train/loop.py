@@ -288,7 +288,7 @@ class Trainer:
             # silently ignore the CLI-auto-exposed flag.
             raise NotImplementedError(
                 "--vocab_chunks is not wired into this entry point's loss "
-                "function (supported: run_clm's dense dp/tp path, run_sft, "
+                "function (supported: run_clm's dp/tp/sp/pp paths, run_sft, "
                 "run_dpo)"
             )
         if cfg.tp_vocab and not getattr(loss_fn, "_tp_vocab", False):

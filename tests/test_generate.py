@@ -129,3 +129,42 @@ def test_generate_cli_roundtrips_exported_model(tmp_path):
                  "--model_name", "tiny", "--prompt", "hi",
                  "--max_new_tokens", "3", "--temperature", "0"])
     assert isinstance(text, str)
+
+
+def test_top_p_nucleus_filtering():
+    """top_p keeps exactly the smallest head-mass prefix: with probs
+    (.5, .3, .15, .05) and top_p=.7 only tokens {0, 1} can be sampled;
+    top_p→tiny degrades to greedy (the top token always survives)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.models.generate import sample_logits
+
+    probs = jnp.array([[0.5, 0.3, 0.15, 0.05]])
+    logits = jnp.log(probs)
+    draws = [int(sample_logits(logits, jax.random.key(s), 1.0, None, 0.7)[0])
+             for s in range(64)]
+    assert set(draws) <= {0, 1}, set(draws)
+    assert len(set(draws)) == 2  # both survivors actually get sampled
+    tiny = [int(sample_logits(logits, jax.random.key(s), 1.0, None, 1e-6)[0])
+            for s in range(8)]
+    assert set(tiny) == {0}
+    # top_p=1.0 keeps everything: all four ids reachable
+    full = [int(sample_logits(logits, jax.random.key(s), 1.0, None, 1.0)[0])
+            for s in range(200)]
+    assert set(full) == {0, 1, 2, 3}, set(full)
+
+
+def test_top_p_degenerate_values_fall_back_to_greedy():
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.generate import sample_logits
+
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    for s in range(8):
+        assert int(sample_logits(logits, jax.random.key(s), 1.0,
+                                 None, 0.0)[0]) == 0
+        assert int(sample_logits(logits, jax.random.key(s), 1.0,
+                                 0, None)[0]) == 0
