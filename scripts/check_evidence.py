@@ -79,5 +79,14 @@ def check(what: str, arg: str | None = None) -> bool:
 
 
 if __name__ == "__main__":
-    ok = check(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else None)
+    what = sys.argv[1]
+    if what == "all":
+        # per-stage status printout for operators; exit 0 only when complete
+        stages = [("sweep2", sweep2()), ("bench_best", bench_best()),
+                  ("sft7b", sft7b())] + [
+                  (f"parity:{m}", parity(m)) for m in ("local", "vote", "lazy")]
+        for name, ok in stages:
+            print(f"{name}: {'captured' if ok else 'MISSING'}")
+        sys.exit(0 if all(ok for _, ok in stages) else 1)
+    ok = check(what, sys.argv[2] if len(sys.argv) > 2 else None)
     sys.exit(0 if ok else 1)
