@@ -280,6 +280,23 @@ class Trainer:
                         f"{ax}-replication — one rank's moments would silently "
                         "win. Use pure data parallelism with ZeRO-1."
                     )
+        if cfg.lion and cfg.learning_rate < 1e-3 and any(
+            p.dtype == jnp.bfloat16 for p in jax.tree.leaves(params)
+        ):
+            # Lion applies a FIXED ±lr step; bf16's ULP at |p| ~ 0.1 is
+            # ~8e-4, so at small lr the update rounds to a no-op on every
+            # large-magnitude coordinate (silently frozen params). bf16
+            # params are a throughput/memory opt-in for benching; real
+            # training should keep f32 master params with bf16 COMPUTE
+            # (the model configs' default split), like torch's f32 master
+            # weights under autocast.
+            print(
+                "[trainer] WARNING: bf16 param storage with Lion lr "
+                f"{cfg.learning_rate:g} < 1e-3 — the fixed ±lr update is "
+                "below bf16 ULP for |p| > ~lr*256, so those coordinates "
+                "will NOT move. Use f32 param_dtype (bf16 compute_dtype "
+                "keeps the matmul speed) unless this is a throughput bench."
+            )
         if (cfg.vocab_chunks > 0 and loss_fn is not None
                 and not getattr(loss_fn, "_vocab_chunked", False)):
             # vocab_chunks is consumed by losses that opt in (for_gpt2's

@@ -29,15 +29,21 @@ SFT7B_LAST_SPEC = "2048"
 
 
 def parity(mode: str) -> bool:
+    """Captured = enough steps AND stamped as an f32-master-params run —
+    bf16-era curves had frozen large-magnitude params (Lion's ±lr is below
+    bf16 ULP there) and must not satisfy the evidence check."""
     try:
-        last = 0
+        last, f32 = 0, False
         with open(os.path.join(REPO, "runs", "parity", f"{mode}.jsonl")) as f:
             for line in f:
                 try:
-                    last = max(last, json.loads(line).get("step", 0))
+                    d = json.loads(line)
                 except json.JSONDecodeError:
-                    pass
-        return last >= PARITY_MIN_STEP
+                    continue
+                if d.get("meta"):
+                    f32 = d.get("param_dtype") == "float32"
+                last = max(last, d.get("step", 0))
+        return f32 and last >= PARITY_MIN_STEP
     except OSError:
         return False
 
@@ -63,6 +69,19 @@ def bench_best() -> bool:
     return os.path.exists(os.path.join(OUT, "bench_best.done"))
 
 
+# the ONE stage list both check("all") and the CLI printout derive from —
+# adding a stage here updates the watcher exit condition and the operator
+# status display together
+STAGES = [
+    ("sweep2", sweep2),
+    ("bench_best", bench_best),
+    ("sft7b", sft7b),
+    ("parity:local", lambda: parity("local")),
+    ("parity:vote", lambda: parity("vote")),
+    ("parity:lazy", lambda: parity("lazy")),
+]
+
+
 def check(what: str, arg: str | None = None) -> bool:
     if what == "parity":
         return parity(arg or "local")
@@ -73,8 +92,7 @@ def check(what: str, arg: str | None = None) -> bool:
     if what == "bench_best":
         return bench_best()
     if what == "all":
-        return (sweep2() and bench_best() and sft7b()
-                and all(parity(m) for m in ("local", "vote", "lazy")))
+        return all(fn() for _, fn in STAGES)
     raise SystemExit(f"unknown evidence check {what!r}")
 
 
@@ -82,11 +100,9 @@ if __name__ == "__main__":
     what = sys.argv[1]
     if what == "all":
         # per-stage status printout for operators; exit 0 only when complete
-        stages = [("sweep2", sweep2()), ("bench_best", bench_best()),
-                  ("sft7b", sft7b())] + [
-                  (f"parity:{m}", parity(m)) for m in ("local", "vote", "lazy")]
-        for name, ok in stages:
+        status = [(name, fn()) for name, fn in STAGES]
+        for name, ok in status:
             print(f"{name}: {'captured' if ok else 'MISSING'}")
-        sys.exit(0 if all(ok for _, ok in stages) else 1)
+        sys.exit(0 if all(ok for _, ok in status) else 1)
     ok = check(what, sys.argv[2] if len(sys.argv) > 2 else None)
     sys.exit(0 if ok else 1)

@@ -183,8 +183,13 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
         cfg = GPT2Config.tiny(vocab_size=VOCAB, n_ctx=T)
     else:
         cfg = GPT2Config.gpt2_124m(vocab_size=VOCAB)
-    cfg = dataclasses.replace(cfg, remat=False, attn_impl="xla",
-                              param_dtype=jnp.bfloat16)
+    # f32 MASTER params (compute stays bf16, the config default): Lion's
+    # fixed ±lr update is 1e-4 while bf16's ULP at |p| >= 0.05 is ~4e-4 —
+    # bf16-stored params would silently absorb the entire update on most
+    # large-magnitude coordinates (verified: apply_signed_update on bf16
+    # p=0.05..0.5 is a no-op at lr=1e-4). Same reason torch training keeps
+    # f32 master weights under bf16 autocast.
+    cfg = dataclasses.replace(cfg, remat=False, attn_impl="xla")
     params = gpt2_init(jax.random.key(seed), cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
     print(f"[run:{mode}] {n_params/1e6:.1f}M params "
@@ -328,6 +333,16 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
     count = jnp.int32(0)
     t0 = time.time()
     with open(log_path, "w") as logf:
+        # header row stamps the config so curve consumers (check_evidence,
+        # report) can reject runs captured under a different precision —
+        # bf16-era curves had frozen large-magnitude params (see the f32
+        # master-params comment above) and must not be compared against
+        # f32 runs as if the optimizer mode were the difference
+        logf.write(json.dumps({
+            "meta": True, "mode": mode, "param_dtype": str(cfg.param_dtype.__name__
+            if hasattr(cfg.param_dtype, "__name__") else cfg.param_dtype),
+            "lr": LR, "workers": WORKERS, "steps": steps,
+        }) + "\n")
         for s in range(steps):
             if mode == "lazy":
                 params, moms, cache, count, loss = step_fn(

@@ -369,3 +369,23 @@ def test_force_cpu_platform_appends_device_count(monkeypatch):
 
     monkeypatch.setenv("DLION_PLATFORM", "tpu")
     assert mesh_mod.force_cpu_platform() is False
+
+
+def test_bf16_param_small_lr_lion_warns(capsys):
+    """Lion's fixed ±lr rounds to a NO-OP on bf16 params with |p| > ~lr·256
+    (bf16 ULP) — the trainer must warn loudly rather than silently freeze
+    most coordinates (scripts/loss_parity.py trains f32 masters for this
+    reason)."""
+    import jax.numpy as jnp
+
+    mesh = make_mesh(data=8)
+    cfg = _tiny_cfg(learning_rate=1e-4)
+    model_cfg = dataclasses.replace(GPT2Config.tiny(),
+                                    param_dtype=jnp.bfloat16)
+    t = Trainer.for_gpt2(cfg, mesh, model_cfg)
+    t.close()
+    assert "below bf16 ULP" in capsys.readouterr().out
+    # f32 params at the same lr: no warning
+    t2 = Trainer.for_gpt2(cfg, mesh, GPT2Config.tiny())
+    t2.close()
+    assert "below bf16 ULP" not in capsys.readouterr().out
