@@ -316,28 +316,30 @@ def _block_remat_for(cfg):
                    policy=_remat_policy(cfg.remat_policy))(_block)
 
 
-def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None):
+def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None, tp_axis=None):
     """Pre-LN block whose FFN is the Switch-MoE layer: tokens flattened to
     [B*T, D], routed/dispatched by parallel/expert.moe_ffn (two all_to_all
-    hops when ``expert_axis`` is bound), combined back. Returns
-    ``(x, aux_loss)`` — the load-balance auxiliary to add to the train loss."""
+    hops when ``expert_axis`` is bound), combined back. ``tp_axis`` runs
+    the attention half column/row-parallel and Megatron-splits each
+    expert's FFN (ep × tp). Returns ``(x, aux_loss)`` — the load-balance
+    auxiliary to add to the train loss."""
     from distributed_lion_tpu.parallel.expert import moe_ffn
 
     k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
     x = x + _dropout(
-        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, None, None),
+        _attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, k1, tp_axis, None),
         cfg.dropout, k2,
     )
     B, T, D = x.shape
     h = _layer_norm(x, p["ln_2"]).reshape(B * T, D)
     y, aux = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                     axis_name=expert_axis)
+                     axis_name=expert_axis, tp_axis=tp_axis)
     x = x + _dropout(y.reshape(B, T, D), cfg.dropout, k3)
     return x, aux
 
 
 def _moe_block_remat_for(cfg):
-    return partial(jax.checkpoint, static_argnums=(3, 4),
+    return partial(jax.checkpoint, static_argnums=(3, 4, 5),
                    policy=_remat_policy(cfg.remat_policy))(_moe_block)
 
 
@@ -408,7 +410,7 @@ def gpt2_hidden(
     aux_total = jnp.float32(0)
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
         if "moe" in p:  # static pytree-structure branch, resolved at trace
-            x, aux = moe_block(x, p, k, cfg, expert_axis)
+            x, aux = moe_block(x, p, k, cfg, expert_axis, tp_axis)
             aux_total = aux_total + aux
         else:
             x = block(x, p, k, cfg, tp_axis, seq_axis)
@@ -456,28 +458,39 @@ def count_params(params) -> int:
     return sum(p.size for p in jax.tree.leaves(params))
 
 
-def gpt2_moe_param_specs(cfg: GPT2Config) -> dict:
+def gpt2_moe_param_specs(cfg: GPT2Config, tensor: bool = False) -> dict:
     """PartitionSpec tree for a MoE config: expert FFN banks sharded over the
     'expert' mesh axis (parallel/expert.moe_param_specs); everything else
     replicated. Valid for ep == 1 too (a P('expert') dim over a size-1 axis
-    is replication)."""
+    is replication). ``tensor=True`` (ep × tp) additionally applies the
+    Megatron split to attention, the dense MLP blocks, and each expert's
+    FFN (the same layouts as gpt2_param_specs / moe_param_specs(tensor))."""
     from jax.sharding import PartitionSpec as P
 
     from distributed_lion_tpu.parallel.expert import moe_param_specs
 
     rep = P()
     ln = {"scale": rep, "bias": rep}
+    if tensor:
+        # ONE source of truth for the Megatron attn/mlp layouts: reuse the
+        # dense-TP spec tree rather than hand-copying it (a layout change
+        # there must not silently diverge the MoE-TP sharding)
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            gpt2_param_specs,
+        )
+
+        dense_block = gpt2_param_specs(cfg)["blocks"][0]
+        att, mlp = dense_block["attn"], dense_block["mlp"]
+    else:
+        att = {k: rep for k in ("qkv", "qkv_b", "proj", "proj_b")}
+        mlp = {k: rep for k in ("fc", "fc_b", "proj", "proj_b")}
     blocks = []
     for i in range(cfg.n_layer):
-        block = {
-            "ln_1": ln,
-            "attn": {k: rep for k in ("qkv", "qkv_b", "proj", "proj_b")},
-            "ln_2": ln,
-        }
+        block = {"ln_1": ln, "attn": att, "ln_2": ln}
         if is_moe_block(cfg, i):
-            block["moe"] = moe_param_specs()
+            block["moe"] = moe_param_specs(tensor=tensor)
         else:
-            block["mlp"] = {k: rep for k in ("fc", "fc_b", "proj", "proj_b")}
+            block["mlp"] = mlp
         blocks.append(block)
     return {"wte": rep, "wpe": rep, "ln_f": ln, "blocks": blocks}
 

@@ -40,14 +40,28 @@ def moe_init(key, n_experts: int, d_model: int, d_ff: int, dtype=jnp.float32):
     }
 
 
-def moe_param_specs():
+def moe_param_specs(tensor: bool = False):
+    """Expert banks over the 'expert' axis; ``tensor=True`` ADDITIONALLY
+    Megatron-splits each expert's FFN over the tensor axis (w_in column-
+    parallel on d_ff, w_out row-parallel — the same split as a dense MLP,
+    batched over the expert dim). The gate and b_out stay replicated over
+    tensor (b_out is added AFTER the row-parallel psum in moe_ffn)."""
     from jax.sharding import PartitionSpec as P
 
+    from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
     e = EXPERT_AXIS
+    if not tensor:
+        return {
+            "gate": P(),
+            "w_in": P(e), "b_in": P(e),
+            "w_out": P(e), "b_out": P(e),
+        }
+    t = TENSOR_AXIS
     return {
         "gate": P(),
-        "w_in": P(e), "b_in": P(e),
-        "w_out": P(e), "b_out": P(e),
+        "w_in": P(e, None, t), "b_in": P(e, t),   # [E, d, f/tp], [E, f/tp]
+        "w_out": P(e, t, None), "b_out": P(e),    # [E, f/tp, d]
     }
 
 
@@ -62,6 +76,7 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     axis_name: Optional[str] = EXPERT_AXIS,
     capacity_override: Optional[int] = None,
+    tp_axis: Optional[str] = None,
 ):
     """Apply the MoE FFN to local tokens ``x [N, D]``.
 
@@ -70,6 +85,12 @@ def moe_ffn(
     ALL ``E = E_local * shards`` experts; tokens travel over the fabric.
     With ``axis_name=None`` (or axis size 1) it is the single-device
     reference semantics — same routing, same drops, no collectives.
+
+    ``tp_axis`` (ep × tp): each expert's FFN is ADDITIONALLY Megatron-split
+    over the tensor axis — w_in column-parallel on d_ff, w_out row-parallel
+    with one psum (moe_param_specs(tensor=True) layout). Routing/dispatch
+    see the full D on every tensor rank (x is replicated over tensor), so
+    the gate decisions and the expert all_to_all are identical across tp.
 
     Returns ``(y [N, D], aux_loss scalar)``; add ``aux`=0.01*aux_loss`` to
     the train loss to balance expert load (Switch Transformer recipe).
@@ -117,14 +138,27 @@ def moe_ffn(
         )
 
     # --- expert FFN (batched over this shard's experts) ---
+    if tp_axis is not None:
+        # Megatron f-operator: identity forward, psum backward — each
+        # tensor rank's partial input-cotangent (from its w_in shard)
+        # completes here, so upstream sees the full gradient
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            copy_to_tp_region,
+            reduce_from_tp_region,
+        )
+
+        dispatch = copy_to_tp_region(dispatch, tp_axis)
     h = jax.nn.gelu(
         jnp.einsum("ecd,edf->ecf", dispatch, params["w_in"])
         + params["b_in"][:, None, :]
     )
-    out = (
-        jnp.einsum("ecf,efd->ecd", h, params["w_out"])
-        + params["b_out"][:, None, :]
-    )
+    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    if tp_axis is not None:
+        # g-operator: row-parallel partials psum to the full output; b_out
+        # is replicated over tensor and must be added exactly once — AFTER
+        # the psum (adding per rank would scale it by tp)
+        out = reduce_from_tp_region(out, tp_axis)
+    out = out + params["b_out"][:, None, :]
 
     if axis_name is not None and ep > 1:
         # inverse: [E_local, S*C, D] -> [E, C, D] back on the token's shard

@@ -867,23 +867,33 @@ class Trainer:
                 clm_loss_sharded_rows,
             )
 
-            if tp > 1 or dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
+            if dict(mesh.shape).get(SEQ_AXIS, 1) > 1:
                 raise NotImplementedError(
-                    "MoE composes with data + expert parallelism (dp x ep); "
-                    "tensor/seq axes alongside MoE are not wired"
+                    "MoE composes with data, expert and tensor parallelism "
+                    "(dp x ep x tp); a seq axis alongside MoE is not wired"
                 )
             if model_cfg.moe_experts % ep:
                 raise ValueError(
                     f"moe_experts {model_cfg.moe_experts} not divisible by "
                     f"expert axis {ep}"
                 )
+            if cfg.tp_vocab:
+                raise NotImplementedError(
+                    "--tp_vocab on the MoE path is not wired (the MoE loss "
+                    "uses the replicated tied head); drop one"
+                )
+            if tp > 1:
+                validate_tp(model_cfg, tp, "gpt2")
             expert_axis = EXPERT_AXIS if ep > 1 else None
-            moe_specs = gpt2_moe_param_specs(model_cfg) if ep > 1 else None
+            moe_tp_axis = TENSOR_AXIS if tp > 1 else None
+            moe_specs = (gpt2_moe_param_specs(model_cfg, tensor=tp > 1)
+                         if (ep > 1 or tp > 1) else None)
 
             def moe_apply(params, tokens, dropout_key):
                 return gpt2_apply(params, tokens, model_cfg,
                                   dropout_key=dropout_key,
-                                  expert_axis=expert_axis, return_aux=True)
+                                  expert_axis=expert_axis,
+                                  tp_axis=moe_tp_axis, return_aux=True)
 
             if ep > 1:
                 def moe_loss(params, batch, dropout_key):

@@ -138,3 +138,56 @@ def test_moe_decode_matches_apply():
     dec, _ = gpt2_decode(params, tokens, model, cache, 0)
     np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
                                rtol=2e-2, atol=2e-2)
+
+
+def test_moe_ep_tp_trajectory_matches_ep():
+    """dp=2 x ep=2 x tp=2 ≡ dp=2 x ep=2: adding the tensor axis —
+    Megatron-split attention AND per-expert FFNs (w_in column / w_out row,
+    b_out added after the row psum) — is a pure re-schedule on top of the
+    ep mesh: identical routing groups, identical voters. (ep itself is NOT
+    trajectory-equal to pure dp: row sharding changes the voter grouping —
+    its semantics are pinned by the forward-equality and convergence tests
+    above.) f32 compute so the vote's sign threshold sees no reordering
+    noise."""
+    import dataclasses
+
+    model_f32 = dataclasses.replace(MODEL, compute_dtype=np.float32,
+                                    moe_experts=2)
+
+    def run(mesh, **cfg_kw):
+        cfg = _cfg(learning_rate=1e-3, max_steps=5, logging_steps=1, **cfg_kw)
+        trainer = Trainer.for_gpt2(cfg, mesh, model_f32, seed=123)
+        blocks = synthetic_lm_dataset(
+            max(64, trainer.global_train_batch() * 2), 32,
+            model_f32.vocab_size, seed=11)
+        hist = trainer.train(
+            batch_iterator(blocks, trainer.global_train_batch(), seed=0),
+            max_steps=5)
+        params = jax.tree.map(np.asarray, jax.device_get(trainer.params))
+        trainer.close()
+        return [h["loss"] for h in hist if "loss" in h], params
+
+    losses_ep, params_ep = run(
+        make_mesh(data=2, expert=2, devices=jax.devices()[:4]),
+        expert_parallel=2)
+    losses_x, params_x = run(make_mesh(data=2, expert=2, tensor=2),
+                             expert_parallel=2, tensor_parallel=2)
+    np.testing.assert_allclose(losses_x, losses_ep, rtol=1e-4, atol=1e-4)
+    envelope = 2 * 1e-3 * 5
+    for a, b in zip(jax.tree.leaves(params_ep), jax.tree.leaves(params_x)):
+        assert np.abs(a.astype(np.float64) - b.astype(np.float64)).max() \
+            <= envelope
+
+
+def test_moe_tp_only_trains():
+    """ep=1 with tp=2: the tensor split applies without an expert axis."""
+    mesh = make_mesh(data=4, tensor=2)
+    trainer = Trainer.for_gpt2(_cfg(max_steps=20, tensor_parallel=2),
+                               mesh, MODEL, seed=1)
+    blocks = synthetic_lm_dataset(trainer.global_train_batch() * 2, 32,
+                                  MODEL.vocab_size, seed=3)
+    hist = trainer.train(batch_iterator(blocks, trainer.global_train_batch(),
+                                        seed=0))
+    losses = [h["loss"] for h in hist if "loss" in h]
+    assert losses[-1] < losses[0] - 0.3, losses
+    trainer.close()
