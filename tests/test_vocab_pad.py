@@ -169,3 +169,27 @@ def test_trainer_padded_equals_unpadded_trajectory():
     np.testing.assert_allclose(losses["plain"][0], losses["padded"][0],
                                atol=1e-5)
     np.testing.assert_allclose(losses["plain"], losses["padded"], atol=8e-3)
+
+
+def test_hf_export_slices_pad_rows(tmp_path):
+    """gpt2_to_hf writes the TRUE-vocab table: the MXU pad rows never leak
+    into the HF checkpoint (which must round-trip into transformers)."""
+    from distributed_lion_tpu.models.hf_export import gpt2_to_hf
+
+    _, padded = _cfgs()
+    p = gpt2_init(jax.random.key(7), padded)
+    out = str(tmp_path / "export")
+    gpt2_to_hf(p, padded, out)
+    import json
+    import os
+
+    import numpy as _np
+
+    from safetensors.numpy import load_file
+
+    sd = load_file(os.path.join(out, "model.safetensors"))
+    assert sd["transformer.wte.weight"].shape[0] == V
+    with open(os.path.join(out, "config.json")) as f:
+        assert json.load(f)["vocab_size"] == V
+    _np.testing.assert_array_equal(sd["transformer.wte.weight"],
+                                   _np.asarray(p["wte"][:V], _np.float32))
