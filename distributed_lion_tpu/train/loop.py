@@ -82,11 +82,21 @@ class TrainConfig:
     zero1: bool = False  # AdamW path only: shard Adam m/v over the data axis
     # (ZeRO-1, optim/zero.py) — 2N/W floats of optimizer state per device
     # instead of 2N, updated chunks re-assembled with one all_gather.
-    wire: str = "sign_psum"
-    vote_every: int = 1  # K > 1: lazy sign refresh — each step votes a 1/K
-    # coordinate slice (wire volume ÷ K; packed_a2a at K=4 ≈ 0.5 bit/param/
-    # step, the BASELINE.md comm budget), stale elected signs applied
-    # elsewhere (optim.distributed_lion).
+    wire: str = "auto"  # vote wire format. 'auto' picks per mesh shape
+    # (resolve_auto_comm): W=1 → sign_psum (no traffic); single-host W>1 →
+    # packed_a2a (minimum received bytes AND fastest measured wire,
+    # scripts/SWEEP_wires.md); multi-host → hier:<local_devices> (only the
+    # 1-bit verdict chunks cross the DCN boundary). All wires elect
+    # IDENTICAL signs (tests/test_collectives.py wire equivalence) — the
+    # choice changes bytes moved, never the trajectory.
+    vote_every: int = 0  # K > 1: lazy sign refresh — each step votes a 1/K
+    # coordinate slice (wire volume ÷ K; packed_a2a at K=4 ≈ 0.375 bit/
+    # param/step at W=4, the BASELINE.md ≤0.5-bit comm budget), stale
+    # elected signs applied elsewhere (optim.distributed_lion). 0 = auto:
+    # 4 when W > 1, params replicated, and the ballot is ≥10M coordinates
+    # (where wire volume matters; trajectory-overlay at that scale is
+    # evidenced by runs/parity's lazy leg) — else 1, the reference's
+    # every-step vote. Pass --vote_every 1 to force strict voting.
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     mom_dtype: str = ""  # Lion momentum dtype override ('bfloat16' halves
     # the per-worker optimizer state and its read/write traffic — at 7B
@@ -172,6 +182,92 @@ def validate_seq_block(cfg: "TrainConfig", model_cfg, sp: int) -> None:
         )
 
 
+# lazy vote refresh auto-enables only when the ballot is at least this many
+# coordinates: below it the full vote is cheap anyway, and keeping tiny
+# (test/debug) models on the reference's every-step vote means 'auto'
+# changes bytes-on-wire, never the optimizer trajectory, at small scale
+AUTO_LAZY_MIN_PARAMS = 10_000_000
+
+
+def _spec_sharded_axes(param_specs) -> set:
+    """Mesh axes any param PartitionSpec shards over (empty = replicated
+    params). ``None`` specs (the default-replicated case) give the empty
+    set."""
+    if param_specs is None:
+        return set()
+    return {
+        ax for s in jax.tree.leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P))
+        for dim in s for ax in
+        (dim if isinstance(dim, (tuple, list)) else (dim,))
+        if ax is not None
+    }
+
+
+def resolve_auto_comm(cfg: TrainConfig, mesh, n_params: int,
+                      params_replicated: bool) -> TrainConfig:
+    """Resolve the comm sentinels (``wire='auto'``, ``vote_every=0``) into
+    concrete values for this mesh + model — the one place the multi-chip
+    default wire recipe lives (README 'wire recipe'; BASELINE.md ≤0.5-bit
+    budget vs the reference's always-sign_psum analog,
+    /root/reference/distributed_lion.py:80-81). Idempotent: a cfg with both
+    fields explicit is returned unchanged, so factories can resolve early
+    (for their byte-accounting print) and Trainer.__init__ resolves only
+    what reaches it unresolved."""
+    if cfg.wire != "auto" and cfg.vote_every != 0:
+        return cfg
+    world = data_axis_size(mesh)
+    wire, ve = cfg.wire, cfg.vote_every
+    if wire == "auto":
+        # hier's subgroups must be DATA-axis workers sharing a host. data is
+        # the slowest-varying mesh axis (make_mesh), so consecutive data
+        # indices sit `inner` devices apart (inner = product of the model
+        # axes); a host of L local devices therefore holds L // inner whole
+        # data rows. Grouping by local_device_count alone would straddle
+        # hosts whenever inner > 1 and run the full ballot reduce-scatter
+        # over DCN — the opposite of the wire's point.
+        inner = 1
+        for ax, sz in mesh.shape.items():
+            if ax != DATA_AXIS:
+                inner *= sz
+        local = jax.local_device_count()
+        hier_g = local // inner if inner and local % inner == 0 else 0
+        if not cfg.lion or world == 1:
+            wire = "sign_psum"  # W=1 short-circuits: no bytes move
+        elif jax.process_count() > 1 and hier_g > 1 and world % hier_g == 0:
+            # multi-host: only the 1-bit verdict chunks should cross DCN —
+            # hier's DCN leg is 0.125 bits/param at g=4 vs packed_a2a's
+            # cross-host phases (scripts/SWEEP_wires.md)
+            wire = f"hier:{hier_g}"
+        else:
+            # minimum received bytes AND fastest measured wire at W=8
+            # (scripts/SWEEP_wires.md: 1.75 bits/param, 1276 ms vs
+            # sign_psum's 8.0 bits, 1885 ms); also the multi-host fallback
+            # when the host layout gives no intact ICI data subgroup
+            wire = "packed_a2a"
+    if ve == 0:
+        lazy_ok = (cfg.lion and world > 1 and params_replicated
+                   and n_params >= AUTO_LAZY_MIN_PARAMS)
+        ve = 4 if lazy_ok else 1
+        if ve > 1:
+            # state the MEASURED bits for the resolved wire, not a fixed
+            # budget claim: an explicit --wire sign_psum with auto
+            # vote_every lands at 2 bits/param/step — lazy-sliced, but
+            # 4x over the 0.5-bit budget the packed_a2a default meets
+            bits = wire_bytes_per_param(
+                n_params, world, wire, vote_every=ve)["bits_per_param"]
+            print(
+                f"[trainer] auto comm: wire={wire} vote_every=4 — lazy "
+                f"1/4-slice votes cut the {n_params/1e6:.0f}M-coordinate "
+                f"ballot to {bits:.2f} bits/param/step "
+                f"({'under' if bits <= 0.5 else 'ABOVE'} the 0.5-bit "
+                "budget); trajectory overlays every-step voting at this "
+                "scale (runs/parity). Pass --vote_every 1 for the "
+                "reference's strict every-step vote."
+            )
+    return dataclasses.replace(cfg, wire=wire, vote_every=ve)
+
+
 def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
     """The reference's optimizer wiring (run_clm.py:580-585): ``--lion`` →
     Lion(lr, wd) else AdamW(wd=0.1 hardcoded); both under a cosine-warmup
@@ -198,8 +294,12 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             weight_decay=cfg.weight_decay,
             axis_name=DATA_AXIS,
             max_grad_norm=cfg.max_grad_norm,
-            wire=cfg.wire,
-            vote_every=cfg.vote_every,
+            # standalone callers may pass an unresolved cfg (no mesh in this
+            # signature): the sentinels degrade to the reference's strict
+            # semantics; the Trainer always resolves via resolve_auto_comm
+            # before reaching here
+            wire="sign_psum" if cfg.wire == "auto" else cfg.wire,
+            vote_every=cfg.vote_every or 1,
             kernel=cfg.kernel,
             mom_dtype=mom_dtype,
         )
@@ -265,6 +365,10 @@ class Trainer:
         replicated). When set, ``loss_fn`` takes
         ``(params, frozen, batch, dropout_key)`` and ``frozen_specs`` gives
         its PartitionSpecs (default replicated)."""
+        cfg = resolve_auto_comm(
+            cfg, mesh, count_params(params),
+            params_replicated=not _spec_sharded_axes(param_specs),
+        )
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
@@ -335,13 +439,7 @@ class Trainer:
             raise NotImplementedError("tensor-parallel param_specs require the Lion path")
         self.param_specs = param_specs
         if cfg.lion and cfg.vote_every > 1:
-            sharded_axes = {
-                ax for s in jax.tree.leaves(
-                    param_specs, is_leaf=lambda x: isinstance(x, P))
-                for dim in s for ax in
-                (dim if isinstance(dim, (tuple, list)) else (dim,))
-                if ax is not None
-            }
+            sharded_axes = _spec_sharded_axes(param_specs)
             if sharded_axes:
                 raise ValueError(
                     f"--vote_every > 1 is incompatible with params sharded "
@@ -786,6 +884,15 @@ class Trainer:
         params = (initial_params if initial_params is not None else
                   gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg))
         n = count_params(params)
+        shape = dict(mesh.shape)
+        cfg = resolve_auto_comm(
+            cfg, mesh, n,
+            # tp/pp/expert all shard params; only dp(/sp) keeps them
+            # replicated, the precondition for the lazy elected-sign cache
+            params_replicated=all(
+                shape.get(ax, 1) == 1
+                for ax in (TENSOR_AXIS, PIPE_AXIS, EXPERT_AXIS)),
+        )
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
                                     vote_every=cfg.vote_every,
                                     accum_steps=cfg.gradient_accumulation_steps)
@@ -1055,6 +1162,12 @@ class Trainer:
                   llama_init(jax.random.key(seed if seed is not None else cfg.seed),
                              model_cfg))
         n = count_params(params)
+        shape = dict(mesh.shape)
+        cfg = resolve_auto_comm(
+            cfg, mesh, n,
+            params_replicated=all(
+                shape.get(ax, 1) == 1 for ax in (TENSOR_AXIS, PIPE_AXIS)),
+        )
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
                                     vote_every=cfg.vote_every,
                                     accum_steps=cfg.gradient_accumulation_steps)

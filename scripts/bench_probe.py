@@ -33,6 +33,9 @@ def main(batch_per_dev=8, remat=True):
         per_device_train_batch_size=batch_per_dev,
         gradient_accumulation_steps=1, block_size=model_cfg.n_ctx,
         logging_steps=10_000, output_dir=None,
+        # pin the banked-row methodology (see bench.py): auto would change
+        # the measured comm on W>1 meshes
+        wire="sign_psum", vote_every=1,
     )
     trainer = Trainer.for_gpt2(cfg, mesh, model_cfg)
     global_bs = trainer.global_train_batch()

@@ -55,6 +55,11 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         gradient_accumulation_steps=accum, block_size=seq_len,
         steps_per_call=K, logging_steps=10_000, output_dir=None,
         vocab_chunks=vocab_chunks,
+        # pin the banked-row methodology: the auto sentinels would resolve
+        # to packed_a2a (+ lazy votes) on a W>1 mesh and rank incomparably
+        # against rows measured under every-step sign_psum (same pin as
+        # bench.py)
+        wire="sign_psum", vote_every=1,
     )
 
     # Init + quantize the frozen base ON HOST CPU, then ship only the packed

@@ -1,0 +1,137 @@
+"""Round-4 multi-chip default recipe: the comm sentinels (wire='auto',
+vote_every=0) must resolve to the measured budget configuration —
+packed_a2a + lazy 1/4-slice votes (BASELINE.md ≤0.5 bit/param/step) — on
+big replicated-param dp meshes, and degrade to the reference's strict
+every-step vote everywhere the lazy cache is unsound (sharded params) or
+pointless (tiny ballots, W=1). The recipe itself lives in ONE place,
+train/loop.resolve_auto_comm; these tests pin its decision matrix and that
+the Trainer applies it end to end."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.parallel.mesh import make_mesh
+from distributed_lion_tpu.train.loop import (
+    AUTO_LAZY_MIN_PARAMS,
+    TrainConfig,
+    Trainer,
+    resolve_auto_comm,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(data=8, devices=jax.devices()[:8])
+
+
+def test_big_replicated_dp_gets_budget_recipe(mesh8):
+    r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
+                          params_replicated=True)
+    assert (r.wire, r.vote_every) == ("packed_a2a", 4)
+
+
+def test_tiny_ballot_keeps_strict_vote(mesh8):
+    r = resolve_auto_comm(TrainConfig(), mesh8, AUTO_LAZY_MIN_PARAMS - 1,
+                          params_replicated=True)
+    assert (r.wire, r.vote_every) == ("packed_a2a", 1)
+
+
+def test_sharded_params_keep_strict_vote(mesh8):
+    """tp/pp/ep-sharded params make the lazy elected-sign cache unsound
+    (per-rank ballots over different local shards) — auto must not pick
+    vote_every > 1 there."""
+    r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
+                          params_replicated=False)
+    assert r.vote_every == 1
+
+
+def test_world_one_is_silent(mesh8):
+    mesh1 = make_mesh(data=1, devices=jax.devices()[:1])
+    r = resolve_auto_comm(TrainConfig(), mesh1, 124_000_000,
+                          params_replicated=True)
+    assert (r.wire, r.vote_every) == ("sign_psum", 1)
+
+
+def test_explicit_choice_is_never_overridden(mesh8):
+    cfg = TrainConfig(wire="sign_psum", vote_every=1)
+    assert resolve_auto_comm(cfg, mesh8, 124_000_000, True) is cfg
+
+
+def test_trainer_resolves_and_steps_with_auto_recipe(mesh8):
+    """End to end: a Trainer built with default comm fields on a dp=8 mesh
+    resolves to the budget wire and completes a train step (the same leg
+    __graft_entry__._dryrun_auto_budget runs for the driver)."""
+    from distributed_lion_tpu.data.sources import batch_iterator, synthetic_lm_dataset
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+
+    model_cfg = GPT2Config.tiny(vocab_size=2048, n_layer=2, n_head=8,
+                                d_model=768, n_ctx=64)
+    cfg = TrainConfig(
+        lion=True, async_grad=True, learning_rate=1e-3, warmup_steps=1,
+        max_steps=1, per_device_train_batch_size=1,
+        gradient_accumulation_steps=1, block_size=64, logging_steps=1,
+        output_dir=None,
+    )
+    tr = Trainer.for_gpt2(cfg, mesh8, model_cfg)
+    assert tr.n_params >= AUTO_LAZY_MIN_PARAMS
+    assert (tr.cfg.wire, tr.cfg.vote_every) == ("packed_a2a", 4)
+    blocks = synthetic_lm_dataset(max(64, tr.global_train_batch()),
+                                  cfg.block_size, model_cfg.vocab_size)
+    hist = tr.train(batch_iterator(blocks, tr.global_train_batch(), seed=0),
+                    max_steps=1)
+    tr.close()
+    assert np.isfinite([h["loss"] for h in hist if "loss" in h]).all()
+
+
+def test_make_optimizer_degrades_sentinels_strict():
+    """Standalone make_optimizer callers (no mesh in the signature) get the
+    reference's strict semantics from an unresolved cfg, not a crash."""
+    from distributed_lion_tpu.train.loop import make_optimizer
+
+    make_optimizer(TrainConfig())  # wire='auto', vote_every=0 must not raise
+
+
+def test_resolve_dropout_family_defaults():
+    from distributed_lion_tpu.cli.run_clm import resolve_dropout
+
+    assert resolve_dropout(None, "gpt2", 1) == 0.1
+    assert resolve_dropout(None, "llama", 1) == 0.0
+    assert resolve_dropout(None, "gpt2", 2) == 0.0  # pp: unsupported
+    # sp skips attention-prob dropout — 0.1 would silently be a different
+    # regularizer than the HF default, so auto stays off there
+    assert resolve_dropout(None, "gpt2", 1, sp=2) == 0.0
+    assert resolve_dropout(0.1, "gpt2", 1, sp=2) == 0.1  # explicit opt-in
+    assert resolve_dropout(0.0, "gpt2", 1) == 0.0   # explicit opt-out wins
+    assert resolve_dropout(0.3, "gpt2", 1) == 0.3
+
+
+def test_multihost_hier_groups_are_data_rows_per_host(monkeypatch):
+    """code-review r4: hier's subgroups must be whole DATA rows sharing a
+    host. data is the slowest mesh axis, so a host of L devices holds
+    L // inner data rows (inner = product of model axes) — grouping by
+    local_device_count alone would straddle hosts whenever inner > 1."""
+    from distributed_lion_tpu.train import loop as L
+
+    monkeypatch.setattr(L.jax, "process_count", lambda: 2)
+    monkeypatch.setattr(L.jax, "local_device_count", lambda: 4)
+
+    # dp=4 x sp=2 over 8 'devices', 2 'hosts' of 4: each host holds 2 whole
+    # data rows -> hier:2, not hier:4
+    mesh = make_mesh(data=4, seq=2, devices=jax.devices()[:8])
+    r = resolve_auto_comm(TrainConfig(), mesh, 124_000_000,
+                          params_replicated=True)
+    assert r.wire == "hier:2"
+
+    # dp=2 x tensor=2 x seq=2: inner=4 == local -> 1 data row per host,
+    # no intact ICI subgroup -> fall back to the flat sub-2-bit wire
+    mesh = make_mesh(data=2, tensor=2, seq=2, devices=jax.devices()[:8])
+    r = resolve_auto_comm(TrainConfig(), mesh, 124_000_000,
+                          params_replicated=False)
+    assert r.wire == "packed_a2a"
+
+    # pure dp over 2 hosts: groups = all 4 local devices
+    mesh = make_mesh(data=8, devices=jax.devices()[:8])
+    r = resolve_auto_comm(TrainConfig(), mesh, 124_000_000,
+                          params_replicated=True)
+    assert r.wire == "hier:4"
