@@ -16,7 +16,12 @@
 - ``splash`` — the newer Pallas TPU splash kernel family (sparse-mask
   blocking); faster than ``flash`` at moderate T but still behind ``xla``
   at T=1024 on v5e (scripts/SWEEP_v5e.md).
-- ``auto``  — flash on TPU for T ≥ 2048, else xla.
+- ``auto``  — on TPU: caller-pinned tiles → flash with those tiles (any
+  shape); flash for T ≥ 2048 (its memory regime); tile-tuned flash
+  (512x1024) at the swept flagship shape (T=1024, head_dim=64 — GPT-2);
+  xla everywhere else (tuned tiles are per-shape measurements, not safe
+  generalizations). Off TPU: always xla (pinned forward tiles are unused
+  there — Pallas kernels are TPU-only).
 
 All take q, k, v as [B, H, T, head_dim] and return [B, H, T, head_dim] in
 q's dtype. Causal only (decoder framework).
@@ -143,7 +148,28 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
               block_q: int = 0, block_kv: int = 0,
               block_q_bwd: int = 0, block_kv_bwd: int = 0):
     if impl == "auto":
-        impl = "flash" if (jax.default_backend() == "tpu" and q.shape[2] >= 2048) else "xla"
+        on_tpu = jax.default_backend() == "tpu"
+        T = q.shape[2]
+        if on_tpu and (block_q or block_kv):
+            # caller-pinned tiles are a flash knob: honor them at ANY shape
+            # rather than silently running untiled xla (a config like
+            # auto@256x512 would otherwise report numbers and tune nothing
+            # — same trap the bwd-tile guard below raises for)
+            impl = "flash"
+        elif on_tpu and T >= 2048:
+            impl = "flash"
+        elif on_tpu and T == 1024 and q.shape[3] == 64:
+            # measured winner at the swept flagship shape — GPT-2 124M,
+            # T=1024, head_dim=64: tile-tuned flash beats xla by ~12% on
+            # v5e (flash@512x1024 → 98,099 tokens/s/chip vs xla 85.7k,
+            # scripts/SWEEP_r3_raw/sweep2.jsonl). The head_dim gate keeps
+            # OTHER T=1024 workloads (e.g. Llama-7B, head_dim 128 — the 7B
+            # bench leg) on the conservative xla path: the tiles are a
+            # per-shape measurement, not a safe generalization
+            impl = "flash"
+            block_q, block_kv = 512, 1024
+        else:
+            impl = "xla"
     if impl == "flash":
         return attention_flash(q, k, v, causal=causal,
                                block_q=block_q, block_kv=block_kv,

@@ -106,3 +106,58 @@ def test_bwd_tiles_refused_off_flash():
         attention(q, k, v, impl="splash", block_q_bwd=64)
     with pytest.raises(ValueError, match="flash-kernel knob"):
         attention(q, k, v, impl="xla", block_kv_bwd=128)
+
+def test_auto_picks_tuned_flash_at_swept_flagship_shape(monkeypatch):
+    """VERDICT r3 item 6: `auto` on TPU at the swept flagship shape
+    (T=1024, no caller-pinned tiles) must dispatch to the MEASURED winner —
+    tile-tuned flash@512x1024 (98,099 tok/s/chip vs xla's 85.7k,
+    scripts/SWEEP_r3_raw/sweep2.jsonl) — while unswept shapes keep the xla
+    fallback and caller-pinned tiles are honored. Backend + kernel are
+    monkeypatched: this pins DISPATCH, the kernels' math is pinned by the
+    equivalence tests above."""
+    from distributed_lion_tpu.ops import attention as A
+
+    calls = []
+
+    def fake_flash(q, k, v, *, causal=True, block_q=0, block_kv=0,
+                   block_q_bwd=0, block_kv_bwd=0):
+        calls.append((block_q, block_kv, block_q_bwd, block_kv_bwd))
+        return q
+
+    def fake_xla(q, k, v, *, causal=True, score_dtype=None):
+        calls.append("xla")
+        return q
+
+    monkeypatch.setattr(A, "attention_flash", fake_flash)
+    monkeypatch.setattr(A, "attention_xla", fake_xla)
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+
+    q, k, v = _qkv(T=1024)
+    A.attention(q, k, v, impl="auto")
+    assert calls[-1] == (512, 1024, 0, 0)  # tuned tiles at the swept shape
+
+    q, k, v = _qkv(T=1024, hd=128)
+    A.attention(q, k, v, impl="auto")
+    # T=1024 but head_dim 128 (Llama shapes): NOT the swept shape — the
+    # GPT-2-tuned tiles must not leak onto it (keeps the 7B bench leg's
+    # round-3 xla methodology)
+    assert calls[-1] == "xla"
+
+    A.attention(q, k, v, impl="auto", block_q=256, block_kv=256)
+    assert calls[-1] == (256, 256, 0, 0)  # pinned tiles honored via flash
+
+    q, k, v = _qkv(T=512)
+    A.attention(q, k, v, impl="auto")
+    assert calls[-1] == "xla"  # unswept shape keeps the conservative path
+
+    A.attention(q, k, v, impl="auto", block_q=128, block_kv=128)
+    assert calls[-1] == (128, 128, 0, 0)  # pinned tiles win at any shape
+
+    q, k, v = _qkv(T=2048)
+    A.attention(q, k, v, impl="auto")
+    assert calls[-1] == (0, 0, 0, 0)  # long-context regime: default flash
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "cpu")
+    q, k, v = _qkv(T=1024)
+    A.attention(q, k, v, impl="auto")
+    assert calls[-1] == "xla"  # no TPU: never the pallas kernel
