@@ -47,7 +47,13 @@ class ModelArguments:
     # save_model output format (run_clm.py:611-622)
     vocab_size: Optional[int] = None  # default: tokenizer/model default
     n_ctx: Optional[int] = None
-    dropout: float = 0.0
+    dropout: Optional[float] = None  # None = family default: 0.1 for GPT-2
+    # (the reference trains from the HF GPT-2 config, whose every pdrop knob
+    # defaults to 0.1 — /root/reference/run_clm.py:425-444), 0.0 for Llama
+    # (no dropout), under --pipeline_parallel (unsupported there; explicit
+    # --dropout with pp still fails loudly in validate_pipeline), and under
+    # --seq_parallel (attention-prob dropout is skipped there; explicit
+    # --dropout opts into the partial semantics — see resolve_dropout)
     seq_impl: str = "ring"  # sequence-parallel attention under
     # --seq_parallel: 'ring' (kv rotation) | 'ulysses' (all_to_all to head
     # sharding; needs n_head % seq_parallel == 0)
@@ -64,6 +70,24 @@ class ModelArguments:
     # up to this multiple (e.g. 1024 → 50257 becomes 51200) so the tied
     # head / chunked-CE slices are MXU-tile-aligned and --tp_vocab shards
     # evenly; loss/generation semantics are exact (models/gpt2)
+
+
+def resolve_dropout(dropout: Optional[float], family: str, pp: int,
+                    sp: int = 1) -> float:
+    """Family-default dropout (None = unset): 0.1 for GPT-2 pretraining —
+    the reference instantiates the HF GPT-2 config, whose every pdrop knob
+    defaults to 0.1 (/root/reference/run_clm.py:425-444). 0.0 for Llama
+    (no dropout), under pipeline parallelism (unsupported there; an
+    EXPLICIT nonzero value still fails loudly in validate_pipeline / the
+    Llama guard rather than being silently zeroed here), and under
+    sequence parallelism — sp skips attention-prob dropout (the scores
+    never exist in one place, models/gpt2), so 0.1 would be a DIFFERENT
+    regularizer than the reference default this function promises; an
+    explicit --dropout under sp opts into that partial semantics (the
+    trainer prints the semantics warning)."""
+    if dropout is not None:
+        return dropout
+    return 0.1 if family == "gpt2" and pp <= 1 and sp <= 1 else 0.0
 
 
 @dataclasses.dataclass
@@ -296,18 +320,6 @@ def main(argv=None):
     mesh = build_mesh(train_cfg.tensor_parallel, train_cfg.seq_parallel,
                       train_cfg.pipeline_parallel, train_cfg.expert_parallel)
     dtypes = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
-    common = dict(
-        dropout=model_args.dropout,
-        param_dtype=dtypes[model_args.param_dtype],
-        compute_dtype=dtypes[model_args.compute_dtype],
-        remat=model_args.remat,
-        remat_policy=model_args.remat_policy,
-        seq_impl=model_args.seq_impl,
-        moe_experts=model_args.moe_experts,
-        moe_every=model_args.moe_every,
-        moe_capacity_factor=model_args.moe_capacity_factor,
-        vocab_pad_multiple=model_args.vocab_pad_multiple,
-    )
     family = model_args.model_family
     if model_args.model_path:
         # the checkpoint's architecture wins; resolve BEFORE the family
@@ -318,6 +330,21 @@ def main(argv=None):
         if family != model_args.model_family:
             print(f"[run_clm] --model_family {model_args.model_family} -> "
                   f"{family} (detected from --model_path)")
+    dropout = resolve_dropout(model_args.dropout, family,
+                              train_cfg.pipeline_parallel,
+                              train_cfg.seq_parallel)
+    common = dict(
+        dropout=dropout,
+        param_dtype=dtypes[model_args.param_dtype],
+        compute_dtype=dtypes[model_args.compute_dtype],
+        remat=model_args.remat,
+        remat_policy=model_args.remat_policy,
+        seq_impl=model_args.seq_impl,
+        moe_experts=model_args.moe_experts,
+        moe_every=model_args.moe_every,
+        moe_capacity_factor=model_args.moe_capacity_factor,
+        vocab_pad_multiple=model_args.vocab_pad_multiple,
+    )
     if family not in ("gpt2", "llama"):
         raise ValueError(f"unknown model family {family!r}")
     if family == "llama" and (
@@ -327,7 +354,7 @@ def main(argv=None):
             "--model_family llama composes with dp x tp x sp x pp; MoE and "
             "the expert axis are wired for GPT-2 only"
         )
-    if family == "llama" and model_args.dropout > 0.0:
+    if family == "llama" and (model_args.dropout or 0.0) > 0.0:
         raise ValueError("our Llama (like HF's) has no dropout; set --dropout 0")
     if family == "llama" and model_args.vocab_pad_multiple:
         raise ValueError(
@@ -347,7 +374,7 @@ def main(argv=None):
         else:
             initial_params, model_cfg = hf_import.gpt2_from_hf(
                 model_args.model_path,
-                dropout=model_args.dropout,
+                dropout=dropout,
                 param_dtype=dtypes[model_args.param_dtype],
                 compute_dtype=dtypes[model_args.compute_dtype],
                 remat=model_args.remat,
@@ -461,7 +488,10 @@ def main(argv=None):
                 train_summary={
                     "optimizer": "distributed-lion" if train_cfg.lion else "adamw",
                     "async_grad": train_cfg.async_grad,
-                    "wire": train_cfg.wire,
+                    # trainer.cfg, not train_cfg: the card must record the
+                    # wire that actually ran, not the 'auto' sentinel
+                    "wire": trainer.cfg.wire,
+                    "vote_every": trainer.cfg.vote_every,
                     "steps": train_cfg.max_steps,
                     "learning_rate": train_cfg.learning_rate,
                     "weight_decay": train_cfg.weight_decay,

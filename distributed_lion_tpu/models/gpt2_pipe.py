@@ -154,6 +154,18 @@ def make_pipeline_loss(model_cfg: GPT2Config, n_micro: int,
                     f"sequence length {T} exceeds n_ctx {model_cfg.n_ctx}")
             pos_start = 0
         else:
+            # axis sizes are static under shard_map, so this guard is
+            # shape-static too: without it an oversized TOTAL sequence
+            # (T_local × seq shards > n_ctx) would make the wpe
+            # dynamic_slice below clamp silently and hand later seq shards
+            # duplicated positional rows — callers bypassing the Trainer's
+            # config-time validate_seq_block must still fail loudly here
+            total_t = T * lax.axis_size(seq_axis)
+            if total_t > model_cfg.n_ctx:
+                raise ValueError(
+                    f"total sequence length {total_t} (T_local {T} x "
+                    f"{lax.axis_size(seq_axis)} seq shards) exceeds n_ctx "
+                    f"{model_cfg.n_ctx}")
             pos_start = lax.axis_index(seq_axis) * T
         x = params["wte"][tokens].astype(model_cfg.compute_dtype)
         x = x + lax.dynamic_slice_in_dim(

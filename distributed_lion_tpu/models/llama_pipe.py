@@ -114,6 +114,15 @@ def make_llama_pipeline_loss(model_cfg: LlamaConfig, n_micro: int,
                                  f"{model_cfg.n_ctx}")
             offset = 0
         else:
+            # static guard (axis sizes are static under shard_map): an
+            # oversized total sequence would silently RoPE-extrapolate past
+            # n_ctx instead of failing; mirror gpt2_pipe's loud check
+            total_t = T * lax.axis_size(seq_axis)
+            if total_t > model_cfg.n_ctx:
+                raise ValueError(
+                    f"total sequence length {total_t} (T_local {T} x "
+                    f"{lax.axis_size(seq_axis)} seq shards) exceeds n_ctx "
+                    f"{model_cfg.n_ctx}")
             offset = lax.axis_index(seq_axis) * T
         cos, sin = rope_angles(T, model_cfg.head_dim, model_cfg.rope_theta,
                                offset=offset)

@@ -323,3 +323,44 @@ def test_checkpoint_resume_exact_under_tp_pp(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     t2.close()
     t_cont.close()
+
+
+def test_sp_pipeline_oversized_total_sequence_fails_loudly():
+    """ADVICE r3: calling make_pipeline_loss directly with seq_axis and a
+    TOTAL sequence (T_local x seq shards) past n_ctx must raise at trace
+    time — without the guard the wpe dynamic_slice clamps silently and
+    later seq shards duplicate positional rows. (The Trainer path already
+    refuses this at config time via validate_seq_block; this pins the
+    model-level guard for callers that bypass the Trainer.)"""
+    from distributed_lion_tpu.models.gpt2_pipe import (
+        make_pipeline_loss,
+        pipeline_param_specs,
+        pipeline_params,
+    )
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    pp, sp = 2, 2
+    mesh = make_mesh(data=2, seq=sp, pipe=pp)
+    model = GPT2Config.tiny(n_layer=pp)  # n_ctx=128
+    params = gpt2_init(jax.random.key(0), model)
+    # the shard_map in_spec splits dim 1 over the 2-way seq axis, so
+    # T_local = n_ctx: fits per shard, but total = 2*n_ctx overflows wpe
+    tokens = np.zeros((8, 2 * model.n_ctx), np.int32)
+
+    loss_fn = make_pipeline_loss(model, n_micro=2, seq_axis="seq",
+                                 vocab_chunks=0, axis_name="pipe")
+    pparams = pipeline_params(params, pp)
+    pspecs = pipeline_param_specs()
+
+    def run(pparams, tokens):
+        def body(p, t):
+            loss, _ = loss_fn(p, t, None)
+            return jax.lax.pmean(loss, "data")
+        return shard_map(
+            body, mesh=mesh, in_specs=(pspecs, P("data", "seq")),
+            out_specs=P(), check_vma=False,
+        )(pparams, tokens)
+
+    with pytest.raises(ValueError, match="exceeds n_ctx"):
+        jax.jit(run)(pparams, tokens)
