@@ -41,8 +41,10 @@ rematerialization not counted as useful work).
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -71,6 +73,21 @@ def _load_last_tpu_measurement() -> dict | None:
         return None
 
 
+def sweep_row_promotable(d: dict) -> bool:
+    """The ONE eligibility rule for treating a bench_sweep row as flagship
+    evidence — shared by _best_sweep_row and the runbook's winner promotion
+    (tpu_runbook_auto2.sh imports it), so the rule can't drift between the
+    two. Promotable = a RESULT row of the canonical T=1024 anchor workload,
+    TPU-attested: rows carry backend since round 4, and the default 'tpu'
+    keeps the committed round-3 rows (captured in a verified TPU window,
+    scripts/SWEEP_r3_raw/log.txt) eligible while excluding any future
+    CPU/fallback-produced row. The block filter keeps T=2048 long-context
+    rows (sweep3) out: a different workload, not anchor-comparable."""
+    return (bool(d.get("tokens_per_sec_per_chip"))
+            and d.get("backend", "tpu") == "tpu"
+            and d.get("block", 1024) == 1024)
+
+
 def _best_sweep_row() -> dict | None:
     """Best tokens/s row from the committed raw sweep artifact
     (scripts/SWEEP_r3_raw/sweep2.jsonl) — attached to non-TPU fallback
@@ -94,9 +111,11 @@ def _best_sweep_row() -> dict | None:
                         d = json.loads(line)
                     except json.JSONDecodeError:
                         continue
-                    tps = d.get("tokens_per_sec_per_chip")
-                    if tps and (best is None
-                                or tps > best["tokens_per_sec_per_chip"]):
+                    if not sweep_row_promotable(d):
+                        continue
+                    tps = d["tokens_per_sec_per_chip"]
+                    if (best is None
+                            or tps > best["tokens_per_sec_per_chip"]):
                         best = d
                         best["source"] = os.path.relpath(
                             path, os.path.dirname(os.path.abspath(__file__)))
@@ -167,18 +186,42 @@ def run_inner() -> None:
     n_dev = len(devices)
     backend = devices[0].platform
     device_kind = devices[0].device_kind
+    if os.environ.get("BENCH_REQUIRE_TPU") == "1" and backend != "tpu":
+        # main()'s full-budget attempts are TPU measurements; on a host
+        # whose backend resolves to CPU the flagship config would grind
+        # until the 900s timeout (hours of work at 124M on a host core)
+        # before the evidence-of-life fallback ran. Fail in seconds instead.
+        print(f"BENCH_REQUIRE_TPU=1 but backend is {backend!r}; "
+              "refusing the full-budget flagship config off-TPU",
+              file=sys.stderr)
+        raise SystemExit(2)
     mesh = make_mesh()
+    # BENCH_* env knobs parameterize the ONE timed-step implementation:
+    # bench.py IS the sweep harness's measurement core (scripts/
+    # bench_sweep.py spawns `bench.py --inner` per config), so a sweep row
+    # and a bench capture can never disagree on methodology again
+    remat_s = os.environ.get("BENCH_REMAT", "noremat")  # noremat|full|dots
+    dtype_s = os.environ.get("BENCH_DTYPE", "bf16")  # bf16|f32 param dtype
+    block = int(os.environ.get("BENCH_BLOCK", 1024))  # tokens/sequence; a
+    # non-default value also sets n_ctx (T=2048 long-context legs)
     model_cfg = dataclasses.replace(
-        GPT2Config.gpt2_124m(), remat=False, attn_impl="xla",
-        param_dtype=jnp.bfloat16,
+        GPT2Config.gpt2_124m(), attn_impl="xla",
+        remat=remat_s != "noremat",
+        remat_policy="dots" if remat_s == "dots" else "full",
+        param_dtype=jnp.bfloat16 if dtype_s == "bf16" else jnp.float32,
     )
+    if block != model_cfg.n_ctx:
+        model_cfg = dataclasses.replace(model_cfg, n_ctx=block)
     batch_per_dev = int(os.environ.get("BENCH_BATCH", 4))
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     accum = int(os.environ.get("BENCH_ACCUM", 16))
     vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 8))
     mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "bfloat16")
-    attn_spec = os.environ.get("BENCH_ATTN", "flash@512x1024")
+    # 'auto' resolves to the tile-tuned flash winner at the flagship shape
+    # (T=1024 on TPU → flash@512x1024, ops/attention.attention dispatch,
+    # round-3 sweep row) — the flagship bench needs no explicit attn spec
+    attn_spec = os.environ.get("BENCH_ATTN", "auto")
     vocab_pad = int(os.environ.get("BENCH_VOCAB_PAD", 0))
     if vocab_pad:
         model_cfg = dataclasses.replace(model_cfg,
@@ -194,6 +237,13 @@ def run_inner() -> None:
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
+        # pin the round-3 comm methodology: every committed sweep/bench row
+        # measured every-step sign_psum voting. Left at the auto sentinels,
+        # a W>1 backend would resolve to packed_a2a + vote_every=4 (less
+        # comm per step) and rank incomparably against the banked rows.
+        # W=1 short-circuits either way; this makes multi-chip explicit.
+        wire="sign_psum",
+        vote_every=1,
         learning_rate=1e-4,
         weight_decay=0.1,
         warmup_steps=10,
@@ -237,7 +287,7 @@ def run_inner() -> None:
         trainer.params, trainer.state, m = trainer._train_chunk(
             trainer.params, trainer.state, trainer._frozen_arg(), batches, base_key
         )
-    _ = float(np.asarray(jax.device_get(m["loss"])))
+    final_loss = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
 
     steps = steps_per_call * timed_calls
@@ -264,9 +314,13 @@ def run_inner() -> None:
                 + (f", mom_dtype {mom_dtype}" if mom_dtype else "")
                 + (f", attn {attn_spec}" if attn_spec != "xla" else "")
                 + (f", vocab_pad {vocab_pad}" if vocab_pad else "")
+                + (f", remat {remat_s}" if remat_s != "noremat" else "")
+                + (", f32 params" if dtype_s != "bf16" else "")
                 + f", {n_dev} {device_kind} device(s), backend={backend})",
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
+                "ms_per_step": round(dt / steps * 1e3, 1),
+                "loss": round(final_loss, 3),
                 # vs_baseline is defined against the derived A100 anchor and
                 # only meaningful on TPU hardware; null (not 0.0) elsewhere
                 # so a fallback doesn't render as a perf failure.
@@ -322,10 +376,65 @@ def _extract_json_line(text: str) -> dict | None:
     return None
 
 
+# The measurement child holds the TPU (libtpu single-client lock). If an
+# outer `timeout`/driver SIGTERMs the orchestrating parent mid-attempt, an
+# orphaned child would keep the chip locked and hang every later user —
+# children run in their own process group, torn down on signal/exit. This
+# machinery is shared: scripts/bench_sweep.py imports run_child /
+# install_child_teardown so the TPU-lock-release semantics can't drift
+# between the two harnesses.
+_child: subprocess.Popen | None = None
+
+
+def _kill_child() -> None:
+    if _child is not None and _child.poll() is None:
+        try:
+            os.killpg(_child.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def install_child_teardown() -> None:
+    """Tear the current measurement child's process group down on SIGTERM
+    and at interpreter exit. Call once from the orchestrating __main__."""
+    signal.signal(signal.SIGTERM, lambda s, f: (_kill_child(),
+                                                sys.exit(128 + s)))
+    atexit.register(_kill_child)
+
+
+def run_child(cmd: list, env: dict, budget: float,
+              cwd: str) -> tuple[int, str, str]:
+    """Run ``cmd`` in its own process group under a hard timeout; returns
+    (rc, stdout, stderr). On timeout the whole group is SIGKILLed and
+    TimeoutExpired re-raised — the child can never outlive the budget."""
+    global _child
+    _child = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=cwd, start_new_session=True,
+    )
+    try:
+        out, err = _child.communicate(timeout=budget)
+        rc = _child.returncode
+    except subprocess.TimeoutExpired:
+        _kill_child()
+        _child.wait()
+        _child = None
+        raise
+    _child = None
+    return rc, out, err
+
+
+def _run_attempt(env: dict, budget: float) -> tuple[int, str, str]:
+    here = os.path.abspath(__file__)
+    return run_child([sys.executable, here, "--inner"], env, budget,
+                     os.path.dirname(here))
+
+
 def main() -> None:
     """Orchestrator: run the measurement in a child process under a hard
     timeout, retry on failure, fall back to CPU, and ALWAYS print one JSON
     line and exit 0. Never imports jax itself (backend init can hang)."""
+    install_child_teardown()
     # a healthy TPU run needs ~2-4 min (compile + 50 fused steps); 900s is
     # ample headroom while keeping the worst-case hung-backend chain
     # (900 + 300 + CPU fallback ~400s) well inside the driver's window
@@ -336,8 +445,8 @@ def main() -> None:
         # budget), then the CPU evidence-of-life config: it exists to prove
         # the program runs, not to measure a meaningful number — full
         # flagship size would itself blow the timeout on a host CPU
-        ("default", timeout_s, {}),
-        ("default", min(timeout_s, 300.0), {}),
+        ("default", timeout_s, {"BENCH_REQUIRE_TPU": "1"}),
+        ("default", min(timeout_s, 300.0), {"BENCH_REQUIRE_TPU": "1"}),
         ("cpu", timeout_s,
          {"JAX_PLATFORMS": "cpu", "BENCH_FORCE_CPU": "1",
           "BENCH_STEPS": "1", "BENCH_CALLS": "1", "BENCH_ACCUM": "1",
@@ -345,26 +454,23 @@ def main() -> None:
           # malformed int must not take down the evidence-of-life attempt
           "BENCH_ATTN": "xla", "BENCH_MOM_DTYPE": "",
           "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4",
-          "BENCH_VOCAB_PAD": "0"}),
+          "BENCH_VOCAB_PAD": "0", "BENCH_REMAT": "noremat",
+          "BENCH_DTYPE": "bf16", "BENCH_BLOCK": "1024",
+          # an inherited TPU-only pin must not kill the evidence-of-life
+          # attempt — it exists precisely for when the TPU is unreachable
+          "BENCH_REQUIRE_TPU": ""}),
     )
     errors: list[str] = []
     for label, budget, env_extra in attempts:
         env = dict(os.environ)
         env.update(env_extra)
         try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--inner"],
-                capture_output=True,
-                text=True,
-                timeout=budget,
-                env=env,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
+            rc, stdout, stderr = _run_attempt(env, budget)
         except subprocess.TimeoutExpired:
             errors.append(f"[{label}] timeout after {budget:.0f}s")
             continue
-        result = _extract_json_line(proc.stdout)
-        if proc.returncode == 0 and result is not None:
+        result = _extract_json_line(stdout)
+        if rc == 0 and result is not None:
             if result.get("backend") == "tpu":
                 _record_tpu_measurement(result)
             else:
@@ -376,8 +482,8 @@ def main() -> None:
                     result["best_sweep_row"] = sweep
             print(json.dumps(result), flush=True)
             return
-        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
-        errors.append(f"[{label}] rc={proc.returncode}: " + " | ".join(tail))
+        tail = (stderr or stdout or "").strip().splitlines()[-8:]
+        errors.append(f"[{label}] rc={rc}: " + " | ".join(tail))
     print(
         json.dumps(
             {

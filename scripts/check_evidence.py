@@ -25,7 +25,16 @@ PARITY_MIN_STEP = 1900
 # error) per config before moving on, so the last config's row implies the
 # whole window executed
 SWEEP2_LAST_CONFIG = "512x1024@512x512"
-SFT7B_LAST_SPEC = "2048"
+# round-4 anchor-chasing window (scripts/SWEEP_r3_raw/sweep3.jsonl): the
+# last config is the T=2048 bwd-tile leg; batch_per_dev=2 disambiguates it
+# from sweep3's T=1024 rows with the same attn spec (row dicts are
+# insertion-ordered, so this fragment is stable)
+SWEEP3_LAST_CONFIG = '"batch_per_dev": 2, "attn": "flash@512x1024@512x512"'
+# structurally anchored to the last 7B spec's row (nf4:1:2:8::2048:dots →
+# json.dumps insertion order "accum": 8, "seq_len": 2048) — a bare "2048"
+# needle would also match unrelated numbers (ms_per_step, tok/s) in
+# EARLIER specs' rows and mark the stage captured before the 2048 leg ran
+SFT7B_LAST_SPEC = '"seq_len": 2048'
 
 
 def parity(mode: str) -> bool:
@@ -48,25 +57,65 @@ def parity(mode: str) -> bool:
         return False
 
 
-def _file_contains(path: str, needle: str) -> bool:
+def _window_captured(path: str, needle: str, result_key: str) -> bool:
+    """Captured = the LAST window config has a RESULT row (stages run
+    sequentially, so it implies every earlier config executed). An ERROR
+    row for the marker config does NOT count: a window where every config
+    failed fast (tunnel died mid-stage but each config still emitted an
+    error row) must not mark the stage captured — and because the files are
+    append-mode across watcher re-fires, a file-global "any result row"
+    check would be satisfied by a PREVIOUS window's banked rows. This is
+    the watcher's EXIT condition only — earlier configs that errored
+    transiently are retried regardless: the runbook's sweep stages run
+    UNCONDITIONALLY on every recovery and bench_sweep's SWEEP_SKIP_FILE
+    skips result-row configs only, so retries cost seconds, not chip
+    time."""
     try:
         with open(path) as f:
-            return needle in f.read()
+            return any(needle in line and result_key in line for line in f)
     except OSError:
         return False
 
 
 def sweep2() -> bool:
-    return _file_contains(os.path.join(OUT, "sweep2.jsonl"),
-                          SWEEP2_LAST_CONFIG)
+    return _window_captured(os.path.join(OUT, "sweep2.jsonl"),
+                            SWEEP2_LAST_CONFIG, "tokens_per_sec_per_chip")
+
+
+def sweep3() -> bool:
+    return _window_captured(os.path.join(OUT, "sweep3.jsonl"),
+                            SWEEP3_LAST_CONFIG, "tokens_per_sec_per_chip")
 
 
 def sft7b() -> bool:
-    return _file_contains(os.path.join(OUT, "sft7b2.jsonl"), SFT7B_LAST_SPEC)
+    return _window_captured(os.path.join(OUT, "sft7b2.jsonl"),
+                            SFT7B_LAST_SPEC, "tokens_per_sec_per_chip")
 
 
 def bench_best() -> bool:
     return os.path.exists(os.path.join(OUT, "bench_best.done"))
+
+
+def conv() -> bool:
+    """Real-corpus convergence artifact (VERDICT r3 stretch): ≥1900 steps of
+    the canonical-config run_clm with the reference's convergence signals
+    (eval accuracy/perplexity, /root/reference/run_clm.py:562-577, 630-636)
+    logged in runs/convergence/metrics.jsonl."""
+    try:
+        last, has_eval = 0, False
+        with open(os.path.join(REPO, "runs", "convergence",
+                               "metrics.jsonl")) as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                last = max(last, d.get("step", 0))
+                if any(k.startswith("eval/") for k in d):
+                    has_eval = True
+        return has_eval and last >= 1900
+    except OSError:
+        return False
 
 
 # the ONE stage list both check("all") and the CLI printout derive from —
@@ -74,11 +123,13 @@ def bench_best() -> bool:
 # status display together
 STAGES = [
     ("sweep2", sweep2),
+    ("sweep3", sweep3),
     ("bench_best", bench_best),
     ("sft7b", sft7b),
     ("parity:local", lambda: parity("local")),
     ("parity:vote", lambda: parity("vote")),
     ("parity:lazy", lambda: parity("lazy")),
+    ("conv", conv),
 ]
 
 
@@ -87,10 +138,14 @@ def check(what: str, arg: str | None = None) -> bool:
         return parity(arg or "local")
     if what == "sweep2":
         return sweep2()
+    if what == "sweep3":
+        return sweep3()
     if what == "sft7b":
         return sft7b()
     if what == "bench_best":
         return bench_best()
+    if what == "conv":
+        return conv()
     if what == "all":
         return all(fn() for _, fn in STAGES)
     raise SystemExit(f"unknown evidence check {what!r}")

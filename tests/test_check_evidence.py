@@ -1,0 +1,64 @@
+"""Evidence-window capture semantics (ADVICE r3): a window where every
+config failed fast still writes the last config's ERROR row — that must
+NOT mark the stage captured, or the re-arming TPU watcher
+(scripts/tpu_watch_loop.sh) exits with no real data for it."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "check_evidence", os.path.join(REPO, "scripts", "check_evidence.py"))
+ce = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ce)
+
+
+def _write(tmp_path, lines):
+    p = tmp_path / "w.jsonl"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+MARKER = '"attn": "flash@512x1024@512x512"'
+
+
+def test_all_error_window_is_not_captured(tmp_path):
+    path = _write(tmp_path, [
+        '{"attn": "flash@512x1024", "error": "rc=1: tunnel died"}',
+        '{"attn": "flash@512x1024@512x512", "error": "rc=1: tunnel died"}',
+    ])
+    assert not ce._window_captured(path, MARKER, "tokens_per_sec_per_chip")
+
+
+
+
+def test_marker_error_row_is_not_captured_even_with_banked_results(tmp_path):
+    """The files are append-mode across watcher re-fires: a PREVIOUS
+    window's banked result rows must not combine with THIS window's error
+    marker to fake a capture (code-review r4 finding on the file-global
+    any-result check)."""
+    path = _write(tmp_path, [
+        '{"attn": "flash@512x1024", "tokens_per_sec_per_chip": 98099.3}',
+        '{"attn": "flash@512x1024@512x512", "error": "OOM"}',
+    ])
+    assert not ce._window_captured(path, MARKER, "tokens_per_sec_per_chip")
+
+
+def test_marker_result_row_is_captured(tmp_path):
+    path = _write(tmp_path, [
+        '{"attn": "flash@512x1024", "error": "transient"}',
+        '{"attn": "flash@512x1024@512x512", "tokens_per_sec_per_chip": 97000.0}',
+    ])
+    assert ce._window_captured(path, MARKER, "tokens_per_sec_per_chip")
+
+
+def test_missing_marker_is_not_captured(tmp_path):
+    path = _write(tmp_path, [
+        '{"attn": "flash@512x1024", "tokens_per_sec_per_chip": 98099.3}',
+    ])
+    assert not ce._window_captured(path, MARKER, "tokens_per_sec_per_chip")
+
+
+def test_missing_file_is_not_captured(tmp_path):
+    assert not ce._window_captured(str(tmp_path / "nope.jsonl"), MARKER,
+                                   "tokens_per_sec_per_chip")
