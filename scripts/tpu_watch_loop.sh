@@ -29,6 +29,14 @@ while true; do
       echo "$(stamp) TPU up ($out); running stage-2 runbook"
       bash scripts/tpu_runbook_auto2.sh
       echo "$(stamp) runbook exited; re-checking evidence"
+      # bank whatever the window produced immediately — a later crash or
+      # round-end race must not lose captured chip evidence
+      git add scripts/SWEEP_r3_raw scripts/last_tpu_measurement.json \
+          runs/parity runs/convergence 2>/dev/null
+      if ! git diff --cached --quiet 2>/dev/null; then
+        git commit -q -m "Record TPU evidence captures from watcher window" \
+          && echo "$(stamp) committed window captures"
+      fi
       ;;
     "")
       echo "$(stamp) probe timed out/failed" ;;
