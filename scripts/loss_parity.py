@@ -330,26 +330,103 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
         return jnp.asarray(rows)
 
     log_path = os.path.join(out_dir, f"{mode}.jsonl")
+    ckpt_path = os.path.join(out_dir, f"{mode}.ckpt.npz")
+    dtype_name = str(cfg.param_dtype.__name__
+                     if hasattr(cfg.param_dtype, "__name__")
+                     else cfg.param_dtype)
+
+    # ---- mid-leg checkpoint/resume: a 2000-step leg is ~45 min of chip
+    # time and the tunnel drops without warning — without resume, every
+    # drop restarts the leg from step 0 AND truncates the partial curve
+    # (mode "w"). Params+momenta+iterator state persist every SAVE_EVERY
+    # steps (atomic tmp+rename), so a re-fired leg loses at most that
+    # window. The checkpoint stamps mode/dtype/steps and is ignored on
+    # mismatch (a config change must not silently splice curves).
+    SAVE_EVERY = 250
+    p_leaves, p_tree = jax.tree.flatten(params)
+    m_leaves, m_tree = jax.tree.flatten(moms)
     count = jnp.int32(0)
+    start_step = 0
+    resumed = False
+    if os.path.exists(ckpt_path):
+        try:
+            ck = np.load(ckpt_path, allow_pickle=False)
+            meta_ok = (str(ck["mode"]) == mode
+                       and str(ck["param_dtype"]) == dtype_name
+                       and int(ck["steps"]) == steps)
+            if meta_ok and int(ck["step"]) + 1 < steps:
+                params = jax.tree.unflatten(
+                    p_tree, [jnp.asarray(ck[f"p{i}"])
+                             for i in range(len(p_leaves))])
+                moms = jax.tree.unflatten(
+                    m_tree, [jnp.asarray(ck[f"m{i}"])
+                             for i in range(len(m_leaves))])
+                if mode == "lazy":
+                    cache = jnp.asarray(ck["cache"])
+                count = jnp.int32(int(ck["count"]))
+                start_step = int(ck["step"]) + 1
+                pos = int(ck["pos"])
+                order = np.asarray(ck["order"])
+                rng.bit_generator.state = json.loads(str(ck["rng_state"]))
+                resumed = True
+                # rows past the checkpoint will be re-run and re-logged —
+                # drop them now or the curve carries duplicate steps
+                try:
+                    with open(log_path) as f:
+                        kept = [ln for ln in f
+                                if json.loads(ln).get("meta")
+                                or json.loads(ln).get("step", steps)
+                                < start_step]
+                    with open(log_path, "w") as f:
+                        f.writelines(kept)
+                except (OSError, json.JSONDecodeError):
+                    pass
+                print(f"[run:{mode}] resumed checkpoint at step {start_step}")
+            elif meta_ok:
+                print(f"[run:{mode}] checkpoint already at final step — "
+                      "leg complete, nothing to do")
+                return
+            else:
+                print(f"[run:{mode}] checkpoint config mismatch — fresh run")
+        except Exception as e:  # corrupt/partial ckpt: fresh run
+            print(f"[run:{mode}] checkpoint unreadable ({e}) — fresh run")
+
+    def save_ckpt(s):
+        arrs = {f"p{i}": np.asarray(p) for i, p in
+                enumerate(jax.tree.leaves(params))}
+        arrs.update({f"m{i}": np.asarray(m) for i, m in
+                     enumerate(jax.tree.leaves(moms))})
+        if mode == "lazy":
+            arrs["cache"] = np.asarray(cache)
+        arrs.update(mode=mode, param_dtype=dtype_name, steps=steps,
+                    step=s, count=int(np.asarray(count)), pos=pos,
+                    order=order,
+                    rng_state=json.dumps(rng.bit_generator.state))
+        tmp = ckpt_path + ".tmp.npz"  # .npz suffix: np.savez appends it
+        np.savez(tmp, **arrs)         # to any other name, breaking the
+        os.replace(tmp, ckpt_path)    # atomic rename
+
     t0 = time.time()
-    with open(log_path, "w") as logf:
+    with open(log_path, "a" if resumed else "w") as logf:
         # header row stamps the config so curve consumers (check_evidence,
         # report) can reject runs captured under a different precision —
         # bf16-era curves had frozen large-magnitude params (see the f32
         # master-params comment above) and must not be compared against
         # f32 runs as if the optimizer mode were the difference
-        logf.write(json.dumps({
-            "meta": True, "mode": mode, "param_dtype": str(cfg.param_dtype.__name__
-            if hasattr(cfg.param_dtype, "__name__") else cfg.param_dtype),
-            "lr": LR, "workers": WORKERS, "steps": steps,
-        }) + "\n")
-        for s in range(steps):
+        if not resumed:
+            logf.write(json.dumps({
+                "meta": True, "mode": mode, "param_dtype": dtype_name,
+                "lr": LR, "workers": WORKERS, "steps": steps,
+            }) + "\n")
+        for s in range(start_step, steps):
             if mode == "lazy":
                 params, moms, cache, count, loss = step_fn(
                     params, moms, cache, count, next_batch())
             else:
                 params, moms, count, loss = step_fn(
                     params, moms, count, next_batch())
+            if (s + 1) % SAVE_EVERY == 0 and s != steps - 1:
+                save_ckpt(s)
             if s % log_every == 0 or s == steps - 1:
                 lv = float(np.asarray(jax.device_get(loss)))
                 rec = {"step": s, "loss": round(lv, 5),
@@ -367,6 +444,12 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
                     {"step": s, "eval_loss": round(ev, 5)}) + "\n")
                 logf.flush()
                 print(f"[run:{mode}] step {s}: eval {ev:.4f}")
+    # a completed leg's checkpoint is dead weight (and a stale one could
+    # splice duplicate tail rows if the jsonl were ever lost) — drop it
+    try:
+        os.remove(ckpt_path)
+    except OSError:
+        pass
     print(f"[run:{mode}] done: {log_path}")
 
 
