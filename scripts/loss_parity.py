@@ -347,45 +347,62 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
     m_leaves, m_tree = jax.tree.flatten(moms)
     count = jnp.int32(0)
     start_step = 0
+    wall_base = 0.0  # cumulative wall time from earlier windows
     resumed = False
     if os.path.exists(ckpt_path):
+        # TRANSACTIONAL restore: decode everything into temporaries first —
+        # a partial/old-format npz that raises halfway must not leave
+        # params at checkpoint values while the leg restarts "fresh" at
+        # step 0 (a silently corrupt curve)
         try:
             ck = np.load(ckpt_path, allow_pickle=False)
             meta_ok = (str(ck["mode"]) == mode
                        and str(ck["param_dtype"]) == dtype_name
                        and int(ck["steps"]) == steps)
-            if meta_ok and int(ck["step"]) + 1 < steps:
-                params = jax.tree.unflatten(
+            if meta_ok:
+                r_params = jax.tree.unflatten(
                     p_tree, [jnp.asarray(ck[f"p{i}"])
                              for i in range(len(p_leaves))])
-                moms = jax.tree.unflatten(
+                r_moms = jax.tree.unflatten(
                     m_tree, [jnp.asarray(ck[f"m{i}"])
                              for i in range(len(m_leaves))])
+                r_cache = (jnp.asarray(ck["cache"])
+                           if mode == "lazy" else None)
+                r_count = jnp.int32(int(ck["count"]))
+                r_pos = int(ck["pos"])
+                r_order = np.asarray(ck["order"])
+                r_rng_state = json.loads(str(ck["rng_state"]))
+                r_wall = float(ck["wall_s"]) if "wall_s" in ck else 0.0
+                # every key decoded — commit the restore atomically
+                params, moms, count = r_params, r_moms, r_count
                 if mode == "lazy":
-                    cache = jnp.asarray(ck["cache"])
-                count = jnp.int32(int(ck["count"]))
+                    cache = r_cache
                 start_step = int(ck["step"]) + 1
-                pos = int(ck["pos"])
-                order = np.asarray(ck["order"])
-                rng.bit_generator.state = json.loads(str(ck["rng_state"]))
+                pos, order = r_pos, r_order
+                rng.bit_generator.state = r_rng_state
+                wall_base = r_wall
                 resumed = True
                 # rows past the checkpoint will be re-run and re-logged —
-                # drop them now or the curve carries duplicate steps
+                # drop them now or the curve carries duplicate steps. Parse
+                # per line: a TORN last line is the normal artifact of the
+                # crash resume exists for, and must be dropped, not abort
+                # the prune (report()'s loader would crash on it later).
                 try:
+                    kept = []
                     with open(log_path) as f:
-                        kept = [ln for ln in f
-                                if json.loads(ln).get("meta")
-                                or json.loads(ln).get("step", steps)
-                                < start_step]
+                        for ln in f:
+                            try:
+                                d = json.loads(ln)
+                            except json.JSONDecodeError:
+                                continue
+                            if d.get("meta") or d.get("step", steps) \
+                                    < start_step:
+                                kept.append(ln)
                     with open(log_path, "w") as f:
                         f.writelines(kept)
-                except (OSError, json.JSONDecodeError):
+                except OSError:
                     pass
                 print(f"[run:{mode}] resumed checkpoint at step {start_step}")
-            elif meta_ok:
-                print(f"[run:{mode}] checkpoint already at final step — "
-                      "leg complete, nothing to do")
-                return
             else:
                 print(f"[run:{mode}] checkpoint config mismatch — fresh run")
         except Exception as e:  # corrupt/partial ckpt: fresh run
@@ -401,19 +418,27 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
         arrs.update(mode=mode, param_dtype=dtype_name, steps=steps,
                     step=s, count=int(np.asarray(count)), pos=pos,
                     order=order,
-                    rng_state=json.dumps(rng.bit_generator.state))
+                    rng_state=json.dumps(rng.bit_generator.state),
+                    # cumulative wall time: logged wall_s/tok-s must stay
+                    # monotone and honest across resume boundaries
+                    wall_s=wall_base + (time.time() - t0))
         tmp = ckpt_path + ".tmp.npz"  # .npz suffix: np.savez appends it
         np.savez(tmp, **arrs)         # to any other name, breaking the
         os.replace(tmp, ckpt_path)    # atomic rename
 
     t0 = time.time()
+    # header row stamps the config so curve consumers (check_evidence,
+    # report) can reject runs captured under a different precision —
+    # bf16-era curves had frozen large-magnitude params (see the f32
+    # master-params comment above) and must not be compared against
+    # f32 runs as if the optimizer mode were the difference. Written on
+    # fresh runs AND on a resume whose log vanished (a ckpt without its
+    # jsonl would otherwise produce a headerless curve check_evidence
+    # rejects for no visible reason).
+    need_meta = (not resumed or not os.path.exists(log_path)
+                 or os.path.getsize(log_path) == 0)
     with open(log_path, "a" if resumed else "w") as logf:
-        # header row stamps the config so curve consumers (check_evidence,
-        # report) can reject runs captured under a different precision —
-        # bf16-era curves had frozen large-magnitude params (see the f32
-        # master-params comment above) and must not be compared against
-        # f32 runs as if the optimizer mode were the difference
-        if not resumed:
+        if need_meta:
             logf.write(json.dumps({
                 "meta": True, "mode": mode, "param_dtype": dtype_name,
                 "lr": LR, "workers": WORKERS, "steps": steps,
@@ -432,7 +457,7 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
                 rec = {"step": s, "loss": round(lv, 5),
                        "lr": float(schedule(s)),
                        "tokens": (s + 1) * gb * T,
-                       "wall_s": round(time.time() - t0, 1)}
+                       "wall_s": round(wall_base + time.time() - t0, 1)}
                 logf.write(json.dumps(rec) + "\n")
                 logf.flush()
                 print(f"[run:{mode}] step {s}: loss {lv:.4f} "
