@@ -488,7 +488,11 @@ def report(out_dir: str) -> None:
             return None, None
         with open(path) as f:
             for line in f:
-                r = json.loads(line)
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn last line: the leg died mid-write
+                    # AFTER the capture threshold — the curve is valid
                 if "eval_loss" in r:
                     ev[r["step"]] = r["eval_loss"]
                 elif "loss" in r:
