@@ -169,12 +169,40 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
     trainer.close()
 
 
+def _captured_keys() -> set:
+    """Specs already holding a RESULT row in $SFT7B_SKIP_FILE (the jsonl
+    the runbook appends to): a watcher-re-fired window resumes at the
+    first unmeasured spec instead of re-burning minutes of 7B quantize +
+    compile per captured one. Error rows don't count — failed specs get
+    retried. Key = the spec-derived config fields (n_layer is resolved
+    model-side, so it's not part of the key)."""
+    path = os.environ.get("SFT7B_SKIP_FILE", "")
+    keys: set = set()
+    if not path:
+        return keys
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if d.get("tokens_per_sec_per_chip"):
+                    keys.add((d.get("quant"), d.get("batch_per_dev"),
+                              d.get("accum"), d.get("seq_len"),
+                              d.get("remat_policy"), d.get("vocab_chunks")))
+    except OSError:
+        pass
+    return keys
+
+
 if __name__ == "__main__":
     from distributed_lion_tpu.parallel.mesh import force_cpu_platform
 
     force_cpu_platform()
     specs = sys.argv[1:] or ["nf4:1:4:8"]
     DEFAULTS = ["nf4", "1", "4", "8", "", "1024", "full"]
+    captured = _captured_keys()
     for spec in specs:
         parts = spec.split(":")
         # pad with the defaults for the MISSING tail fields only (a plain
@@ -182,6 +210,11 @@ if __name__ == "__main__":
         # "nf4:1:4:8" must mean full-depth T=1024, not n_layer=1 seq=4)
         parts = (parts + DEFAULTS[len(parts):])[:7]
         quant, bs, accum, vc, nl, sl, pol = parts
+        if (quant, int(bs), int(accum), int(sl), pol or "full",
+                int(vc or 0)) in captured:
+            print(f"[7b] skip (already captured): {spec}", file=sys.stderr,
+                  flush=True)
+            continue
         try:
             run(quant, int(bs), int(accum), int(vc or 0),
                 None if not nl else int(nl), int(sl), remat_policy=pol or "full")

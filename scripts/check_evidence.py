@@ -30,10 +30,11 @@ SWEEP2_LAST_CONFIG = "512x1024@512x512"
 # from sweep3's T=1024 rows with the same attn spec (row dicts are
 # insertion-ordered, so this fragment is stable)
 SWEEP3_LAST_CONFIG = '"batch_per_dev": 2, "attn": "flash@512x1024@512x512"'
-# structurally anchored to the last 7B spec's row (nf4:1:2:8::2048:dots →
-# json.dumps insertion order "accum": 8, "seq_len": 2048) — a bare "2048"
-# needle would also match unrelated numbers (ms_per_step, tok/s) in
-# EARLIER specs' rows and mark the stage captured before the 2048 leg ran
+# structurally anchored to the last 7B spec's row (nf4:1:2:8::2048:dots —
+# the only spec with seq_len 2048, and row dicts are insertion-ordered) —
+# a bare "2048" needle would also match unrelated numbers (ms_per_step,
+# tok/s) in EARLIER specs' rows and mark the stage captured before the
+# 2048 leg ran
 SFT7B_LAST_SPEC = '"seq_len": 2048'
 
 

@@ -161,7 +161,7 @@ fi
 if python scripts/check_evidence.py sft7b; then
   echo "$(stamp) 7B already captured (last spec row present) — skip" | tee -a "$OUT/log.txt"
 else
-  timeout 3000 env JAX_PLATFORMS=axon,cpu \
+  timeout 3000 env JAX_PLATFORMS=axon,cpu SFT7B_SKIP_FILE="$OUT/sft7b2.jsonl" \
       python scripts/bench_sft_7b.py nf4:1:4:8 nf4:1:4:8::1024:dots \
       nf4:1:2:8::2048:dots \
       >> "$OUT/sft7b2.jsonl" 2>> "$OUT/sft7b2.err"
