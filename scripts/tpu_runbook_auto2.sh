@@ -1,18 +1,27 @@
 #!/bin/bash
-# Round-3 stage-2 runbook: the evidence axes still missing after the first
-# window (scripts/tpu_runbook_auto.sh captured flagship bench, the lever
-# sweep, and the chunks8 re-bench before the tunnel hung mid-7B).
+# Round-4 evidence runbook, ordered for SHORT tunnel windows (round-3
+# windows lasted ~30 min; the full program needs ~4-5h of chip):
 #
-# Ordering: combination sweep first (it decides the flagship config and
-# takes ~15 min), then the promoted-config bench refresh (headline), then
-# the FIXED 7B specs (the first window's specs were mis-parsed by the old
-# positional-default bug and ran n_layer=1 — see bench_sft_7b.py), then the
-# three 2000-step parity legs (longest, least tunnel-risk-sensitive).
+#   1. bench_best   — ~8 min: re-capture bench.py under the banked sweep
+#                     winner (98.1k config) so the headline artifact is a
+#                     driver-methodology TPU number as early as possible.
+#   2. sweep3       — the >100k anchor-chasing configs (lever stacking +
+#                     T=2048 legs).
+#   3. bench_best2  — if sweep3 found something above the new headline,
+#                     re-capture once more.
+#   4. sweep2       — the remaining round-3 lever table (completes the
+#                     published sweep evidence).
+#   5. sft7b        — NF4+LoRA Llama-2-7B rows (per-spec skip on re-fire).
+#   6. parity legs  — 3 x 2000 steps (mid-leg checkpoint/resume: a window
+#                     drop costs <=250 steps, not the leg).
+#   7. conv         — 2000-step real-corpus canonical-config run (Orbax
+#                     resume).
 #
-# IDEMPOTENT: every stage checks whether its evidence already exists and
-# skips itself, so the loop watcher (tpu_watch_loop.sh) can re-run the
-# whole runbook after a mid-run tunnel drop without re-burning chip time
-# on captured stages.
+# IDEMPOTENT: capture-complete stages skip themselves; sweep stages run
+# unconditionally but skip per-config via SWEEP_SKIP_FILE (so transiently
+# errored configs retry on every recovery); the loop watcher
+# (tpu_watch_loop.sh) re-runs the whole runbook after a mid-run tunnel
+# drop without re-burning chip time on captured work.
 set -u
 cd "$(dirname "$0")/.."
 OUT=scripts/SWEEP_r3_raw
@@ -21,63 +30,17 @@ stamp() { date -u +%FT%TZ; }
 
 echo "$(stamp) stage-2 runbook start" | tee -a "$OUT/log.txt"
 
-# APPEND (>>): sweep2.jsonl already holds the first combo window's banked
-# winner (flash@512x1024+chunks8+bf16mom = 98,099 tok/s). Only the configs
-# that window did NOT reach run here; flash@1024x1024 is excluded — its
-# remote_compile hung >14 min and had to be killed. Completion marker
-# (check_evidence.py sweep2): the LAST window config's row — stages run
-# sequentially and every config emits a row (result or error), so the last
-# row implies the whole window executed.
-# NO capture guard on the sweep stages: SWEEP_SKIP_FILE makes bench_sweep
-# skip every already-measured config (a fully-captured window exits in
-# seconds), so running unconditionally means configs that errored
-# transiently in an earlier window keep getting retried on every recovery
-# until they hold a result row — check_evidence's marker-result semantics
-# stay the watcher's EXIT condition only.
-{
-  timeout 3000 env SWEEP_SKIP_FILE="$OUT/sweep2.jsonl" BENCH_REQUIRE_TPU=1 python scripts/bench_sweep.py \
-      noremat:4:flash@512x1024:16:bf16:8:bfloat16:1024 \
-      noremat:4:flash@512x1024:16:bf16:0:bfloat16:1024 \
-      noremat:8:flash@512x1024:8:bf16:8:bfloat16 \
-      noremat:4:flash@512x1024:32:bf16:8:bfloat16 \
-      noremat:4:flash@512x512:16:bf16:8:bfloat16 \
-      noremat:4:flash@256x1024:16:bf16:8:bfloat16 \
-      noremat:4:xla_bf16:16:bf16:8:bfloat16 \
-      noremat:4:flash@512x1024:16:bf16:16:bfloat16 \
-      noremat:4:flash@512x1024@256x512:16:bf16:8:bfloat16 \
-      noremat:4:flash@512x1024@512x512:16:bf16:8:bfloat16 \
-      >> "$OUT/sweep2.jsonl" 2>> "$OUT/sweep2.err"
-  rc=$?; echo "$(stamp) sweep2 rc=$rc" | tee -a "$OUT/log.txt"
-}
-
-# round-4 anchor-chasing window: stack the levers sweep2 measures singly
-# (bwd tiles x vocab_pad x xla_bf16-scores x dots-remat x chunk count),
-# then the T=2048 long-context legs (flash's memory regime; NOT anchor-
-# comparable — the anchor is the T=1024 canonical workload). The last
-# config (batch 2, bwd tiles, T=2048) is check_evidence's sweep3 marker.
-{
-  timeout 3600 env SWEEP_SKIP_FILE="$OUT/sweep3.jsonl" BENCH_REQUIRE_TPU=1 python scripts/bench_sweep.py \
-      noremat:4:flash@512x1024@512x512:16:bf16:8:bfloat16:1024 \
-      noremat:4:flash@512x1024@256x512:16:bf16:8:bfloat16:1024 \
-      noremat:4:xla_bf16:16:bf16:8:bfloat16:1024 \
-      noremat:4:flash@512x1024:16:bf16:4:bfloat16:1024 \
-      noremat:8:flash@512x1024:16:bf16:8:bfloat16:1024 \
-      dots:8:flash@512x1024:8:bf16:8:bfloat16 \
-      noremat:2:flash@512x1024:16:bf16:8:bfloat16:0:2048 \
-      noremat:2:flash@512x1024@512x512:16:bf16:8:bfloat16:0:2048 \
-      >> "$OUT/sweep3.jsonl" 2>> "$OUT/sweep3.err"
-  rc=$?; echo "$(stamp) sweep3 rc=$rc" | tee -a "$OUT/log.txt"
-}
-
-# pick the sweep2 winner and re-bench bench.py under it via env knobs so
-# last_tpu_measurement.json reflects the best measured config. The
-# bench_best.done marker (written after any successful TPU re-bench) makes
-# this stage run at most once: without it, a re-bench that measures BELOW
-# its sweep row would leave recorded < best forever and re-burn ~20 min of
-# chip on every watcher recovery.
-if python scripts/check_evidence.py bench_best; then
-  echo "$(stamp) bench(best) already captured — skip" | tee -a "$OUT/log.txt"
-else
+# Pick the best promotable sweep row across sweep*.jsonl and re-bench
+# bench.py under it via env knobs so last_tpu_measurement.json reflects
+# the best measured config. $1 names the run-at-most-once marker: without
+# it, a re-bench that measures BELOW its sweep row would leave recorded <
+# best forever and re-burn ~10 min of chip on every watcher recovery.
+bench_best_stage() {
+  local marker="$1"
+  if [ -e "$OUT/$marker.done" ]; then
+    echo "$(stamp) $marker already captured — skip" | tee -a "$OUT/log.txt"
+    return
+  fi
 python - "$OUT" > "$OUT/winner.env" <<'EOF'
 import glob, json, sys
 sys.path.insert(0, ".")
@@ -117,22 +80,34 @@ if rows:
     print(f"export BENCH_REMAT={best.get('remat', 'noremat')}")
     print(f"export BENCH_DTYPE={best.get('dtype', 'bf16')}")
 EOF
-if [ ! -s "$OUT/winner.env" ]; then
-  echo "$(stamp) no sweep2 winner above the recorded headline — skipping re-bench" | tee -a "$OUT/log.txt"
-else
-cat "$OUT/winner.env" | tee -a "$OUT/log.txt"
-# shellcheck disable=SC1090
-. "$OUT/winner.env" 2>/dev/null || true
-# bench.py rewrites the headline artifact on every successful TPU run;
-# snapshot it so a winner that regresses vs the recorded number (possible:
-# the combo interactions are untested) can't silently lower the headline
-cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_best" 2>/dev/null || true
-timeout 1200 python bench.py > "$OUT/bench_best.json" 2> "$OUT/bench_best.err"
-rc=$?; echo "$(stamp) bench(best) rc=$rc" | tee -a "$OUT/log.txt"
-unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM BENCH_VOCAB_PAD BENCH_REMAT BENCH_DTYPE
-if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' "$OUT/bench_best.json"; then
-  date -u +%FT%TZ > "$OUT/bench_best.done"
-fi
+  if [ ! -s "$OUT/winner.env" ]; then
+    echo "$(stamp) $marker: no sweep winner above the recorded headline" | tee -a "$OUT/log.txt"
+    # nothing better to chase — mark done so the stage stops re-checking
+    # only for the SECOND pass (the first must stay armed until a capture
+    # happens: its purpose is a driver-methodology TPU number, and before
+    # one exists the winner list is never empty)
+    if [ "$marker" = "bench_best2" ]; then
+      date -u +%FT%TZ > "$OUT/$marker.done"
+    fi
+    return
+  fi
+  cat "$OUT/winner.env" | tee -a "$OUT/log.txt"
+  # shellcheck disable=SC1090
+  . "$OUT/winner.env" 2>/dev/null || true
+  # bench.py rewrites the headline artifact on every successful TPU run;
+  # snapshot it so a winner that regresses vs the recorded number
+  # (possible: combo interactions are untested) can't silently lower it
+  cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_best" 2>/dev/null || true
+  timeout 1200 python bench.py > "$OUT/$marker.json" 2> "$OUT/$marker.err"
+  local rc=$?
+  echo "$(stamp) $marker rc=$rc" | tee -a "$OUT/log.txt"
+  unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM BENCH_VOCAB_PAD BENCH_REMAT BENCH_DTYPE
+  if [ $rc -eq 0 ] && grep -q '"backend": "tpu"' "$OUT/$marker.json"; then
+    date -u +%FT%TZ > "$OUT/$marker.done"
+    # check_evidence's bench_best stage reads bench_best.done — a second-
+    # pass capture satisfies the same evidence axis
+    [ "$marker" = "bench_best2" ] && date -u +%FT%TZ > "$OUT/bench_best.done"
+  fi
 python - "$OUT" >> "$OUT/log.txt" <<'EOF'
 import json, sys
 out = sys.argv[1]
@@ -152,12 +127,58 @@ if old > new:
 else:
     print(f"bench(best) {new} >= prior {old}: new headline artifact kept")
 EOF
-fi
-fi
+}
 
-# 7B QLoRA evidence with the FIXED spec parser + host-side init (the
-# "axon,cpu" platform list exposes the host backend the init path uses;
-# axon stays first = default, so compute still runs on the chip)
+# ---- 1. headline capture under the banked winner (the 98,099 tok/s row
+# is already committed in sweep2.jsonl, so this needs no sweep first)
+bench_best_stage bench_best
+
+# ---- 2. round-4 anchor-chasing window: stack the levers round 3
+# measured singly (bwd tiles x vocab_pad x xla_bf16-scores x dots-remat x
+# chunk count), then the T=2048 long-context legs (flash's memory regime;
+# NOT anchor-comparable — the anchor is the T=1024 canonical workload).
+# The last config (batch 2, bwd tiles, T=2048) is check_evidence's sweep3
+# marker.
+{
+  timeout 3600 env SWEEP_SKIP_FILE="$OUT/sweep3.jsonl" BENCH_REQUIRE_TPU=1 python scripts/bench_sweep.py \
+      noremat:4:flash@512x1024@512x512:16:bf16:8:bfloat16:1024 \
+      noremat:4:flash@512x1024@256x512:16:bf16:8:bfloat16:1024 \
+      noremat:4:xla_bf16:16:bf16:8:bfloat16:1024 \
+      noremat:4:flash@512x1024:16:bf16:4:bfloat16:1024 \
+      noremat:8:flash@512x1024:16:bf16:8:bfloat16:1024 \
+      dots:8:flash@512x1024:8:bf16:8:bfloat16 \
+      noremat:2:flash@512x1024:16:bf16:8:bfloat16:0:2048 \
+      noremat:2:flash@512x1024@512x512:16:bf16:8:bfloat16:0:2048 \
+      >> "$OUT/sweep3.jsonl" 2>> "$OUT/sweep3.err"
+  rc=$?; echo "$(stamp) sweep3 rc=$rc" | tee -a "$OUT/log.txt"
+}
+
+# ---- 3. if sweep3 beat the captured headline, re-capture once
+bench_best_stage bench_best2
+
+# ---- 4. the remaining round-3 lever table. APPEND (>>): sweep2.jsonl
+# already holds the first combo window's banked winner
+# (flash@512x1024+chunks8+bf16mom = 98,099 tok/s); flash@1024x1024 is
+# excluded — its remote_compile hung >14 min and had to be killed.
+{
+  timeout 3000 env SWEEP_SKIP_FILE="$OUT/sweep2.jsonl" BENCH_REQUIRE_TPU=1 python scripts/bench_sweep.py \
+      noremat:4:flash@512x1024:16:bf16:8:bfloat16:1024 \
+      noremat:4:flash@512x1024:16:bf16:0:bfloat16:1024 \
+      noremat:8:flash@512x1024:8:bf16:8:bfloat16 \
+      noremat:4:flash@512x1024:32:bf16:8:bfloat16 \
+      noremat:4:flash@512x512:16:bf16:8:bfloat16 \
+      noremat:4:flash@256x1024:16:bf16:8:bfloat16 \
+      noremat:4:xla_bf16:16:bf16:8:bfloat16 \
+      noremat:4:flash@512x1024:16:bf16:16:bfloat16 \
+      noremat:4:flash@512x1024@256x512:16:bf16:8:bfloat16 \
+      noremat:4:flash@512x1024@512x512:16:bf16:8:bfloat16 \
+      >> "$OUT/sweep2.jsonl" 2>> "$OUT/sweep2.err"
+  rc=$?; echo "$(stamp) sweep2 rc=$rc" | tee -a "$OUT/log.txt"
+}
+
+# ---- 5. 7B QLoRA evidence with the FIXED spec parser + host-side init
+# (the "axon,cpu" platform list exposes the host backend the init path
+# uses; axon stays first = default, so compute still runs on the chip)
 if python scripts/check_evidence.py sft7b; then
   echo "$(stamp) 7B already captured (last spec row present) — skip" | tee -a "$OUT/log.txt"
 else
@@ -168,6 +189,8 @@ else
   rc=$?; echo "$(stamp) 7b(fixed) rc=$rc" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
+# most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do
   if python scripts/check_evidence.py parity "$mode"; then
     echo "$(stamp) parity:$mode already captured — skip" | tee -a "$OUT/log.txt"
@@ -179,12 +202,12 @@ for mode in local vote lazy; do
 done
 python scripts/loss_parity.py --phase report >> "$OUT/log.txt" 2>&1
 
-# LAST stage (VERDICT r3 stretch, after all higher-priority evidence): a
-# real-corpus convergence artifact — 2000 steps of the canonical config
-# (bs 20 x accum 8, GPT-2 124M) on the parity corpus through the native
-# BPE, with the reference's convergence signals (eval accuracy/perplexity)
-# logged. Orbax resume (save_steps 250) makes a tunnel drop cost one
-# checkpoint interval, not the run: the stage re-fires idempotently.
+# ---- 7. LAST stage (VERDICT r3 stretch, after all higher-priority
+# evidence): a real-corpus convergence artifact — 2000 steps of the
+# canonical config (bs 20 x accum 8, GPT-2 124M) on the parity corpus
+# through the native BPE, with the reference's convergence signals (eval
+# accuracy/perplexity) logged. Orbax resume (save_steps 250) makes a
+# tunnel drop cost one checkpoint interval, not the run.
 if python scripts/check_evidence.py conv; then
   echo "$(stamp) convergence run already captured — skip" | tee -a "$OUT/log.txt"
 else
