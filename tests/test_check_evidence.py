@@ -62,3 +62,58 @@ def test_missing_marker_is_not_captured(tmp_path):
 def test_missing_file_is_not_captured(tmp_path):
     assert not ce._window_captured(str(tmp_path / "nope.jsonl"), MARKER,
                                    "tokens_per_sec_per_chip")
+
+
+def test_sweep_skip_keys_round_trip(tmp_path, monkeypatch):
+    """bench_sweep's per-config resume: result rows (old round-3 schema and
+    new backend-carrying schema) produce skip keys; error rows don't."""
+    import importlib.util
+    import json as _json
+
+    p = tmp_path / "sweep.jsonl"
+    p.write_text("\n".join([
+        # round-3 row (no backend/block fields)
+        _json.dumps({"remat": "noremat", "batch_per_dev": 4,
+                     "attn": "flash@512x1024", "accum": 16, "dtype": "bf16",
+                     "vocab_chunks": 8, "mom_dtype": "bfloat16",
+                     "ms_per_step": 668.1, "loss": 9.045,
+                     "tokens_per_sec_per_chip": 98099.3}),
+        # round-4 row
+        _json.dumps({"remat": "noremat", "batch_per_dev": 2,
+                     "attn": "flash@512x1024", "accum": 16, "dtype": "bf16",
+                     "vocab_chunks": 8, "mom_dtype": "bfloat16",
+                     "vocab_pad": 0, "block": 2048,
+                     "tokens_per_sec_per_chip": 50000.0, "backend": "tpu"}),
+        # error row: must be retried, not skipped
+        _json.dumps({"remat": "noremat", "batch_per_dev": 8,
+                     "attn": "flash@512x1024", "accum": 8, "dtype": "bf16",
+                     "error": "timeout"}),
+    ]) + "\n")
+    monkeypatch.setenv("SWEEP_SKIP_FILE", str(p))
+    spec = importlib.util.spec_from_file_location(
+        "bench_sweep", os.path.join(REPO, "scripts", "bench_sweep.py"))
+    bs = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bs)
+    keys = bs._captured_keys()
+    assert ("noremat", 4, "flash@512x1024", 16, "bf16", 8, "bfloat16",
+            0, 1024) in keys
+    assert ("noremat", 2, "flash@512x1024", 16, "bf16", 8, "bfloat16",
+            0, 2048) in keys
+    assert len(keys) == 2  # the error row contributed nothing
+
+
+def test_sweep_row_promotable_rule():
+    """bench.sweep_row_promotable: the ONE eligibility rule shared by
+    _best_sweep_row and the runbook winner promotion."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    ok = {"tokens_per_sec_per_chip": 98099.3}
+    assert b.sweep_row_promotable(ok)                       # legacy row
+    assert b.sweep_row_promotable({**ok, "backend": "tpu"})
+    assert not b.sweep_row_promotable({**ok, "backend": "cpu"})
+    assert not b.sweep_row_promotable({**ok, "block": 2048})  # not anchor
+    assert not b.sweep_row_promotable({"error": "boom"})
