@@ -199,11 +199,75 @@ def run_inner() -> None:
     # BENCH_* env knobs parameterize the ONE timed-step implementation:
     # bench.py IS the sweep harness's measurement core (scripts/
     # bench_sweep.py spawns `bench.py --inner` per config), so a sweep row
-    # and a bench capture can never disagree on methodology again
-    remat_s = os.environ.get("BENCH_REMAT", "noremat")  # noremat|full|dots
-    dtype_s = os.environ.get("BENCH_DTYPE", "bf16")  # bf16|f32 param dtype
-    block = int(os.environ.get("BENCH_BLOCK", 1024))  # tokens/sequence; a
-    # non-default value also sets n_ctx (T=2048 long-context legs)
+    # and a bench capture can never disagree on methodology again.
+    # Unset knobs default to the recorded PROMOTED flagship config (the
+    # "config" block of scripts/last_tpu_measurement.json): when the
+    # TPU-window automation promotes a faster sweep config (its bench_best
+    # stage runs with BENCH_PROMOTE=1), a later bare `python bench.py` —
+    # the driver's own capture — measures THAT flagship, not a stale
+    # built-in. Gated three ways (code-review r4): only promoted records
+    # are adopted (a one-off debug run's knobs must not poison future
+    # headline captures — adoption itself re-marks the new record promoted
+    # so the chain survives bare re-runs); eligibility goes through the
+    # ONE sweep_row_promotable rule (backend + anchor-workload block); and
+    # every adopted value is validated below with a fallback to built-ins
+    # (a corrupt committed artifact must not take down both full-budget
+    # TPU attempts — that's the CPU fallback's failure class, not ours).
+    rec_cfg = {}
+    if backend == "tpu":
+        rec = _load_last_tpu_measurement() or {}
+        if rec.get("promoted") and isinstance(rec.get("config"), dict):
+            probe = {"tokens_per_sec_per_chip": rec.get("value"),
+                     "backend": rec.get("backend"),
+                     "block": rec["config"].get("block", 1024)}
+            if sweep_row_promotable(probe):
+                rec_cfg = rec["config"]
+    def _resolve_knobs(rc):
+        def knob(env_key, rec_key, builtin):
+            v = os.environ.get(env_key)
+            return v if v is not None else rc.get(rec_key, builtin)
+
+        k = {
+            "remat": str(knob("BENCH_REMAT", "remat", "noremat")),
+            "dtype": str(knob("BENCH_DTYPE", "dtype", "bf16")),
+            "block": int(knob("BENCH_BLOCK", "block", 1024)),
+            "batch_per_dev": int(knob("BENCH_BATCH", "batch_per_dev", 4)),
+            "accum": int(knob("BENCH_ACCUM", "accum", 16)),
+            "vocab_chunks": int(knob("BENCH_VOCAB_CHUNKS",
+                                     "vocab_chunks", 8)),
+            "mom_dtype": str(knob("BENCH_MOM_DTYPE", "mom_dtype",
+                                  "bfloat16")),
+            # 'auto' resolves to the tile-tuned flash winner at the
+            # flagship shape (T=1024 on TPU → flash@512x1024,
+            # ops/attention.attention dispatch, round-3 sweep row) — the
+            # flagship bench needs no explicit attn spec
+            "attn": str(knob("BENCH_ATTN", "attn", "auto")),
+            "vocab_pad": int(knob("BENCH_VOCAB_PAD", "vocab_pad", 0)),
+        }
+        if k["remat"] not in ("noremat", "full", "dots"):
+            raise ValueError(f"bad remat {k['remat']!r}")
+        if k["dtype"] not in ("bf16", "f32"):
+            raise ValueError(f"bad dtype {k['dtype']!r}")
+        from distributed_lion_tpu.ops.attention import parse_attn_spec
+        parse_attn_spec(k["attn"])  # raises on a malformed spec
+        return k
+
+    try:
+        k = _resolve_knobs(rec_cfg)
+    except Exception as e:
+        if not rec_cfg:
+            raise  # malformed ENV values keep their loud failure
+        print(f"recorded flagship config unusable ({e}); using built-in "
+              "defaults", file=sys.stderr)
+        rec_cfg = {}
+        k = _resolve_knobs({})
+    remat_s, dtype_s, block = k["remat"], k["dtype"], k["block"]
+    batch_per_dev = k["batch_per_dev"]
+    accum, vocab_chunks = k["accum"], k["vocab_chunks"]
+    mom_dtype, attn_spec, vocab_pad = (k["mom_dtype"], k["attn"],
+                                       k["vocab_pad"])
+    steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
+    timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     model_cfg = dataclasses.replace(
         GPT2Config.gpt2_124m(), attn_impl="xla",
         remat=remat_s != "noremat",
@@ -212,17 +276,6 @@ def run_inner() -> None:
     )
     if block != model_cfg.n_ctx:
         model_cfg = dataclasses.replace(model_cfg, n_ctx=block)
-    batch_per_dev = int(os.environ.get("BENCH_BATCH", 4))
-    steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
-    timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
-    accum = int(os.environ.get("BENCH_ACCUM", 16))
-    vocab_chunks = int(os.environ.get("BENCH_VOCAB_CHUNKS", 8))
-    mom_dtype = os.environ.get("BENCH_MOM_DTYPE", "bfloat16")
-    # 'auto' resolves to the tile-tuned flash winner at the flagship shape
-    # (T=1024 on TPU → flash@512x1024, ops/attention.attention dispatch,
-    # round-3 sweep row) — the flagship bench needs no explicit attn spec
-    attn_spec = os.environ.get("BENCH_ATTN", "auto")
-    vocab_pad = int(os.environ.get("BENCH_VOCAB_PAD", 0))
     if vocab_pad:
         model_cfg = dataclasses.replace(model_cfg,
                                         vocab_pad_multiple=vocab_pad)
@@ -321,6 +374,20 @@ def run_inner() -> None:
                 "unit": "tokens/s/chip",
                 "ms_per_step": round(dt / steps * 1e3, 1),
                 "loss": round(final_loss, 3),
+                # the resolved knobs, persisted with the headline artifact
+                # so future bare runs adopt the promoted flagship config.
+                # promoted = blessed by the runbook's bench_best stage
+                # (BENCH_PROMOTE=1) or itself adopted from a promoted
+                # record — one-off env-tweaked runs stay unpromoted and
+                # are never adopted as defaults
+                "config": {
+                    "attn": attn_spec, "vocab_chunks": vocab_chunks,
+                    "mom_dtype": mom_dtype, "batch_per_dev": batch_per_dev,
+                    "accum": accum, "vocab_pad": vocab_pad,
+                    "remat": remat_s, "dtype": dtype_s, "block": block,
+                },
+                "promoted": (os.environ.get("BENCH_PROMOTE") == "1"
+                             or bool(rec_cfg)),
                 # vs_baseline is defined against the derived A100 anchor and
                 # only meaningful on TPU hardware; null (not 0.0) elsewhere
                 # so a fallback doesn't render as a perf failure.

@@ -98,7 +98,9 @@ EOF
   # snapshot it so a winner that regresses vs the recorded number
   # (possible: combo interactions are untested) can't silently lower it
   cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_best" 2>/dev/null || true
-  timeout 1200 python bench.py > "$OUT/$marker.json" 2> "$OUT/$marker.err"
+  # BENCH_PROMOTE marks the capture as the blessed flagship config: bare
+  # `python bench.py` runs adopt promoted records' knobs as defaults
+  timeout 1200 env BENCH_PROMOTE=1 python bench.py > "$OUT/$marker.json" 2> "$OUT/$marker.err"
   local rc=$?
   echo "$(stamp) $marker rc=$rc" | tee -a "$OUT/log.txt"
   unset BENCH_ATTN BENCH_VOCAB_CHUNKS BENCH_MOM_DTYPE BENCH_BATCH BENCH_ACCUM BENCH_VOCAB_PAD BENCH_REMAT BENCH_DTYPE
