@@ -150,11 +150,14 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         T = q.shape[2]
-        if on_tpu and (block_q or block_kv):
+        if on_tpu and (block_q or block_kv or block_q_bwd or block_kv_bwd):
             # caller-pinned tiles are a flash knob: honor them at ANY shape
             # rather than silently running untiled xla (a config like
             # auto@256x512 would otherwise report numbers and tune nothing
-            # — same trap the bwd-tile guard below raises for)
+            # — same trap the bwd-tile guard below raises for). Backward-only
+            # pins (auto@@BQBxBKVB-style resolved specs) count too: falling
+            # through to xla would hit that guard's ValueError instead of
+            # honoring the tiles (advisor r4)
             impl = "flash"
         elif on_tpu and T >= 2048:
             impl = "flash"

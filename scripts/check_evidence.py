@@ -19,78 +19,171 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "scripts", "SWEEP_r3_raw")
 PARITY_MIN_STEP = 1900
-
-# the LAST config of the runbook's sweep window / 7B spec list: the stages
-# run sequentially and bench_sweep/bench_sft_7b emit a row (result OR
-# error) per config before moving on, so the last config's row implies the
-# whole window executed
-SWEEP2_LAST_CONFIG = "512x1024@512x512"
-# round-4 anchor-chasing window (scripts/SWEEP_r3_raw/sweep3.jsonl): the
-# last config is the T=2048 bwd-tile leg; batch_per_dev=2 disambiguates it
-# from sweep3's T=1024 rows with the same attn spec (row dicts are
-# insertion-ordered, so this fragment is stable)
-SWEEP3_LAST_CONFIG = '"batch_per_dev": 2, "attn": "flash@512x1024@512x512"'
-# structurally anchored to the last 7B spec's row (nf4:1:2:8::2048:dots —
-# the only spec with seq_len 2048, and row dicts are insertion-ordered) —
-# a bare "2048" needle would also match unrelated numbers (ms_per_step,
-# tok/s) in EARLIER specs' rows and mark the stage captured before the
-# 2048 leg ran
-SFT7B_LAST_SPEC = '"seq_len": 2048'
+# full-scale TPU legs take precedence; runs/parity_cpu holds the reduced
+# (>=10M-param, short-seq) CPU legs captured when the tunnel is dead —
+# legs are only ever COMPARED within one directory (same scale/config)
+PARITY_DIRS = ("parity", "parity_cpu")
+# ---- the pre-registered numeric parity criterion (VERDICT r4 #4), pinned
+# BEFORE the data lands: over the last quarter of training, the mean
+# per-logged-step |loss(vote) - loss(local)| must be within EPS nats (legs
+# share seed => identical per-step batches, so the gap is optimizer
+# trajectory, not data noise). Same bound for the lazy (vote_every=4) leg.
+# loss_parity.py --phase report imports these and prints PASS/FAIL.
+PARITY_EPS_NATS = 0.05
+PARITY_TAIL_FRAC = 0.75
 
 
-def parity(mode: str) -> bool:
+def _load_leg(dirname: str, mode: str):
+    """(meta, {step: loss}) from runs/<dirname>/<mode>.jsonl, or None.
+    ``dirname`` may also be an absolute directory (loss_parity's report
+    phase reuses this loader on an arbitrary --out dir)."""
+    base = (dirname if os.path.isabs(dirname)
+            else os.path.join(REPO, "runs", dirname))
+    meta, curve = None, {}
+    try:
+        with open(os.path.join(base, f"{mode}.jsonl")) as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn last line from a mid-write crash
+                if d.get("meta"):
+                    meta = d
+                elif "loss" in d and "step" in d:
+                    curve[d["step"]] = d["loss"]
+    except OSError:
+        return None
+    return (meta, curve) if meta is not None else None
+
+
+def _leg_ok(leg) -> bool:
     """Captured = enough steps AND stamped as an f32-master-params run —
     bf16-era curves had frozen large-magnitude params (Lion's ±lr is below
     bf16 ULP there) and must not satisfy the evidence check."""
+    if leg is None:
+        return False
+    meta, curve = leg
+    return (meta.get("param_dtype") == "float32"
+            and curve and max(curve) >= PARITY_MIN_STEP)
+
+
+def _metas_comparable(a: dict, b: dict) -> bool:
+    """Two legs may only be numerically compared when every config stamp
+    they BOTH carry (scale, seed, batch, precision, step budget — all but
+    the mode itself) agrees; intersection semantics keep older metas
+    without the round-5 scale stamps comparable."""
+    keys = (set(a) & set(b)) - {"mode", "meta", "backend"}
+    return all(a[k] == b[k] for k in keys)
+
+
+def parity(mode: str) -> bool:
+    """Presence check (the watcher exit condition): a qualifying leg
+    exists in either parity directory. The numeric criterion lives in
+    parity_pass() / the parity:PASS stage — kept separate so a present-
+    but-failing leg cannot trap the runbook into re-burning a
+    deterministic 2000-step leg on every watcher recovery."""
+    return any(_leg_ok(_load_leg(d, mode)) for d in PARITY_DIRS)
+
+
+def parity_full(mode: str) -> bool:
+    """Full-scale (runs/parity) presence only — the TPU runbook's stage-6
+    skip guard. Reduced CPU legs satisfy parity()/the watcher, but must
+    NOT stop a live TPU window from capturing the flagship-scale legs the
+    docs say take precedence (code-review r5)."""
+    return _leg_ok(_load_leg("parity", mode))
+
+
+def parity_mad(dirname: str, mode: str):
+    """Mean |loss(mode) - loss(local)| over the common logged steps in the
+    last (1 - PARITY_TAIL_FRAC) of training, or None when either leg in
+    that directory is missing/unqualified/config-mismatched."""
+    leg_l, leg_m = _load_leg(dirname, "local"), _load_leg(dirname, mode)
+    if not (_leg_ok(leg_l) and _leg_ok(leg_m)):
+        return None
+    if not _metas_comparable(leg_l[0], leg_m[0]):
+        return None
+    steps = leg_l[0].get("steps", PARITY_MIN_STEP)
+    tail = [s for s in sorted(set(leg_l[1]) & set(leg_m[1]))
+            if s >= PARITY_TAIL_FRAC * steps]
+    if not tail:
+        return None
+    return sum(abs(leg_m[1][s] - leg_l[1][s]) for s in tail) / len(tail)
+
+
+def parity_pass() -> bool:
+    """The parity:PASS stage: some directory holds a complete local leg
+    plus vote AND lazy legs whose tail curves are within PARITY_EPS_NATS
+    of it. This is what makes check_evidence able to FAIL on bad parity
+    data, not only on absent data (VERDICT r4 #4)."""
+    for d in PARITY_DIRS:
+        mads = [parity_mad(d, m) for m in ("vote", "lazy")]
+        if all(m is not None and m <= PARITY_EPS_NATS for m in mads):
+            return True
+    return False
+
+
+def _window_captured(path: str, marker: dict, result_key: str) -> bool:
+    """Captured = the LAST window config has a RESULT row (stages run
+    sequentially, so it implies every earlier config executed). Rows are
+    parsed as JSON and the marker compared field-by-field — substring
+    needles were coupled to dict insertion order and separator spacing
+    (advisor r4). An ERROR row for the marker config does NOT count: a
+    window where every config failed fast (tunnel died mid-stage but each
+    config still emitted an error row) must not mark the stage captured —
+    and because the files are append-mode across watcher re-fires, a
+    file-global "any result row" check would be satisfied by a PREVIOUS
+    window's banked rows. This is the watcher's EXIT condition only —
+    earlier configs that errored transiently are retried regardless: the
+    runbook's sweep stages run UNCONDITIONALLY on every recovery and
+    bench_sweep's SWEEP_SKIP_FILE skips result-row configs only, so
+    retries cost seconds, not chip time."""
     try:
-        last, f32 = 0, False
-        with open(os.path.join(REPO, "runs", "parity", f"{mode}.jsonl")) as f:
+        with open(path) as f:
             for line in f:
                 try:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if d.get("meta"):
-                    f32 = d.get("param_dtype") == "float32"
-                last = max(last, d.get("step", 0))
-        return f32 and last >= PARITY_MIN_STEP
+                if not isinstance(d, dict) or not d.get(result_key):
+                    continue
+                if all(d.get(k, _MARKER_DEFAULTS.get(k)) == v
+                       for k, v in marker.items()):
+                    return True
+        return False
     except OSError:
         return False
 
 
-def _window_captured(path: str, needle: str, result_key: str) -> bool:
-    """Captured = the LAST window config has a RESULT row (stages run
-    sequentially, so it implies every earlier config executed). An ERROR
-    row for the marker config does NOT count: a window where every config
-    failed fast (tunnel died mid-stage but each config still emitted an
-    error row) must not mark the stage captured — and because the files are
-    append-mode across watcher re-fires, a file-global "any result row"
-    check would be satisfied by a PREVIOUS window's banked rows. This is
-    the watcher's EXIT condition only — earlier configs that errored
-    transiently are retried regardless: the runbook's sweep stages run
-    UNCONDITIONALLY on every recovery and bench_sweep's SWEEP_SKIP_FILE
-    skips result-row configs only, so retries cost seconds, not chip
-    time."""
-    try:
-        with open(path) as f:
-            return any(needle in line and result_key in line for line in f)
-    except OSError:
-        return False
+# absent row fields fall back to the emitting script's defaults before the
+# marker compare (round-3 sweep2 rows omit block when it is 1024)
+_MARKER_DEFAULTS = {"block": 1024}
+
+# the LAST config of each runbook window's spec list, as structural field
+# markers (stages run sequentially, so the last config's result row
+# implies the whole window executed):
+#   sweep2 — noremat:4:flash@512x1024@512x512:...:1024 (bwd-tile leg)
+#   sweep3 — noremat:2:flash@512x1024@512x512:...:2048 (T=2048 bwd-tile
+#            leg; batch+block disambiguate it from the same attn at T=1024)
+#   sft7b  — nf4:1:2:8::2048:dots (the only spec with seq_len 2048)
+SWEEP2_MARKER = {"attn": "flash@512x1024@512x512", "block": 1024}
+SWEEP3_MARKER = {"attn": "flash@512x1024@512x512", "batch_per_dev": 2,
+                 "block": 2048}
+SFT7B_MARKER = {"seq_len": 2048}
 
 
 def sweep2() -> bool:
     return _window_captured(os.path.join(OUT, "sweep2.jsonl"),
-                            SWEEP2_LAST_CONFIG, "tokens_per_sec_per_chip")
+                            SWEEP2_MARKER, "tokens_per_sec_per_chip")
 
 
 def sweep3() -> bool:
     return _window_captured(os.path.join(OUT, "sweep3.jsonl"),
-                            SWEEP3_LAST_CONFIG, "tokens_per_sec_per_chip")
+                            SWEEP3_MARKER, "tokens_per_sec_per_chip")
 
 
 def sft7b() -> bool:
     return _window_captured(os.path.join(OUT, "sft7b2.jsonl"),
-                            SFT7B_LAST_SPEC, "tokens_per_sec_per_chip")
+                            SFT7B_MARKER, "tokens_per_sec_per_chip")
 
 
 def bench_best() -> bool:
@@ -130,8 +223,19 @@ STAGES = [
     ("parity:local", lambda: parity("local")),
     ("parity:vote", lambda: parity("vote")),
     ("parity:lazy", lambda: parity("lazy")),
+    ("parity:PASS", parity_pass),
     ("conv", conv),
 ]
+
+
+def automation_complete() -> bool:
+    """The watcher's exit condition: every stage automation can still
+    affect is captured. parity:PASS is excluded — it is a deterministic
+    function of already-captured legs (same seed reproduces the same
+    curve), so once the legs exist no amount of re-fired windows can flip
+    it; a failing criterion needs a human, not an infinite watcher loop
+    (code-review r5). `all` keeps the full list for operators/judges."""
+    return all(fn() for name, fn in STAGES if name != "parity:PASS")
 
 
 def check(what: str, arg: str | None = None) -> bool:
@@ -147,8 +251,14 @@ def check(what: str, arg: str | None = None) -> bool:
         return bench_best()
     if what == "conv":
         return conv()
+    if what == "parity_pass":
+        return parity_pass()
+    if what == "parity_full":
+        return parity_full(arg or "local")
     if what == "all":
         return all(fn() for _, fn in STAGES)
+    if what == "automation":
+        return automation_complete()
     raise SystemExit(f"unknown evidence check {what!r}")
 
 

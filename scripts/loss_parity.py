@@ -48,6 +48,11 @@ T = 1024
 WORKERS = 8
 ROWS_PER_WORKER = 4          # global batch 32 rows = 32768 tokens/step
 SMOKE = False                # --smoke: tiny model/seq for a CPU pipeline check
+REDUCED = False              # --reduced: ≥10M-param short-seq legs sized so a
+# 2000-step curve completes on the 1-core CPU host when the TPU tunnel is
+# dead (VERDICT r4 §next-1/3: "the claim is about trajectory, not
+# throughput"). Writes to runs/parity_cpu so a later TPU window can still
+# capture the full-scale legs in runs/parity without colliding.
 LR, WD, B1, B2 = 1e-4, 0.1, 0.9, 0.99
 WARMUP = 100
 
@@ -144,7 +149,12 @@ def prep(out_dir: str, max_bytes: int) -> None:
 def _blocks(out_dir: str):
     import numpy as np
 
-    stream = np.load(os.path.join(out_dir, "tokens.npy"), mmap_mode="r")
+    tokens_path = os.path.join(out_dir, "tokens.npy")
+    if not os.path.exists(tokens_path):
+        # reduced legs live in runs/parity_cpu but share the prepared
+        # full-scale corpus/token stream — same data, same 16k vocab
+        tokens_path = os.path.join(DEFAULT_OUT, "tokens.npy")
+    stream = np.load(tokens_path, mmap_mode="r")
     n_blocks = stream.size // T
     blocks = stream[: n_blocks * T].reshape(n_blocks, T)
     n_eval = 64
@@ -154,6 +164,7 @@ def _blocks(out_dir: str):
 def run(out_dir: str, mode: str, steps: int, log_every: int,
         eval_every: int, seed: int, force_cpu: bool = False) -> None:
     assert mode in ("local", "vote", "lazy")
+    os.makedirs(out_dir, exist_ok=True)  # reduced legs skip the prep phase
     import jax
 
     if force_cpu:
@@ -181,6 +192,13 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
 
     if SMOKE:
         cfg = GPT2Config.tiny(vocab_size=VOCAB, n_ctx=T)
+    elif REDUCED:
+        # smallest scale at which the shipped lazy auto-default applies
+        # (train/loop.resolve_auto_comm: W>1 ∧ replicated ∧ ≥10M params):
+        # 6L d=320 over the 16k vocab ≈ 12.8M params. Short T keeps a
+        # 2000-step leg within ~1-2h on the single host core.
+        cfg = GPT2Config(vocab_size=VOCAB, n_layer=6, n_head=5,
+                         d_model=320, n_ctx=T)
     else:
         cfg = GPT2Config.gpt2_124m(vocab_size=VOCAB)
     # f32 MASTER params (compute stays bf16, the config default): Lion's
@@ -192,8 +210,13 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
     cfg = dataclasses.replace(cfg, remat=False, attn_impl="xla")
     params = gpt2_init(jax.random.key(seed), cfg)
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    if REDUCED:
+        # the reduced legs exist to evidence the ≥10M lazy auto-default —
+        # a sub-threshold model would test a config the default never sees
+        assert n_params >= 10_000_000, n_params
     print(f"[run:{mode}] {n_params/1e6:.1f}M params "
-          f"(124M architecture, {VOCAB} local vocab)")
+          f"({'reduced CPU-scale' if REDUCED else '124M'} architecture, "
+          f"{VOCAB} local vocab)")
     schedule = cosine_schedule_with_warmup(LR, WARMUP, steps)
 
     def loss_fn(p, batch):
@@ -442,6 +465,13 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
             logf.write(json.dumps({
                 "meta": True, "mode": mode, "param_dtype": dtype_name,
                 "lr": LR, "workers": WORKERS, "steps": steps,
+                # scale + provenance stamps: the report/check must only
+                # compare legs with identical config, and reduced CPU legs
+                # must be distinguishable from full-scale TPU captures
+                "d_model": cfg.d_model, "n_layer": cfg.n_layer, "T": T,
+                "rows_per_worker": ROWS_PER_WORKER,
+                "n_params": n_params, "seed": seed,
+                "backend": dev.platform, "reduced": REDUCED,
             }) + "\n")
         for s in range(start_step, steps):
             if mode == "lazy":
@@ -482,10 +512,10 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
 
 def report(out_dir: str) -> None:
     def load(mode):
-        tr, ev = {}, {}
+        tr, ev, meta = {}, {}, None
         path = os.path.join(out_dir, f"{mode}.jsonl")
         if not os.path.exists(path):
-            return None, None
+            return None, None, None
         with open(path) as f:
             for line in f:
                 try:
@@ -493,15 +523,17 @@ def report(out_dir: str) -> None:
                 except json.JSONDecodeError:
                     continue  # torn last line: the leg died mid-write
                     # AFTER the capture threshold — the curve is valid
-                if "eval_loss" in r:
+                if r.get("meta"):
+                    meta = r
+                elif "eval_loss" in r:
                     ev[r["step"]] = r["eval_loss"]
                 elif "loss" in r:
                     tr[r["step"]] = r["loss"]
-        return tr, ev
+        return tr, ev, meta
 
-    tr_l, ev_l = load("local")
-    tr_v, ev_v = load("vote")
-    tr_z, ev_z = load("lazy")  # optional third curve: vote_every=4 wire
+    tr_l, ev_l, meta_l = load("local")
+    tr_v, ev_v, _ = load("vote")
+    tr_z, ev_z, _ = load("lazy")  # optional third curve: vote_every=4 wire
     if not tr_l or not tr_v:
         raise SystemExit(
             "[report] need BOTH local.jsonl and vote.jsonl with train "
@@ -511,12 +543,26 @@ def report(out_dir: str) -> None:
     if not common:
         raise SystemExit("[report] local and vote curves share no logged steps")
     has_lazy = bool(tr_z)
+    # scale/provenance line from the leg's own meta stamp — a reduced CPU
+    # leg set must not publish a report claiming 124M/T=1024 full-scale
+    # provenance (the jsonl is the source of truth, the prose follows it)
+    m = meta_l or {}
+    if m.get("d_model"):
+        arch = (f"GPT-2-family {m['n_params']/1e6:.1f}M params "
+                f"({m['n_layer']}L d={m['d_model']} T={m['T']}, "
+                f"{VOCAB}-token local BPE vocab)"
+                + (f", {m.get('backend', '?')} backend"
+                   if m.get("backend") else "")
+                + (" — REDUCED tunnel-dead fallback scale"
+                   if m.get("reduced") else ""))
+    else:
+        arch = ("GPT-2 124M architecture (12L d=768 T=1024, 16,384-token "
+                "local BPE vocab ≈ 98M params)")
     lines = [
         "# Loss parity: vote-Lion (W=8) vs local Lion — equal global batch",
         "",
-        "GPT-2 124M architecture (12L d=768 T=1024, 16,384-token local BPE "
-        "vocab ≈ 98M params), real local text, canonical reference config "
-        "(lr 1e-4, wd 0.1, bf16, cosine+warmup). Generated by "
+        arch + ", real local text, canonical reference config "
+        "(lr 1e-4, wd 0.1, cosine+warmup). Generated by "
         "scripts/loss_parity.py; raw curves in local.jsonl / vote.jsonl"
         + (" / lazy.jsonl (vote_every=4 — the ≤0.5 bit/param wire)"
            if has_lazy else "") + ".",
@@ -534,16 +580,29 @@ def report(out_dir: str) -> None:
             row += (f" {tr_z[s]:.4f} | {tr_z[s] - tr_l[s]:+.4f} |"
                     if s in tr_z else " — | — |")
         lines.append(row)
-    tail = [s for s in common if s >= common[-1] * 0.5]
-    mad = sum(abs(tr_v[s] - tr_l[s]) for s in tail) / max(len(tail), 1)
+    # ---- the ONE numeric parity statement: the pre-registered pass/fail
+    # criterion (VERDICT r4 #4), imported from check_evidence so the
+    # report and the evidence gate can never disagree on what "parity
+    # achieved" means — no second, differently-spanned mad is printed
+    # alongside it (two divergent numbers in one document, code-review r5)
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from check_evidence import (PARITY_EPS_NATS, PARITY_TAIL_FRAC,
+                                parity_mad)
     lines += ["",
-              f"Mean |Δ| (vote − local) over the last half of training: "
-              f"**{mad:.4f} nats** ({len(tail)} logged points).", ""]
-    if has_lazy:
-        tail_z = [s for s in tail if s in tr_z]
-        mad_z = sum(abs(tr_z[s] - tr_l[s]) for s in tail_z) / max(len(tail_z), 1)
-        lines += [f"Mean |Δ| (lazy-K4 − local) over the same span: "
-                  f"**{mad_z:.4f} nats** ({len(tail_z)} points).", ""]
+              f"## Criterion (pre-registered): mean |Δloss| vs local over "
+              f"the last {1 - PARITY_TAIL_FRAC:.0%} of steps ≤ "
+              f"{PARITY_EPS_NATS} nats", ""]
+    abs_dir = os.path.abspath(out_dir)
+    for label in ("vote", "lazy"):
+        if label == "lazy" and not has_lazy:
+            continue
+        v = parity_mad(abs_dir, label)
+        verdict = ("UNCOMPUTABLE (leg missing/unqualified/config mismatch)"
+                   if v is None else
+                   f"{v:.4f} nats — "
+                   + ("PASS" if v <= PARITY_EPS_NATS else "FAIL"))
+        lines += [f"- {label} vs local: {verdict}", ""]
     if ev_l and ev_v:
         lines += ["| step | local eval | vote-W8 eval |"
                   + (" lazy-K4 eval |" if has_lazy else ""),
@@ -575,18 +634,37 @@ def main() -> None:
     ap.add_argument("--corpus_bytes", type=int, default=200_000_000)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model + short seq: CPU pipeline check only")
+    ap.add_argument("--reduced", action="store_true",
+                    help="≥10M-param short-seq legs on the CPU backend, "
+                    "written to runs/parity_cpu (tunnel-dead fallback; "
+                    "full-scale TPU legs in runs/parity take precedence)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (a dead TPU tunnel hangs "
                     "backend init otherwise); implied by --smoke")
     args = ap.parse_args()
+    global SMOKE, REDUCED, T, ROWS_PER_WORKER
     if args.smoke:
-        global SMOKE, T, ROWS_PER_WORKER
         SMOKE = True
         T = 128
         ROWS_PER_WORKER = 1
         args.cpu = True
+    elif args.reduced:
+        REDUCED = True
+        T = 256
+        ROWS_PER_WORKER = 1   # global batch 8 rows = 2048 tokens/step
+        args.cpu = True
+        if os.path.abspath(args.out) == os.path.abspath(DEFAULT_OUT):
+            # path-compare, not string-compare: `--out runs/parity` (or a
+            # trailing slash) must ALSO redirect — a reduced leg writing
+            # into the full-scale directory would truncate a captured TPU
+            # curve via run()'s mode-"w" open (code-review r5)
+            args.out = DEFAULT_OUT + "_cpu"
     if args.phase in ("prep", "all"):
-        prep(args.out, args.corpus_bytes)
+        # reduced legs share the full-scale corpus/tokens via _blocks()'s
+        # fallback — prep into the shared DEFAULT_OUT, never into the
+        # reduced dir (a second ~200MB corpus + hours of 1-core BPE
+        # retraining, which the watcher would then auto-commit)
+        prep(DEFAULT_OUT if REDUCED else args.out, args.corpus_bytes)
     if args.phase == "run":
         run(args.out, args.mode, args.steps, args.log_every,
             args.eval_every, args.seed, force_cpu=args.cpu)

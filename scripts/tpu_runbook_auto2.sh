@@ -194,7 +194,10 @@ fi
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do
-  if python scripts/check_evidence.py parity "$mode"; then
+  # parity_full: only FULL-SCALE legs skip this stage — reduced CPU legs
+  # (runs/parity_cpu) satisfy the watcher but must not stop a live TPU
+  # window from capturing the flagship-scale curves
+  if python scripts/check_evidence.py parity_full "$mode"; then
     echo "$(stamp) parity:$mode already captured — skip" | tee -a "$OUT/log.txt"
     continue
   fi

@@ -19,7 +19,9 @@ def _write(tmp_path, lines):
     return str(p)
 
 
-MARKER = '"attn": "flash@512x1024@512x512"'
+# structural marker (advisor r4: substring needles were coupled to dict
+# insertion order / separator spacing)
+MARKER = {"attn": "flash@512x1024@512x512"}
 
 
 def test_all_error_window_is_not_captured(tmp_path):
@@ -102,6 +104,78 @@ def test_sweep_skip_keys_round_trip(tmp_path, monkeypatch):
     assert len(keys) == 2  # the error row contributed nothing
 
 
+def test_marker_matches_any_field_order(tmp_path):
+    """The structural compare must be immune to key order and spacing —
+    the exact failure mode of the old substring needles."""
+    path = _write(tmp_path, [
+        '{"tokens_per_sec_per_chip": 97000.0,   '
+        '"attn":"flash@512x1024@512x512"}',
+    ])
+    assert ce._window_captured(path, MARKER, "tokens_per_sec_per_chip")
+
+
+def test_marker_default_fill(tmp_path):
+    """Round-3 rows omit block=1024; the sweep2 marker must still match
+    them via _MARKER_DEFAULTS, while block=2048 rows must not."""
+    path = _write(tmp_path, [
+        '{"attn": "flash@512x1024@512x512", "tokens_per_sec_per_chip": 1.0}',
+    ])
+    assert ce._window_captured(path, ce.SWEEP2_MARKER,
+                               "tokens_per_sec_per_chip")
+    path2 = _write(tmp_path, [
+        '{"attn": "flash@512x1024@512x512", "block": 2048, '
+        '"batch_per_dev": 2, "tokens_per_sec_per_chip": 1.0}',
+    ])
+    assert not ce._window_captured(path2, ce.SWEEP2_MARKER,
+                                   "tokens_per_sec_per_chip")
+    assert ce._window_captured(path2, ce.SWEEP3_MARKER,
+                               "tokens_per_sec_per_chip")
+
+
+def _leg_lines(mode, steps=2000, dtype="float32", loss=5.0, seed=0,
+               n_params=12_700_000):
+    import json as _json
+    rows = [_json.dumps({"meta": True, "mode": mode, "param_dtype": dtype,
+                         "steps": steps, "workers": 8, "seed": seed,
+                         "n_params": n_params})]
+    for s in range(0, steps, 10):
+        rows.append(_json.dumps({"step": s, "loss": loss}))
+    rows.append(_json.dumps({"step": steps - 1, "loss": loss}))
+    return rows
+
+
+def test_parity_numeric_criterion(tmp_path):
+    """parity_mad/parity_pass: identical curves PASS, curves offset by more
+    than PARITY_EPS_NATS FAIL, and a config mismatch is UNCOMPUTABLE."""
+    d = tmp_path / "legs"
+    d.mkdir()
+    (d / "local.jsonl").write_text("\n".join(_leg_lines("local")) + "\n")
+    (d / "vote.jsonl").write_text(
+        "\n".join(_leg_lines("vote", loss=5.0 + 0.01)) + "\n")
+    assert abs(ce.parity_mad(str(d), "vote") - 0.01) < 1e-9
+    (d / "lazy.jsonl").write_text(
+        "\n".join(_leg_lines("lazy", loss=5.0 + ce.PARITY_EPS_NATS * 2))
+        + "\n")
+    assert ce.parity_mad(str(d), "lazy") > ce.PARITY_EPS_NATS
+    # config mismatch (different seed) → UNCOMPUTABLE, not a bogus number
+    (d / "vote.jsonl").write_text(
+        "\n".join(_leg_lines("vote", seed=1)) + "\n")
+    assert ce.parity_mad(str(d), "vote") is None
+    # bf16-stamped leg is unqualified regardless of curve
+    (d / "vote.jsonl").write_text(
+        "\n".join(_leg_lines("vote", dtype="bfloat16")) + "\n")
+    assert ce.parity_mad(str(d), "vote") is None
+
+
+def test_parity_short_leg_unqualified(tmp_path):
+    d = tmp_path / "legs"
+    d.mkdir()
+    (d / "local.jsonl").write_text(
+        "\n".join(_leg_lines("local", steps=500)) + "\n")
+    assert ce._load_leg(str(d), "local") is not None
+    assert not ce._leg_ok(ce._load_leg(str(d), "local"))
+
+
 def test_sweep_row_promotable_rule():
     """bench.sweep_row_promotable: the ONE eligibility rule shared by
     _best_sweep_row and the runbook winner promotion."""
@@ -117,3 +191,34 @@ def test_sweep_row_promotable_rule():
     assert not b.sweep_row_promotable({**ok, "backend": "cpu"})
     assert not b.sweep_row_promotable({**ok, "block": 2048})  # not anchor
     assert not b.sweep_row_promotable({"error": "boom"})
+
+
+def test_unpromoted_capture_cannot_clobber_promoted_artifact(tmp_path):
+    """bench._record_tpu_measurement (advisor r4, medium): a debug run's
+    record must not overwrite the promoted flagship artifact that future
+    bare runs adopt their config from — but promoted records, and writes
+    over unpromoted ones, still land."""
+    import importlib.util
+    import json as _json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(REPO, "bench.py"))
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    art = tmp_path / "last.json"
+    b.LAST_TPU_ARTIFACT = str(art)
+    b._record_tpu_measurement({"value": 90000.0, "promoted": True,
+                               "backend": "tpu"})
+    assert _json.loads(art.read_text())["value"] == 90000.0
+    # unpromoted over promoted: refused
+    b._record_tpu_measurement({"value": 10.0, "promoted": False,
+                               "backend": "tpu"})
+    assert _json.loads(art.read_text())["value"] == 90000.0
+    # promoted over promoted: recorded
+    b._record_tpu_measurement({"value": 95000.0, "promoted": True,
+                               "backend": "tpu"})
+    assert _json.loads(art.read_text())["value"] == 95000.0
+    # unpromoted over unpromoted: recorded (no promoted chain to protect)
+    art.write_text(_json.dumps({"value": 1.0, "promoted": False}))
+    b._record_tpu_measurement({"value": 2.0, "promoted": False})
+    assert _json.loads(art.read_text())["value"] == 2.0
