@@ -36,7 +36,7 @@ class ModelArguments:
     model_family: str = "gpt2"  # gpt2 | llama — the reference's run_clm is
     # architecture-agnostic (AutoModelForCausalLM, run_clm.py:425-444);
     # llama composes with dp x tp x sp (pipe/expert/MoE are GPT-2-only)
-    model_name: str = "gpt2_124m"  # gpt2: gpt2_124m | tiny;
+    model_name: str = "gpt2_124m"  # gpt2: gpt2_124m | gpt2_small | tiny;
     # llama: llama2_7b | llama3_8b | tiny
     model_path: Optional[str] = None  # local HF checkpoint (save_pretrained
     # dir / .safetensors / .bin / .npz) → finetune from pretrained weights,
@@ -401,6 +401,8 @@ def main(argv=None):
         model_cfg = LlamaConfig.named(model_args.model_name, **llama_common)
     elif model_args.model_name == "tiny":
         model_cfg = GPT2Config.tiny(**common)
+    elif model_args.model_name == "gpt2_small":
+        model_cfg = GPT2Config.small(**common)
     else:
         model_cfg = GPT2Config.gpt2_124m(**common)
     if model_args.model_path and (model_args.vocab_size or model_args.n_ctx):

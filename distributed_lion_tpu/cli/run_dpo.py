@@ -24,7 +24,7 @@ from typing import Optional
 class DPOArguments:
     """dpo_llama2.py ScriptArguments (:18-81), repaired."""
 
-    model_name: str = "llama2_7b"  # llama2_7b | llama3_8b | tiny
+    model_name: str = "llama2_7b"  # llama2_7b | llama3_8b | small | tiny
     model_path: Optional[str] = None  # local HF Llama checkpoint: policy+ref
     # both start from the pretrained base (dpo_llama2.py:133-152); an
     # --sft_checkpoint takes precedence (the reference's canonical flow runs

@@ -107,6 +107,18 @@ class GPT2Config:
         return GPT2Config(**base)
 
     @staticmethod
+    def small(**kw) -> "GPT2Config":
+        """The reduced evidence-scale preset (~12.7M params at a 16k
+        vocab): the smallest architecture the ≥10M auto comm defaults
+        apply to — shared by the reduced CPU parity legs
+        (scripts/loss_parity.py --reduced) and the reduced convergence
+        run, so the two tunnel-dead fallbacks evidence the same model."""
+        base = dict(vocab_size=16384, n_layer=6, n_head=5, d_model=320,
+                    n_ctx=256)
+        base.update(kw)
+        return GPT2Config(**base)
+
+    @staticmethod
     def gpt2_124m(**kw) -> "GPT2Config":
         return GPT2Config(**kw)
 

@@ -62,6 +62,17 @@ class LlamaConfig:
         return LlamaConfig(**base)
 
     @staticmethod
+    def small(**kw) -> "LlamaConfig":
+        """A ~25M-param preset (at byte-level vocab): large enough for the
+        auto comm defaults and meaningful CPU-mesh evidence runs (DPO
+        step-rate rows when the TPU tunnel is down), small enough that a
+        1-core host steps it in seconds."""
+        base = dict(vocab_size=256, n_layer=8, n_head=8, n_kv_head=4,
+                    d_model=512, d_ff=1376, n_ctx=1024)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+    @staticmethod
     def llama2_7b(**kw) -> "LlamaConfig":
         return LlamaConfig(**kw)
 
@@ -76,8 +87,8 @@ class LlamaConfig:
     def named(cls, name: str, **kw) -> "LlamaConfig":
         """Resolve a CLI model name — single source for every entry point
         (run_clm / run_sft / run_dpo / run_generate)."""
-        ctors = {"tiny": cls.tiny, "llama2_7b": cls.llama2_7b,
-                 "llama3_8b": cls.llama3_8b}
+        ctors = {"tiny": cls.tiny, "small": cls.small,
+                 "llama2_7b": cls.llama2_7b, "llama3_8b": cls.llama3_8b}
         if name not in ctors:
             raise ValueError(
                 f"unknown llama model_name {name!r}; pick one of "

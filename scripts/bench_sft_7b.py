@@ -26,6 +26,15 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 K = 4           # steps per device dispatch
 TIMED_CALLS = 2
+# SFT7B_VALIDATE=1: pipeline-validation mode (VERDICT r4 #5 — "first
+# validate the full spec list end to end on CPU host-RAM so the window is
+# spent measuring, not debugging"). Each spec runs the REAL pipeline (host
+# init at full d_model/vocab, NF4/int8 quantize, LoRA, chunked loss,
+# trainer step) but at n_layer=2 / bs=1 / accum=1 / one dispatch — full
+# 7B depth is days of work on the 1-core host. Rows are stamped
+# "validate": true and never create skip keys, so a later real TPU window
+# still measures every spec.
+VALIDATE = os.environ.get("SFT7B_VALIDATE") == "1"
 
 
 def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
@@ -96,12 +105,6 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         n_base = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(base))
         if not dense:
             base = quantize_tree(base, quant)
-    # explicit target: device_put(x) with no device is the identity for
-    # committed arrays, which would leave the base host-resident; a
-    # replicated sharding (not devices()[0]) keeps the multi-device path
-    # working — every chip holds the frozen base, batches shard over data
-    base = jax.device_put(
-        base, NamedSharding(mesh, P()))
     lora_cfg = LoraConfig(r=8, alpha=16)
     adapters = lora_init(jax.random.key(1), base, lora_cfg)
     n_adapter = sum(int(np.prod(a.shape)) for a in jax.tree.leaves(adapters))
@@ -111,8 +114,15 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
     from distributed_lion_tpu.ops.quant import maybe_dequant
     from distributed_lion_tpu.ops.xent import chunked_clm_loss_and_metrics
 
-    def loss_fn(params, batch, dropout_key):
-        effective = apply_adapters(base, params, lora_cfg)
+    # the frozen base rides the Trainer's frozen_params slot (replicated
+    # device_put + a (params, frozen, batch, key) loss) instead of a
+    # Python closure: a closed-over jax.Array is baked into the jaxpr as a
+    # CONSTANT, so XLA constant-folds over the multi-GB packed codes at
+    # compile time (observed: minutes of u8[4096,2048] folding on the
+    # validation run) and the executable carries them — as an argument the
+    # codes ship once and compile stays shape-only
+    def loss_fn(params, frozen, batch, dropout_key):
+        effective = apply_adapters(frozen, params, lora_cfg)
         if vocab_chunks > 0:
             hidden = llama_hidden(effective, batch, model_cfg)
             emb = maybe_dequant(effective["lm_head"], model_cfg.compute_dtype)
@@ -122,7 +132,8 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         return clm_loss_and_metrics(logits, batch, None)
 
     loss_fn._vocab_chunked = True
-    trainer = Trainer(cfg, mesh, apply_fn=None, params=adapters, loss_fn=loss_fn)
+    trainer = Trainer(cfg, mesh, apply_fn=None, params=adapters, loss_fn=loss_fn,
+                      frozen_params=base)
     gb = trainer.global_train_batch()
     tokens_per_step = gb * seq_len
 
@@ -157,6 +168,7 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
         pass
     print(json.dumps({
         "workload": f"{model} QLoRA SFT vote-Lion train step",
+        **({"validate": True} if VALIDATE else {}),
         "quant": quant, "n_layer": model_cfg.n_layer,
         "base_params": n_base, "adapter_params": n_adapter,
         "batch_per_dev": batch_per_dev, "accum": accum, "seq_len": seq_len,
@@ -187,6 +199,8 @@ def _captured_keys() -> set:
                     d = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if d.get("validate"):
+                    continue  # pipeline-validation rows are not captures
                 if d.get("tokens_per_sec_per_chip"):
                     keys.add((d.get("quant"), d.get("batch_per_dev"),
                               d.get("accum"), d.get("seq_len"),
@@ -196,6 +210,38 @@ def _captured_keys() -> set:
     return keys
 
 
+def _validate_full_init() -> None:
+    """Full-DEPTH host init + quantize only (no train step): the one part
+    of the real 7B pipeline the reduced-depth validation runs don't cover
+    — 13 GB of host-RAM init and the per-leaf NF4 packing at true leaf
+    shapes. Catches OOM/shape/dtype bugs before a TPU window pays for
+    them."""
+    import jax
+    import numpy as np
+
+    from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+    from distributed_lion_tpu.ops.quant import quantize_tree
+
+    cfg = LlamaConfig.llama2_7b()
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+    t0 = time.time()
+    base = llama_init(jax.random.key(0),
+                      _dc.replace(cfg, param_dtype=jnp.bfloat16))
+    n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(base))
+    init_s = time.time() - t0
+    t0 = time.time()
+    q = quantize_tree(base, "nf4")
+    q_bytes = sum(x.nbytes for x in jax.tree.leaves(q))
+    print(json.dumps({
+        "validate": True, "full_init": True, "n_layer": cfg.n_layer,
+        "base_params": n, "init_s": round(init_s, 1),
+        "quantize_s": round(time.time() - t0, 1),
+        "nf4_gb": round(q_bytes / 2**30, 2),
+    }), flush=True)
+
+
 if __name__ == "__main__":
     from distributed_lion_tpu.parallel.mesh import force_cpu_platform
 
@@ -203,6 +249,8 @@ if __name__ == "__main__":
     specs = sys.argv[1:] or ["nf4:1:4:8"]
     DEFAULTS = ["nf4", "1", "4", "8", "", "1024", "full"]
     captured = _captured_keys()
+    if VALIDATE:
+        K, TIMED_CALLS = 1, 1
     for spec in specs:
         parts = spec.split(":")
         # pad with the defaults for the MISSING tail fields only (a plain
@@ -210,8 +258,12 @@ if __name__ == "__main__":
         # "nf4:1:4:8" must mean full-depth T=1024, not n_layer=1 seq=4)
         parts = (parts + DEFAULTS[len(parts):])[:7]
         quant, bs, accum, vc, nl, sl, pol = parts
-        if (quant, int(bs), int(accum), int(sl), pol or "full",
-                int(vc or 0)) in captured:
+        if VALIDATE:
+            # exercise the spec's quant/seq_len/remat/chunks through the
+            # real pipeline at a depth/budget the host core can afford
+            bs, accum, nl = "1", "1", nl or "2"
+        if not VALIDATE and (quant, int(bs), int(accum), int(sl),
+                             pol or "full", int(vc or 0)) in captured:
             print(f"[7b] skip (already captured): {spec}", file=sys.stderr,
                   flush=True)
             continue
@@ -221,3 +273,10 @@ if __name__ == "__main__":
         except Exception as e:
             print(json.dumps({"spec": spec,
                               "error": str(e).split("\n")[0][:200]}), flush=True)
+    if VALIDATE:
+        try:
+            _validate_full_init()
+        except Exception as e:
+            print(json.dumps({"validate": True, "full_init": True,
+                              "error": str(e).split("\n")[0][:200]}),
+                  flush=True)

@@ -195,10 +195,10 @@ def run(out_dir: str, mode: str, steps: int, log_every: int,
     elif REDUCED:
         # smallest scale at which the shipped lazy auto-default applies
         # (train/loop.resolve_auto_comm: W>1 ∧ replicated ∧ ≥10M params):
-        # 6L d=320 over the 16k vocab ≈ 12.8M params. Short T keeps a
-        # 2000-step leg within ~1-2h on the single host core.
-        cfg = GPT2Config(vocab_size=VOCAB, n_layer=6, n_head=5,
-                         d_model=320, n_ctx=T)
+        # GPT2Config.small = 6L d=320 over the 16k vocab ≈ 12.7M params
+        # (the shared reduced evidence preset). Short T keeps a 2000-step
+        # leg within hours on the single host core.
+        cfg = GPT2Config.small(vocab_size=VOCAB, n_ctx=T)
     else:
         cfg = GPT2Config.gpt2_124m(vocab_size=VOCAB)
     # f32 MASTER params (compute stays bf16, the config default): Lion's

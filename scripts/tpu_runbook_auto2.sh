@@ -191,6 +191,18 @@ else
   rc=$?; echo "$(stamp) 7b(fixed) rc=$rc" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5b. DPO chip row (~3 min): the small-model DPO step on the real
+# chip — the last workload without numbers (VERDICT r4 #7). tpu-guarded:
+# a CPU fallback row satisfies the evidence stage but must not stop a
+# live window from capturing a chip row once.
+if python scripts/check_evidence.py dpo tpu; then
+  echo "$(stamp) DPO chip row already captured — skip" | tee -a "$OUT/log.txt"
+else
+  timeout 900 python scripts/bench_dpo.py small:none:4:1:512:0 \
+      >> "$OUT/dpo.log" 2>&1
+  rc=$?; echo "$(stamp) dpo rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do
@@ -213,7 +225,7 @@ python scripts/loss_parity.py --phase report >> "$OUT/log.txt" 2>&1
 # through the native BPE, with the reference's convergence signals (eval
 # accuracy/perplexity) logged. Orbax resume (save_steps 250) makes a
 # tunnel drop cost one checkpoint interval, not the run.
-if python scripts/check_evidence.py conv; then
+if python scripts/check_evidence.py conv_full; then
   echo "$(stamp) convergence run already captured — skip" | tee -a "$OUT/log.txt"
 else
   mkdir -p runs/convergence

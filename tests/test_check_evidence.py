@@ -176,6 +176,63 @@ def test_parity_short_leg_unqualified(tmp_path):
     assert not ce._leg_ok(ce._load_leg(str(d), "local"))
 
 
+def test_validate_rows_never_mark_capture(tmp_path):
+    """SFT7B_VALIDATE pipeline rows carry the real result key but must
+    satisfy neither the capture marker nor the skip-key resume."""
+    import importlib.util
+    import json as _json
+
+    row = {"seq_len": 2048, "tokens_per_sec_per_chip": 5.0,
+           "validate": True, "quant": "nf4", "batch_per_dev": 1,
+           "accum": 1, "remat_policy": "dots", "vocab_chunks": 8}
+    path = _write(tmp_path, [_json.dumps(row)])
+    assert not ce._window_captured(path, ce.SFT7B_MARKER,
+                                   "tokens_per_sec_per_chip")
+    import os as _os
+    _os.environ["SFT7B_SKIP_FILE"] = path
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bench_sft_7b", os.path.join(REPO, "scripts", "bench_sft_7b.py"))
+        b7 = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(b7)
+        assert b7._captured_keys() == set()
+    finally:
+        del _os.environ["SFT7B_SKIP_FILE"]
+
+
+def test_dpo_stage_and_tpu_guard(tmp_path, monkeypatch):
+    import json as _json
+
+    monkeypatch.setattr(ce, "OUT", str(tmp_path))
+    assert not ce.dpo()
+    p = tmp_path / "dpo.jsonl"
+    p.write_text(_json.dumps({"backend": "cpu",
+                              "tokens_per_sec_per_chip": 7.6}) + "\n")
+    assert ce.dpo()                  # evidence stage: any backend
+    assert not ce.dpo(tpu_only=True)  # runbook guard: chip rows only
+    p.write_text(p.read_text() + _json.dumps(
+        {"backend": "tpu", "tokens_per_sec_per_chip": 900.0}) + "\n")
+    assert ce.dpo(tpu_only=True)
+
+
+def test_conv_dual_directory(tmp_path, monkeypatch):
+    import json as _json
+
+    monkeypatch.setattr(ce, "REPO", str(tmp_path))
+    rows = [_json.dumps({"step": s, "train/loss": 5.0})
+            for s in range(0, 2000, 25)]
+    rows.append(_json.dumps({"step": 1999, "eval/loss": 5.0,
+                             "eval/accuracy": 0.3}))
+    d = tmp_path / "runs" / "convergence_cpu"
+    d.mkdir(parents=True)
+    (d / "metrics.jsonl").write_text("\n".join(rows) + "\n")
+    assert ce.conv()                       # fallback dir satisfies conv
+    assert not ce.conv("convergence")      # the runbook's conv_full doesn't
+    # eval-less curve must not count
+    (d / "metrics.jsonl").write_text("\n".join(rows[:-1]) + "\n")
+    assert not ce.conv()
+
+
 def test_sweep_row_promotable_rule():
     """bench.sweep_row_promotable: the ONE eligibility rule shared by
     _best_sweep_row and the runbook winner promotion."""
