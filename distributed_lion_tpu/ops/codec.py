@@ -188,8 +188,20 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
         ours = 2 * (world_size - 1) * a2a_chunk_bytes(n_voted, world_size)
     else:
         raise ValueError(f"unknown wire format: {wire!r}")
+    if world_size <= 1:
+        # one voter: every wire short-circuits (a psum/all_gather over a
+        # 1-device axis is a no-op — no bytes cross any fabric). Reporting
+        # the nominal ballot size here made single-chip metrics claim
+        # MB/step of phantom traffic (observed in run_clm W=1 logs).
+        ours = 0
     reference = world_size * packed_size(num_params) * 8  # int64 lanes
     bf16_allreduce = 2 * num_params
+    if world_size <= 1:
+        # the comparison baselines short-circuit identically at W=1 (a DDP
+        # all-reduce over one device moves nothing either) — zero them so
+        # the ratios read 0/0-style N/A, not an advantage over phantom
+        # baseline traffic
+        reference = bf16_allreduce = 0
     bits = 8.0 * ours / max(num_params, 1)
     return extras | {
         "wire": wire,

@@ -541,7 +541,10 @@ class Trainer:
     def comm_stats(self, steps_per_sec: Optional[float] = None) -> dict:
         """Analytic bytes-on-wire report for the vote collective (empty for
         the AdamW path, which has no optimizer collective)."""
-        if not self.cfg.lion:
+        if not self.cfg.lion or self.world <= 1:
+            # W=1: no vote collective executes at all — logging a comm
+            # report (even a zeroed one) would dress a single-chip run in
+            # multi-chip wire numbers
             return {}
         return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec,
                            vote_every=self.cfg.vote_every,

@@ -52,3 +52,10 @@ def test_wire_accounting_beats_baseline():
 def test_unknown_wire_raises():
     with pytest.raises(ValueError):
         wire_bytes_per_param(8, 2, "carrier_pigeon")
+
+
+def test_world1_wire_bytes_are_zero():
+    """One voter -> every wire short-circuits: a single-chip run must not
+    log phantom collective traffic."""
+    for wire in ("sign_psum", "packed_allgather", "packed_a2a"):
+        assert wire_bytes_per_param(1000, 1, wire)["bytes_per_step"] == 0
