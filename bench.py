@@ -82,10 +82,15 @@ def sweep_row_promotable(d: dict) -> bool:
     keeps the committed round-3 rows (captured in a verified TPU window,
     scripts/SWEEP_r3_raw/log.txt) eligible while excluding any future
     CPU/fallback-produced row. The block filter keeps T=2048 long-context
-    rows (sweep3) out: a different workload, not anchor-comparable."""
+    rows (sweep3) out: a different workload, not anchor-comparable. The
+    vote_buckets filter keeps the overlap-ablation rows out for the same
+    reason in reverse: every banked flagship row measured the monolithic
+    vote, so a pipelined-wire row (same tokens, less exposed wire time)
+    must not displace the anchor it is being compared against."""
     return (bool(d.get("tokens_per_sec_per_chip"))
             and d.get("backend", "tpu") == "tpu"
-            and d.get("block", 1024) == 1024)
+            and d.get("block", 1024) == 1024
+            and d.get("vote_buckets", 1) == 1)
 
 
 def _best_sweep_row() -> dict | None:
@@ -126,6 +131,69 @@ def _best_sweep_row() -> dict | None:
     best["note"] = ("best single-chip TPU v5e row from the committed "
                     "bench_sweep raw log (same methodology as bench.py; "
                     "sweep-attested, not driver-captured)")
+    return best
+
+
+def overlap_from_ablation() -> dict | None:
+    """Measured vote-wire overlap from the committed buckets-ablation rows
+    (scripts/SWEEP_r*_raw/overlap.jsonl, captured by the runbook's overlap
+    stage: the flagship config at vote_buckets ∈ {1, 4, 16}).
+
+    Groups TPU-attested result rows by config-minus-buckets; for a group
+    holding a buckets=1 row and at least one buckets>1 row, the measured
+    ``comm_overlap_frac`` is the step-time fraction the pipelined wire
+    recovered: ``(ms[1] − min_B ms[B]) / ms[1]``, clipped at 0. This is a
+    LOWER bound on the wire time hidden behind the fused apply (compute is
+    unchanged between the rows — only when bytes move differs). Returns the
+    best-covered group as {"comm_overlap_frac", "ms_per_step", "source"},
+    or None when no ablation has been captured yet."""
+    import glob as _glob
+
+    pattern = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "scripts", "SWEEP_r*_raw", "overlap.jsonl")
+    groups: dict = {}
+    for path in sorted(_glob.glob(pattern)):
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line.startswith("{"):
+                        continue
+                    try:
+                        d = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if (not d.get("ms_per_step")
+                            or not d.get("tokens_per_sec_per_chip")
+                            or d.get("backend", "tpu") != "tpu"):
+                        continue
+                    key = (d.get("remat"), d.get("batch_per_dev"),
+                           d.get("attn"), d.get("accum"), d.get("dtype"),
+                           d.get("vocab_chunks", 0),
+                           d.get("mom_dtype", "f32"), d.get("vocab_pad", 0),
+                           d.get("block", 1024))
+                    b = int(d.get("vote_buckets", 1))
+                    # latest capture of a (config, buckets) cell wins
+                    groups.setdefault(key, {})[b] = (float(d["ms_per_step"]),
+                                                     path)
+        except OSError:
+            continue
+    best = None
+    for times in groups.values():
+        if 1 not in times or len(times) < 2:
+            continue
+        ms1 = times[1][0]
+        b_min = min((ms for b, (ms, _) in times.items() if b > 1))
+        frac = max(0.0, (ms1 - b_min) / ms1) if ms1 > 0 else 0.0
+        if best is None or len(times) > len(best["ms_per_step"]):
+            best = {
+                "comm_overlap_frac": round(frac, 4),
+                "ms_per_step": {str(b): ms for b, (ms, _) in
+                                sorted(times.items())},
+                "source": os.path.relpath(
+                    next(iter(times.values()))[1],
+                    os.path.dirname(os.path.abspath(__file__))),
+            }
     return best
 
 
@@ -228,7 +296,8 @@ def run_inner() -> None:
         if rec.get("promoted") and isinstance(rec.get("config"), dict):
             probe = {"tokens_per_sec_per_chip": rec.get("value"),
                      "backend": rec.get("backend"),
-                     "block": rec["config"].get("block", 1024)}
+                     "block": rec["config"].get("block", 1024),
+                     "vote_buckets": rec["config"].get("vote_buckets", 1)}
             if sweep_row_promotable(probe):
                 rec_cfg = rec["config"]
     env_changed: list = []  # BENCH_* overrides that CHANGED an adopted value
@@ -260,9 +329,18 @@ def run_inner() -> None:
             # flagship bench needs no explicit attn spec
             "attn": str(knob("BENCH_ATTN", "attn", "auto")),
             "vocab_pad": int(knob("BENCH_VOCAB_PAD", "vocab_pad", 0)),
+            # bucketed, overlapped vote wire (optim.distributed_lion):
+            # B > 1 pipelines the ballot collective with the fused apply.
+            # Default 1 keeps every banked row comparable (all committed
+            # sweep rows measured the monolithic vote); the overlap
+            # ablation (runbook stage → overlap.jsonl) sweeps {1, 4, 16}.
+            "vote_buckets": int(knob("BENCH_VOTE_BUCKETS",
+                                     "vote_buckets", 1)),
         }
         if k["remat"] not in ("noremat", "full", "dots"):
             raise ValueError(f"bad remat {k['remat']!r}")
+        if k["vote_buckets"] < 1:
+            raise ValueError(f"bad vote_buckets {k['vote_buckets']!r}")
         if k["dtype"] not in ("bf16", "f32"):
             raise ValueError(f"bad dtype {k['dtype']!r}")
         from distributed_lion_tpu.ops.attention import parse_attn_spec
@@ -283,6 +361,7 @@ def run_inner() -> None:
     accum, vocab_chunks = k["accum"], k["vocab_chunks"]
     mom_dtype, attn_spec, vocab_pad = (k["mom_dtype"], k["attn"],
                                        k["vocab_pad"])
+    vote_buckets = k["vote_buckets"]
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     if (steps_per_call, timed_calls) != (STEPS_PER_CALL, TIMED_CALLS):
@@ -319,6 +398,7 @@ def run_inner() -> None:
         # W=1 short-circuits either way; this makes multi-chip explicit.
         wire="sign_psum",
         vote_every=1,
+        vote_buckets=vote_buckets,
         learning_rate=1e-4,
         weight_decay=0.1,
         warmup_steps=10,
@@ -389,6 +469,8 @@ def run_inner() -> None:
                 + (f", mom_dtype {mom_dtype}" if mom_dtype else "")
                 + (f", attn {attn_spec}" if attn_spec != "xla" else "")
                 + (f", vocab_pad {vocab_pad}" if vocab_pad else "")
+                + (f", vote_buckets {vote_buckets}"
+                   if vote_buckets > 1 else "")
                 + (f", remat {remat_s}" if remat_s != "noremat" else "")
                 + (", f32 params" if dtype_s != "bf16" else "")
                 + f", {n_dev} {device_kind} device(s), backend={backend})",
@@ -407,7 +489,15 @@ def run_inner() -> None:
                     "mom_dtype": mom_dtype, "batch_per_dev": batch_per_dev,
                     "accum": accum, "vocab_pad": vocab_pad,
                     "remat": remat_s, "dtype": dtype_s, "block": block,
+                    "vote_buckets": vote_buckets,
                 },
+                "vote_buckets": vote_buckets,
+                # measured step-time fraction recovered by bucketing the
+                # vote wire, from the committed overlap-ablation rows
+                # (buckets ∈ {1,4,16}, scripts/SWEEP_r*_raw/overlap.jsonl);
+                # null on CPU and until a TPU window captures the ablation
+                "comm_overlap_frac": (overlap_from_ablation() or {}).get(
+                    "comm_overlap_frac") if on_tpu else None,
                 "promoted": (os.environ.get("BENCH_PROMOTE") == "1"
                              or (bool(rec_cfg) and not env_changed)),
                 # vs_baseline is defined against the derived A100 anchor and
@@ -545,6 +635,7 @@ def main() -> None:
           "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4",
           "BENCH_VOCAB_PAD": "0", "BENCH_REMAT": "noremat",
           "BENCH_DTYPE": "bf16", "BENCH_BLOCK": "1024",
+          "BENCH_VOTE_BUCKETS": "1",
           # an inherited TPU-only pin must not kill the evidence-of-life
           # attempt — it exists precisely for when the TPU is unreachable
           "BENCH_REQUIRE_TPU": ""}),

@@ -1,0 +1,69 @@
+"""JAX API compatibility layer.
+
+The codebase targets the current ``jax.shard_map`` entry point (with its
+``check_vma`` argument). Older jax releases — including the pinned toolchain
+on some CI hosts — only ship ``jax.experimental.shard_map.shard_map`` whose
+equivalent flag is ``check_rep``. Rather than scattering version branches
+over every call site (train/loop, optim/sharded, parallel/*, tests),
+:func:`install` publishes one forwarding wrapper as ``jax.shard_map`` when
+the attribute is missing, so both ``jax.shard_map(...)`` calls and
+``from jax import shard_map`` imports work on either jax.
+
+Installed automatically at package import (``distributed_lion_tpu``) and
+from ``tests/conftest.py`` (which must run before test modules that do
+``from jax import shard_map`` at module scope).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _compat_shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+    """``jax.shard_map`` signature adapter over the experimental API.
+
+    Supports the partial-application form ``shard_map(mesh=..., ...)(f)``
+    used with ``functools.partial`` decorators throughout the repo.
+    """
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if f is None:
+        return functools.partial(
+            _compat_shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=check_vma, **kwargs)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, **kwargs)
+
+
+def _compat_pcast(x, axes=None, *, to=None, **kwargs):
+    """``jax.lax.pcast`` fallback for jax versions without varying-manual-axes
+    typing: on those versions the cast is PURELY a type-system annotation
+    (there is no vma type to move between), so identity — over any pytree —
+    is exact, not an approximation. Used by parallel.pipeline to mark scan
+    carries device-varying before the first ppermute."""
+    del axes, to, kwargs
+    return x
+
+
+def _compat_axis_size(axis_name):
+    """``jax.lax.axis_size`` fallback: ``psum(1, axis)`` of a Python literal
+    folds to the static axis size at trace time (shard_map axis sizes are
+    static), so callers may keep using the result in shape math and
+    ``if`` guards exactly as with the real entry point."""
+    return jax.lax.psum(1, axis_name)
+
+
+def install() -> None:
+    """Idempotently publish ``jax.shard_map`` / ``jax.lax.pcast`` /
+    ``jax.lax.axis_size`` on jax versions that predate them. A no-op (and
+    therefore zero-risk) wherever jax already provides the real entry
+    points."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _compat_shard_map
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = _compat_pcast
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _compat_axis_size

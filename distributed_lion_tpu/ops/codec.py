@@ -59,6 +59,53 @@ def vote_chunk_elems(n: int, vote_every: int) -> int:
     return max(8, -(-n // (8 * vote_every)) * 8)
 
 
+def bucket_alignment(world_size: int, wire: str) -> int:
+    """Element alignment of bucket boundaries for ``wire`` (all but the last
+    bucket are multiples of this). Chosen so that splitting a ballot at these
+    boundaries changes NOTHING about what each wire moves: every full bucket
+    packs to whole bytes (8), owns whole per-worker a2a chunks (8·W), or whole
+    per-member hier chunks (8·g). That alignment is exactly what makes the
+    per-bucket byte accounting sum to the unbucketed totals (ceil() terms
+    become exact for every bucket but the last, and the last bucket's ceil
+    absorbs precisely the global remainder)."""
+    kind, group = parse_wire(wire)
+    if kind == "packed_a2a":
+        return 8 * world_size
+    if kind == "hier":
+        return 8 * group
+    return 8  # sign_psum / packed_allgather: byte-pack granularity
+
+
+def bucket_bounds(n: int, vote_buckets: int, world_size: int,
+                  wire: str) -> list[tuple[int, int]]:
+    """Split an ``n``-coordinate ballot into ≤ ``vote_buckets`` contiguous
+    ``(start, size)`` chunks, boundaries aligned per :func:`bucket_alignment`.
+
+    Single source of truth for the bucketed vote collectives
+    (parallel.collectives), the optimizer's software-pipelined bucket loop
+    (optim.distributed_lion), and the bucketed byte accounting below — the
+    three MUST slice identically or accounting drifts from what moves.
+
+    Invariants: chunks tile [0, n) exactly in order; every chunk but the
+    last is a multiple of the wire alignment; small ballots yield fewer
+    (possibly 1) buckets rather than empty ones.
+    """
+    if vote_buckets < 1:
+        raise ValueError(f"vote_buckets must be >= 1, got {vote_buckets}")
+    if n <= 0:
+        return []
+    align = bucket_alignment(world_size, wire)
+    per = -(-n // vote_buckets)            # ceil: target bucket size
+    per = -(-per // align) * align         # rounded up to the wire alignment
+    bounds = []
+    off = 0
+    while off < n:
+        size = min(per, n - off)
+        bounds.append((off, size))
+        off += size
+    return bounds
+
+
 def a2a_chunk_bytes(n: int, world_size: int) -> int:
     """uint8 bytes per worker-chunk in the packed_a2a wire: the ballot vector
     is padded so every worker owns an equal ceil(n/8W)-byte chunk. Single
@@ -104,8 +151,44 @@ def unpack_signs(packed: jnp.ndarray, shape: tuple[int, ...]) -> jnp.ndarray:
     return bits.reshape(-1)[:n].reshape(shape).astype(jnp.bool_)
 
 
+def _recv_bytes(n: int, world_size: int, kind: str,
+                group: int | None) -> tuple[int, int]:
+    """Bytes RECEIVED per worker for ONE contiguous ``n``-coordinate ballot
+    on this wire: ``(total_bytes, dcn_leg_bytes)``. The per-bucket unit the
+    (possibly bucketed) accounting below sums over."""
+    if kind == "hier":
+        n_groups = world_size // group
+        # Mirrors collectives._hier_elect's three chunked ppermute rings:
+        #   ICI leg 1 (reduce-scatter of ballots): (g−1) hops × chunk bytes
+        #   ICI leg 3 (all-gather of packed elected): (g−1) hops × chunk/8
+        #   DCN leg 2 (cross-group packed verdicts): (G−1) hops × chunk/8 —
+        #     the flat packed vote's cross-boundary volume divided by g,
+        #     because only each member's OWNED 1/g chunk crosses groups.
+        acc_bytes = 1 if group <= 127 else 4
+        chunk = 8 * a2a_chunk_bytes(n, group)  # same rule as _hier_elect
+        dcn = (n_groups - 1) * (chunk // 8)
+        ici = (group - 1) * (chunk * acc_bytes + chunk // 8)
+        return ici + dcn, dcn
+    if kind == "sign_psum":
+        # Ring all-reduce of the ballot tensor: received payload per worker ≈
+        # N bytes at the accumulator width (reduction happens on-fabric,
+        # receive volume independent of W). int8 is exact only while partial
+        # sums fit (W ≤ 127); larger worlds promote to int32, matching
+        # collectives.majority_vote_psum.
+        acc_bytes = 1 if world_size <= 127 else 4
+        return n * acc_bytes, 0
+    if kind == "packed_allgather":
+        return world_size * packed_size(n), 0
+    if kind == "packed_a2a":
+        # phase 1: (W-1) peers each send me their packed copy of my chunk;
+        # phase 2: (W-1) peers each send me their chunk's packed verdict.
+        return 2 * (world_size - 1) * a2a_chunk_bytes(n, world_size), 0
+    raise ValueError(f"unknown wire format: {kind!r}")
+
+
 def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
-                         vote_every: int = 1, accum_steps: int = 1) -> dict:
+                         vote_every: int = 1, accum_steps: int = 1,
+                         vote_buckets: int = 1) -> dict:
     """Accounting for bytes RECEIVED per worker, per optimizer step.
 
     The reference ships int64-packed tensors via all_gather: every worker
@@ -143,6 +226,12 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
             votes only ceil(n/K) coordinates → wire volume ÷ K.
         accum_steps: gradient-accumulation microbatches per optimizer step
             (for the equal-tokens comparison only).
+        vote_buckets: number of contiguous ballot chunks voted as separate
+            (pipelined) collectives (optim.distributed_lion bucket loop).
+            Accounted as the SUM of the per-bucket wires over
+            :func:`bucket_bounds` — which, by the bucket-boundary alignment,
+            is exactly the unbucketed total: bucketing changes when bytes
+            move (overlapped with compute), never how many.
 
     Returns:
         dict with bytes received per worker per optimizer step for this
@@ -153,41 +242,32 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
     n_voted = (num_params if vote_every <= 1
                else min(num_params, vote_chunk_elems(num_params, vote_every)))
     extras: dict = {}
+    if kind == "hier" and world_size % group:
+        raise ValueError(
+            f"hier group size {group} does not divide world {world_size}"
+        )
+    # One collective per bucket, each accounted with the same per-ballot
+    # formula (_recv_bytes). bucket_bounds' alignment guarantees the sum is
+    # EXACTLY the vote_buckets=1 number — pinned by the conservation test in
+    # tests/test_vote_buckets.py.
+    per_bucket = [_recv_bytes(size, world_size, kind, group)
+                  for _, size in bucket_bounds(n_voted, max(vote_buckets, 1),
+                                               world_size, wire)]
+    ours = sum(b for b, _ in per_bucket)
+    # Analytic pipelineable fraction of the wire: the optimizer's software
+    # pipeline (optim.distributed_lion._step_pallas) overlaps bucket k's
+    # collective with bucket k−1's fused apply, so every bucket AFTER the
+    # first can hide behind compute — the fraction of wire bytes eligible
+    # for overlap is buckets[1:]'s share. 0.0 for the monolithic vote and
+    # at world=1 (no wire to hide). The MEASURED counterpart lives in
+    # bench.py's overlap-ablation rows (comm_overlap_frac).
+    overlappable = (sum(b for b, _ in per_bucket[1:]) / ours
+                    if ours and world_size > 1 else 0.0)
     if kind == "hier":
-        if world_size % group:
-            raise ValueError(
-                f"hier group size {group} does not divide world {world_size}"
-            )
-        n_groups = world_size // group
-        # Mirrors collectives._hier_elect's three chunked ppermute rings:
-        #   ICI leg 1 (reduce-scatter of ballots): (g−1) hops × chunk bytes
-        #   ICI leg 3 (all-gather of packed elected): (g−1) hops × chunk/8
-        #   DCN leg 2 (cross-group packed verdicts): (G−1) hops × chunk/8 —
-        #     the flat packed vote's cross-boundary volume divided by g,
-        #     because only each member's OWNED 1/g chunk crosses groups.
-        acc_bytes = 1 if group <= 127 else 4
-        chunk = 8 * a2a_chunk_bytes(n_voted, group)  # same rule as _hier_elect
-        dcn = (n_groups - 1) * (chunk // 8)
-        ici = (group - 1) * (chunk * acc_bytes + chunk // 8)
-        ours = ici + dcn
-        extras = {"hier_groups": n_groups, "dcn_bytes_per_step": dcn,
+        dcn = sum(d for _, d in per_bucket)
+        extras = {"hier_groups": world_size // group,
+                  "dcn_bytes_per_step": dcn,
                   "dcn_bits_per_param": 8.0 * dcn / max(num_params, 1)}
-    elif wire == "sign_psum":
-        # Ring all-reduce of the ballot tensor: received payload per worker ≈
-        # N bytes at the accumulator width (reduction happens on-fabric,
-        # receive volume independent of W). int8 is exact only while partial
-        # sums fit (W ≤ 127); larger worlds promote to int32, matching
-        # collectives.majority_vote_psum.
-        acc_bytes = 1 if world_size <= 127 else 4
-        ours = n_voted * acc_bytes
-    elif wire == "packed_allgather":
-        ours = world_size * packed_size(n_voted)
-    elif wire == "packed_a2a":
-        # phase 1: (W-1) peers each send me their packed copy of my chunk;
-        # phase 2: (W-1) peers each send me their chunk's packed verdict.
-        ours = 2 * (world_size - 1) * a2a_chunk_bytes(n_voted, world_size)
-    else:
-        raise ValueError(f"unknown wire format: {wire!r}")
     if world_size <= 1:
         # one voter: every wire short-circuits (a psum/all_gather over a
         # 1-device axis is a no-op — no bytes cross any fabric). Reporting
@@ -206,6 +286,8 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
     return extras | {
         "wire": wire,
         "vote_every": vote_every,
+        "vote_buckets": max(vote_buckets, 1),
+        "overlappable_wire_frac": overlappable,
         "bytes_per_step": ours,
         "bits_per_param": bits,
         "bits_per_param_per_microbatch": bits / max(accum_steps, 1),

@@ -13,9 +13,14 @@ Here the whole pytree is one flat vector and the step is two VMEM passes
   elected-sign application, and the momentum update together:
   ``p' = p*(1-lr*wd) - lr*sign(total>0)``; ``m' = b2*m + (1-b2)*g``.
 
-Between the two sits exactly one collective. The kernels are elementwise
-VPU work tiled (ROW_BLOCK, 128) with dtype-uniform flat inputs; CPU tests
-run them in interpreter mode (``interpret=True``).
+Between the two sits the vote wire — ONE collective, or ``vote_buckets``
+pipelined ones: the ``*_window`` entry points run the same kernels over a
+static ``[start, start + length)`` window of shared flat buffers, so the
+bucketed optimizer slices per-leaf views instead of materializing full flat
+copies of params/grads/momentum, and bucket k's collective overlaps bucket
+k−1's apply. The kernels are elementwise VPU work tiled (≤ROW_BLOCK, 128)
+with dtype-uniform flat inputs; CPU tests run them in interpreter mode
+(``interpret=True``).
 """
 
 from __future__ import annotations
@@ -31,13 +36,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
 ROW_BLOCK = 512  # rows per grid step → (512, 128) f32 blocks = 256 KiB
+MIN_ROWS = 32    # min row granularity: covers the (8,128) f32, (16,128)
+# bf16 and (32,128) int8 native tile shapes, so small bucket windows
+# compile on hardware without padding all the way to a full ROW_BLOCK
+
+
+def _grid_rows(n: int) -> tuple[int, int]:
+    """(padded rows, rows per grid step) for an [n] flat operand. Large
+    inputs tile at ROW_BLOCK as before; small ones (per-leaf bucket windows)
+    shrink the block to the input instead of zero-padding 64K elements."""
+    rows = max(1, math.ceil(n / LANES))
+    rows = math.ceil(rows / MIN_ROWS) * MIN_ROWS
+    block = min(ROW_BLOCK, rows)
+    return math.ceil(rows / block) * block, block
 
 
 def _pad_to_grid(flat: jnp.ndarray) -> tuple[jnp.ndarray, int]:
-    """[n] → [rows, 128] with rows a multiple of ROW_BLOCK (zero padded)."""
+    """[n] → [rows, 128] zero-padded to the _grid_rows geometry."""
     n = flat.shape[0]
-    rows = math.ceil(n / LANES)
-    rows = math.ceil(rows / ROW_BLOCK) * ROW_BLOCK
+    rows, _ = _grid_rows(n)
     pad = rows * LANES - n
     return jnp.pad(flat, (0, pad)).reshape(rows, LANES), n
 
@@ -54,16 +71,14 @@ def fused_ballots(
     zero update votes −1, the ``> 0`` encoding)."""
     g2, n = _pad_to_grid(g_flat)
     m2, _ = _pad_to_grid(m_flat)
-    rows = g2.shape[0]
+    rows, block = g2.shape[0], _grid_rows(n)[1]
+    spec = pl.BlockSpec((block, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         functools.partial(_ballot_kernel, b1),
         out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
-        grid=(rows // ROW_BLOCK,),
-        in_specs=[
-            pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        grid=(rows // block,),
+        in_specs=[spec, spec],
+        out_specs=spec,
         interpret=interpret,
     )(g2, m2)
     return out.reshape(-1)[:n]
@@ -98,16 +113,16 @@ def fused_apply(
     g2, _ = _pad_to_grid(g_flat)
     m2, _ = _pad_to_grid(m_flat)
     t2, _ = _pad_to_grid(vote_total.astype(jnp.int32))
-    rows = p2.shape[0]
+    rows, blk = p2.shape[0], _grid_rows(n)[1]
     lr_arr = jnp.asarray(lr, jnp.float32).reshape(1)
-    block = lambda: pl.BlockSpec((ROW_BLOCK, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    block = lambda: pl.BlockSpec((blk, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     p_new, m_new = pl.pallas_call(
         functools.partial(_apply_kernel, wd, b2),
         out_shape=(
             jax.ShapeDtypeStruct((rows, LANES), p_flat.dtype),
             jax.ShapeDtypeStruct((rows, LANES), m_flat.dtype),
         ),
-        grid=(rows // ROW_BLOCK,),
+        grid=(rows // blk,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # lr scalar
             block(), block(), block(), block(),
@@ -116,6 +131,53 @@ def fused_apply(
         interpret=interpret,
     )(lr_arr, p2, g2, m2, t2)
     return p_new.reshape(-1)[:n], m_new.reshape(-1)[:n]
+
+
+def fused_ballots_window(
+    g_flat: jnp.ndarray,
+    m_flat: jnp.ndarray,
+    b1: float,
+    *,
+    start: int,
+    length: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ballots for the ``[start, start + length)`` window of shared flat
+    (g, m) buffers — the per-bucket entry point of the pipelined optimizer
+    (optim.distributed_lion). The window is sliced with static bounds, so
+    XLA fuses the slice into the kernel's operand pass instead of the old
+    path's full-pytree ``jnp.concatenate`` materialization."""
+    g_w = jax.lax.slice(g_flat, (start,), (start + length,))
+    m_w = jax.lax.slice(m_flat, (start,), (start + length,))
+    return fused_ballots(g_w, m_w, b1, interpret=interpret)
+
+
+def fused_apply_window(
+    p_flat: jnp.ndarray,
+    g_flat: jnp.ndarray,
+    m_flat: jnp.ndarray,
+    bucket_total: jnp.ndarray,
+    lr,
+    wd: float,
+    b2: float,
+    *,
+    start: int,
+    length: int,
+    total_offset: int = 0,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused decay + elected update + momentum for one window of shared flat
+    (p, g, m) buffers against ``bucket_total[total_offset :
+    total_offset + length]`` (a single bucket's collective result). Returns
+    the window's (p_new, m_new) only — the caller reassembles leaves, and a
+    window depends on nothing but ITS bucket's wire, which is what lets the
+    bucket-k collective run while bucket k−1 applies."""
+    p_w = jax.lax.slice(p_flat, (start,), (start + length,))
+    g_w = jax.lax.slice(g_flat, (start,), (start + length,))
+    m_w = jax.lax.slice(m_flat, (start,), (start + length,))
+    t_w = jax.lax.slice(bucket_total, (total_offset,),
+                        (total_offset + length,))
+    return fused_apply(p_w, g_w, m_w, t_w, lr, wd, b2, interpret=interpret)
 
 
 def pallas_available() -> bool:

@@ -9,6 +9,13 @@ and its construction-time mode dispatch (:159-166), redesigned TPU-first:
   (SURVEY §3.1 hot loop). Here every leaf's votes are concatenated into a
   single 1-D ballot vector and voted with ONE ``psum`` (or one packed
   ``all_gather``) per optimizer step.
+- **…and that collective is pipelined.** With ``vote_buckets > 1`` the
+  ballot is split at ``codec.bucket_bounds``' wire-aligned boundaries and
+  each chunk voted as its own collective, software-pipelined against the
+  fused apply: bucket k rides the interconnect while bucket k−1's Pallas
+  apply runs in VMEM, so the wire hides behind compute instead of sitting
+  on the critical path. Elections and byte totals are bit-identical to the
+  monolithic vote (tests/test_vote_buckets.py).
 - **Reduction on the interconnect.** The default wire (``sign_psum``) sums ±1
   int8 ballots with ``lax.psum``: receive volume is independent of world
   size, vs the reference's O(W·N) gather-then-``torch.mode``-in-Python.
@@ -37,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_lion_tpu.ops import lion_math
-from distributed_lion_tpu.ops.codec import vote_chunk_elems
+from distributed_lion_tpu.ops.codec import bucket_bounds, vote_chunk_elems
 from distributed_lion_tpu.optim.lion import (
     FunctionalOptimizer,
     LionState,
@@ -66,6 +73,28 @@ def _split_votes(flat, like_tree):
     return jax.tree.unflatten(treedef, out)
 
 
+def _bucket_windows(bounds, sizes):
+    """Static window decomposition of the persistent flat-offset layout.
+
+    ``bounds`` are contiguous flat-coordinate buckets (codec.bucket_bounds);
+    ``sizes`` the leaf sizes in ``jax.tree.leaves`` order. Returns, per
+    bucket, the ``(leaf_idx, leaf_start, length, bucket_offset)`` windows
+    tiling it — all Python ints at trace time, so the bucket loop unrolls
+    into a fixed dataflow graph with no dynamic indexing."""
+    out = []
+    leaf, loff = 0, 0  # running cursor over the flat coordinate space
+    for _, size in bounds:
+        ws, done = [], 0
+        while done < size:
+            while sizes[leaf] == loff:  # also skips zero-size leaves
+                leaf, loff = leaf + 1, 0
+            take = min(sizes[leaf] - loff, size - done)
+            ws.append((leaf, loff, take, done))
+            done, loff = done + take, loff + take
+        out.append(ws)
+    return out
+
+
 def distributed_lion(
     learning_rate: Schedule = 1e-4,
     b1: float = 0.9,
@@ -76,6 +105,7 @@ def distributed_lion(
     max_grad_norm: Optional[float] = None,
     wire: str = "sign_psum",
     vote_every: int = 1,
+    vote_buckets: int = 1,
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
 ) -> FunctionalOptimizer:
@@ -107,6 +137,16 @@ def distributed_lion(
             the cache holds voted (shared) results only. Coordinates not yet
             voted in the first K-1 steps receive no update. Sign staleness
             ≤ K steps is the accuracy trade — covered by a convergence test.
+        vote_buckets: B > 1 splits the ballot into B contiguous wire-aligned
+            chunks (codec.bucket_bounds) voted as B independent collectives,
+            software-pipelined against the fused apply on the Pallas path:
+            bucket k's vote rides the interconnect while bucket k−1's update
+            runs in VMEM. Params/momentum are bit-identical to B = 1 for
+            every wire, and the summed wire bytes equal the monolithic
+            vote's exactly — bucketing changes WHEN bytes move, never what
+            is elected or how much ships. Composes with ``vote_every``
+            (the rotating 1/K slice is itself voted bucket-wise) and the
+            stochastic path. 1 = the monolithic vote.
         mom_dtype: momentum dtype override (default: param dtype, ref :185).
         kernel: 'auto' (fused Pallas kernels on TPU, plain XLA elsewhere),
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
@@ -137,6 +177,8 @@ def distributed_lion(
     _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
     if vote_every < 1:
         raise ValueError(f"vote_every must be >= 1, got {vote_every}")
+    if vote_buckets < 1:
+        raise ValueError(f"vote_buckets must be >= 1, got {vote_buckets}")
     stochastic = max_grad_norm is not None
     from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
 
@@ -157,27 +199,82 @@ def distributed_lion(
                          rng=rng, elected=elected)
 
     def _step_pallas(params, grads, state: LionState):
-        """Fused-kernel fast path: two VMEM passes + one collective over the
-        flat pytree (ops/pallas_lion)."""
+        """Fused-kernel fast path: per-window VMEM kernels + the bucketed,
+        software-pipelined vote wire.
+
+        The pytree is addressed through a persistent flat-offset layout —
+        leaf offsets are Python ints fixed at trace time — and the kernels
+        slice shared per-leaf flat views (``reshape(-1)``), so the step no
+        longer materializes full flat copies of params/grads/momentum via a
+        per-step triple ``jnp.concatenate`` (three full HBM round-trips at
+        f32 width on the old path). The only cross-leaf buffers built are
+        the per-bucket int8 ballot chunks — the wire payload itself.
+
+        Pipeline order: compute + send bucket k's ballots, then run bucket
+        k−1's fused apply while k is on the wire; XLA's async collectives
+        turn that dataflow into interconnect/VMEM overlap. ``grads`` arrive
+        already cast to the momentum dtype (hoisted once in ``step``).
+        """
         from distributed_lion_tpu.ops import pallas_lion
 
         lr = resolve_lr(learning_rate, state.count)
         p_leaves, treedef = jax.tree.flatten(params)
         m_leaves = treedef.flatten_up_to(state.exp_avg)
-        g_leaves = [g.astype(m.dtype) for g, m in
-                    zip(treedef.flatten_up_to(grads), m_leaves)]
-        p_flat = jnp.concatenate([p.reshape(-1) for p in p_leaves])
-        g_flat = jnp.concatenate([g.reshape(-1) for g in g_leaves])
-        m_flat = jnp.concatenate([m.reshape(-1) for m in m_leaves])
+        g_leaves = treedef.flatten_up_to(grads)
+        p_f = [p.reshape(-1) for p in p_leaves]
+        g_f = [g.reshape(-1) for g in g_leaves]
+        m_f = [m.reshape(-1) for m in m_leaves]
+        sizes = [p.size for p in p_leaves]
+        n = sum(sizes)
+        w = collectives.axis_size(axis_name)
+        bounds = bucket_bounds(n, vote_buckets, w, wire)
+        if not bounds:  # zero-coordinate pytree: nothing to vote or apply
+            return params, LionState(state.count + 1, state.exp_avg,
+                                     state.rng, state.elected)
+        windows = _bucket_windows(bounds, sizes)
+        pieces: list[list] = [[] for _ in sizes]  # per-leaf, in flat order
 
-        ballots = pallas_lion.fused_ballots(g_flat, m_flat, b1, interpret=interpret)
-        total = collectives.vote_total(ballots > 0, axis_name, wire)
-        p_new_flat, m_new_flat = pallas_lion.fused_apply(
-            p_flat, g_flat, m_flat, total, lr, weight_decay, b2, interpret=interpret
-        )
+        def _bucket_ballots(k):
+            parts = [
+                pallas_lion.fused_ballots_window(
+                    g_f[li], m_f[li], b1, start=ls, length=ln,
+                    interpret=interpret)
+                for li, ls, ln, _ in windows[k]
+            ]
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        def _bucket_apply(k, total):
+            for li, ls, ln, boff in windows[k]:
+                pieces[li].append(pallas_lion.fused_apply_window(
+                    p_f[li], g_f[li], m_f[li], total, lr, weight_decay, b2,
+                    start=ls, length=ln, total_offset=boff,
+                    interpret=interpret))
+
+        totals = []
+        for k in range(len(bounds)):
+            totals.append(collectives.vote_total(
+                _bucket_ballots(k) > 0, axis_name, wire))
+            if k:  # apply k−1 while bucket k's collective is in flight
+                _bucket_apply(k - 1, totals[k - 1])
+        _bucket_apply(len(bounds) - 1, totals[-1])
+
+        def _join(parts, leaf, idx):
+            if not parts:  # zero-size leaf: nothing was windowed onto it
+                return jnp.zeros(leaf.shape, leaf.dtype)
+            flat = (parts[0][idx] if len(parts) == 1
+                    else jnp.concatenate([p[idx] for p in parts]))
+            return flat.reshape(leaf.shape)
+
+        new_p = [_join(ws, p, 0) for ws, p in zip(pieces, p_leaves)]
+        new_m = [_join(ws, m, 1) for ws, m in zip(pieces, m_leaves)]
         return (
-            _split_votes(p_new_flat, params),
-            LionState(state.count + 1, _split_votes(m_new_flat, state.exp_avg), state.rng),
+            jax.tree.unflatten(treedef, new_p),
+            # this path is gated to vote_every == 1, where the elected-sign
+            # cache is None — but the invariant is "state passes through",
+            # not "elected may be dropped": a future un-gating must not
+            # silently lose the cache
+            LionState(state.count + 1, jax.tree.unflatten(treedef, new_m),
+                      state.rng, state.elected),
         )
 
     def _elect_lazy(flat_votes, state: LionState):
@@ -192,7 +289,10 @@ def distributed_lion(
         ) if vote_every * chunk > n else flat_votes
         slot = lax.rem(state.count, jnp.int32(vote_every))
         sl = lax.dynamic_slice(padded, (slot * chunk,), (chunk,))
-        elected_sl = collectives.majority_vote(sl, axis_name, wire)
+        # the rotating 1/K slice votes bucket-wise too: same elected bits,
+        # but the slice's wire splits into vote_buckets pipelineable chunks
+        elected_sl = collectives.majority_vote_bucketed(
+            sl, axis_name, wire, vote_buckets)
         new_cache = lax.dynamic_update_slice(
             state.elected, pack_signs(elected_sl), (slot * chunk // 8,)
         )
@@ -204,13 +304,15 @@ def distributed_lion(
         return bits[:n], valid[:n], new_cache
 
     def step(params, grads, state: LionState):
+        # grad → momentum-dtype cast, hoisted ONCE for both kernel paths
+        # (the Pallas path used to re-cast internally after this cast)
+        grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, state.exp_avg)
         if interpret is not None and not stochastic and vote_every == 1:
             p_dtypes = {p.dtype for p in jax.tree.leaves(params)}
             m_dtypes = {m.dtype for m in jax.tree.leaves(state.exp_avg)}
             if len(p_dtypes) == 1 and len(m_dtypes) == 1:
                 return _step_pallas(params, grads, state)
         lr = resolve_lr(learning_rate, state.count)
-        grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, state.exp_avg)
 
         # 1) weight decay, multiplicatively, before the update (ref :64).
         decayed = jax.tree.map(lambda p: lion_math.decay_params(p, lr, weight_decay), params)
@@ -237,7 +339,8 @@ def distributed_lion(
         flat = _flatten_votes(votes)
         new_cache = state.elected
         if vote_every == 1:
-            elected = collectives.majority_vote(flat, axis_name, wire)
+            elected = collectives.majority_vote_bucketed(
+                flat, axis_name, wire, vote_buckets)
             elected_tree = _split_votes(elected, votes)
             # 4) apply the elected ±1 update (ref :91-92). The psum output is
             #    identical on every worker, so replicated params stay replicated.

@@ -26,6 +26,15 @@ TPU-native replacement for the reference's only two collective calls —
   DCN leg: (W/g − 1)/g bits/param). Majority-of-majorities semantics;
   degenerates to the flat vote at g=1 and g=W.
 
+Every wire also has a **bucketed** form (:func:`vote_total_buckets` /
+:func:`vote_total_bucketed` / :func:`majority_vote_bucketed`): the ballot is
+split at ``codec.bucket_bounds``' wire-aligned boundaries and each chunk is
+voted with its OWN collective. Elections are elementwise, so the bucketed
+result is bit-identical to the one-shot vote and the per-bucket byte
+accounting sums to exactly the unbucketed totals; what bucketing buys is
+*pipelining* — the optimizer overlaps bucket k's collective with bucket
+k−1's fused apply (optim.distributed_lion).
+
 Both must be called inside ``jax.shard_map`` (or any context where
 ``axis_name`` is bound). Tie rule: ties vote −1, matching ``torch.mode``'s
 smaller-value behavior on even worlds (SURVEY §2.3 step 6).
@@ -39,6 +48,7 @@ from jax import lax
 
 from distributed_lion_tpu.ops.codec import (
     a2a_chunk_bytes,
+    bucket_bounds,
     pack_signs,
     parse_wire,
     unpack_signs,
@@ -89,6 +99,47 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
     # kind == "hier": per-worker tallies never leave the ICI subgroup, so
     # (like packed_a2a) only a ±1 proxy of the elected sign is available.
     return jnp.where(_hier_elect(vote_pos, axis_name, w, group), 1, -1)
+
+
+def vote_total_buckets(
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+) -> list[jnp.ndarray]:
+    """The bucketed wire: one *independent* collective per contiguous ballot
+    chunk (codec.bucket_bounds — the same boundaries the byte accounting
+    sums over), returned per bucket so a caller can interleave each bucket's
+    apply with the next bucket's collective (the optimizer's software
+    pipeline). Elections are elementwise per coordinate, so the
+    concatenation of the bucket results is bit-identical to the one-shot
+    ``vote_total`` for EVERY wire — bucketing changes when bytes move,
+    never what is elected (tests/test_vote_buckets.py pins this).
+    """
+    w = axis_size(axis_name)
+    bounds = bucket_bounds(vote_pos.shape[0], vote_buckets, w, wire)
+    return [
+        vote_total(lax.slice(vote_pos, (start,), (start + size,)),
+                   axis_name, wire)
+        for start, size in bounds
+    ]
+
+
+def vote_total_bucketed(
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+) -> jnp.ndarray:
+    """Concatenated bucketed vote — same contract (and bit pattern) as
+    :func:`vote_total`, but issued as ``vote_buckets`` independent
+    collectives XLA's async scheduler can overlap with unrelated compute."""
+    if vote_buckets <= 1:
+        return vote_total(vote_pos, axis_name, wire)
+    totals = vote_total_buckets(vote_pos, axis_name, wire, vote_buckets)
+    return totals[0] if len(totals) == 1 else jnp.concatenate(totals)
+
+
+def majority_vote_bucketed(
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+) -> jnp.ndarray:
+    """Elected bool votes via the bucketed wire; bit-identical to
+    :func:`majority_vote` for every wire format."""
+    return vote_total_bucketed(vote_pos, axis_name, wire, vote_buckets) > 0
 
 
 def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndarray:

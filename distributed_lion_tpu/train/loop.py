@@ -32,7 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_lion_tpu.models.gpt2 import GPT2Config, count_params, gpt2_apply, gpt2_init
 from distributed_lion_tpu.models.loss import clm_loss_and_metrics
-from distributed_lion_tpu.ops.codec import wire_bytes_per_param
+from distributed_lion_tpu.ops.codec import vote_chunk_elems, wire_bytes_per_param
 from distributed_lion_tpu.optim import (
     distributed_lion,
     expand_worker_state,
@@ -97,6 +97,15 @@ class TrainConfig:
     # (where wire volume matters; trajectory-overlay at that scale is
     # evidenced by runs/parity's lazy leg) — else 1, the reference's
     # every-step vote. Pass --vote_every 1 to force strict voting.
+    vote_buckets: int = 0  # B > 1: bucketed, overlapped vote wire — the
+    # ballot splits into B contiguous wire-aligned chunks (codec.
+    # bucket_bounds) voted as B independent collectives, software-pipelined
+    # against the fused apply (bucket k rides the interconnect while bucket
+    # k−1 updates in VMEM, optim.distributed_lion). Params/momentum and the
+    # summed wire bytes are bit-identical to B=1 (tests/test_vote_buckets.py)
+    # — bucketing changes WHEN bytes move, never what is elected. 0 = auto
+    # (resolve_auto_comm): 4 when W > 1 and the per-step ballot slice is
+    # ≥ AUTO_BUCKET_MIN_COORDS, else 1 (the monolithic vote).
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     mom_dtype: str = ""  # Lion momentum dtype override ('bfloat16' halves
     # the per-worker optimizer state and its read/write traffic — at 7B
@@ -188,6 +197,13 @@ def validate_seq_block(cfg: "TrainConfig", model_cfg, sp: int) -> None:
 # changes bytes-on-wire, never the optimizer trajectory, at small scale
 AUTO_LAZY_MIN_PARAMS = 10_000_000
 
+# bucketed-vote auto threshold: pipeline the wire only when the PER-STEP
+# ballot slice (after vote_every's ÷K) is at least this many coordinates —
+# 4 buckets of ≥4M coords each still amortize per-collective launch latency,
+# while smaller ballots' wires are too cheap for overlap to matter and
+# tiny/debug models keep the simplest single-collective graph
+AUTO_BUCKET_MIN_COORDS = 16_000_000
+
 
 def _spec_sharded_axes(param_specs) -> set:
     """Mesh axes any param PartitionSpec shards over (empty = replicated
@@ -206,18 +222,19 @@ def _spec_sharded_axes(param_specs) -> set:
 
 def resolve_auto_comm(cfg: TrainConfig, mesh, n_params: int,
                       params_replicated: bool) -> TrainConfig:
-    """Resolve the comm sentinels (``wire='auto'``, ``vote_every=0``) into
-    concrete values for this mesh + model — the one place the multi-chip
-    default wire recipe lives (README 'wire recipe'; BASELINE.md ≤0.5-bit
-    budget vs the reference's always-sign_psum analog,
-    /root/reference/distributed_lion.py:80-81). Idempotent: a cfg with both
-    fields explicit is returned unchanged, so factories can resolve early
-    (for their byte-accounting print) and Trainer.__init__ resolves only
-    what reaches it unresolved."""
-    if cfg.wire != "auto" and cfg.vote_every != 0:
+    """Resolve the comm sentinels (``wire='auto'``, ``vote_every=0``,
+    ``vote_buckets=0``) into concrete values for this mesh + model — the one
+    place the multi-chip default wire recipe lives (README 'wire recipe';
+    BASELINE.md ≤0.5-bit budget vs the reference's always-sign_psum analog,
+    /root/reference/distributed_lion.py:80-81). Idempotent: a cfg with all
+    three fields explicit is returned unchanged, so factories can resolve
+    early (for their byte-accounting print) and Trainer.__init__ resolves
+    only what reaches it unresolved."""
+    if (cfg.wire != "auto" and cfg.vote_every != 0
+            and cfg.vote_buckets != 0):
         return cfg
     world = data_axis_size(mesh)
-    wire, ve = cfg.wire, cfg.vote_every
+    wire, ve, vb = cfg.wire, cfg.vote_every, cfg.vote_buckets
     if wire == "auto":
         # hier's subgroups must be DATA-axis workers sharing a host. data is
         # the slowest-varying mesh axis (make_mesh), so consecutive data
@@ -265,7 +282,18 @@ def resolve_auto_comm(cfg: TrainConfig, mesh, n_params: int,
                 "scale (runs/parity). Pass --vote_every 1 for the "
                 "reference's strict every-step vote."
             )
-    return dataclasses.replace(cfg, wire=wire, vote_every=ve)
+    if vb == 0:
+        # bucketed overlap: worth it only when there is a wire (W > 1) AND
+        # the per-step ballot slice is big enough that each of 4 buckets
+        # still amortizes collective launch latency. Elections are
+        # bit-identical at any B, so auto never changes the trajectory —
+        # only whether the wire can hide behind the fused apply.
+        n_voted = (n_params if ve <= 1
+                   else min(n_params, vote_chunk_elems(n_params, ve)))
+        vb = (4 if (cfg.lion and world > 1
+                    and n_voted >= AUTO_BUCKET_MIN_COORDS) else 1)
+    return dataclasses.replace(cfg, wire=wire, vote_every=ve,
+                               vote_buckets=vb)
 
 
 def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
@@ -300,6 +328,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             # before reaching here
             wire="sign_psum" if cfg.wire == "auto" else cfg.wire,
             vote_every=cfg.vote_every or 1,
+            vote_buckets=cfg.vote_buckets or 1,
             kernel=cfg.kernel,
             mom_dtype=mom_dtype,
         )
@@ -548,7 +577,8 @@ class Trainer:
             return {}
         return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec,
                            vote_every=self.cfg.vote_every,
-                           accum_steps=self.cfg.gradient_accumulation_steps)
+                           accum_steps=self.cfg.gradient_accumulation_steps,
+                           vote_buckets=self.cfg.vote_buckets or 1)
 
     # ------------------------------------------------------------------ steps
     def _build_train_step_core(self):
@@ -782,6 +812,10 @@ class Trainer:
                 if comm:
                     m["comm_bytes_per_step"] = comm["comm_bytes_per_step"]
                     m["comm_mbytes_per_sec"] = comm.get("comm_mbytes_per_sec", 0.0)
+                    # analytic pipelineable wire share under vote_buckets
+                    # (profiling.comm_report); the measured counterpart is
+                    # bench.py's overlap-ablation comm_overlap_frac
+                    m["comm_overlap_frac"] = comm.get("comm_overlap_frac", 0.0)
                 hbm = peak_hbm_gb()
                 if hbm is not None:
                     m["peak_hbm_gb"] = hbm
@@ -841,8 +875,10 @@ class Trainer:
 
     # ------------------------------------------------------------ checkpoints
     def _payload(self):
+        # 0-d ndarray, not np.int64 scalar: older orbax StandardCheckpointHandler
+        # versions only accept ndarray/jax.Array leaves
         return {"params": self.params, "opt_state": self.state,
-                "step": np.int64(self.step_count)}
+                "step": np.asarray(self.step_count, np.int64)}
 
     def save(self) -> None:
         assert self.checkpointer is not None
@@ -898,12 +934,16 @@ class Trainer:
         )
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
                                     vote_every=cfg.vote_every,
-                                    accum_steps=cfg.gradient_accumulation_steps)
+                                    accum_steps=cfg.gradient_accumulation_steps,
+                                    vote_buckets=cfg.vote_buckets or 1)
         tp = mesh.shape[TENSOR_AXIS]
         print(
             f"[trainer] GPT-2 {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
             f"tp={tp} | vote wire={cfg.wire}"
             + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
+            + (f" (vote_buckets={cfg.vote_buckets}, "
+               f"{acct['overlappable_wire_frac']*100:.0f}% of the wire "
+               "pipelineable)" if cfg.vote_buckets > 1 else "")
             + f": {acct['bits_per_param']:.2f} bits/param/step "
             f"({acct['vs_bf16_allreduce']*100:.1f}% of bf16 all-reduce; "
             f"{acct['bits_per_param_per_microbatch']:.2f} bits/param/microbatch)"
@@ -1173,13 +1213,16 @@ class Trainer:
         )
         acct = wire_bytes_per_param(n, data_axis_size(mesh), cfg.wire,
                                     vote_every=cfg.vote_every,
-                                    accum_steps=cfg.gradient_accumulation_steps)
+                                    accum_steps=cfg.gradient_accumulation_steps,
+                                    vote_buckets=cfg.vote_buckets or 1)
         tp = mesh.shape[TENSOR_AXIS]
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
         print(
             f"[trainer] Llama {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
             f"tp={tp}" + (f" pp={pp}" if pp > 1 else "") + f" | vote wire={cfg.wire}"
             + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
+            + (f" (vote_buckets={cfg.vote_buckets})"
+               if cfg.vote_buckets > 1 else "")
             + f": {acct['bits_per_param']:.2f} bits/param/step"
             + (f" | DCN leg {acct['dcn_bits_per_param']:.3f} bits/param"
                if "dcn_bits_per_param" in acct else "")

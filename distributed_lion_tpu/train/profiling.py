@@ -139,15 +139,27 @@ def peak_hbm_gb() -> Optional[float]:
 
 def comm_report(num_params: int, world: int, wire: str,
                 steps_per_sec: Optional[float] = None,
-                vote_every: int = 1, accum_steps: int = 1) -> dict:
-    """Vote-collective wire accounting (+ bandwidth when a rate is known)."""
+                vote_every: int = 1, accum_steps: int = 1,
+                vote_buckets: int = 1) -> dict:
+    """Vote-collective wire accounting (+ bandwidth when a rate is known).
+
+    ``comm_overlap_frac`` is the ANALYTIC pipelineable share of the wire
+    under ``vote_buckets`` bucketing: the optimizer overlaps bucket k's
+    collective with bucket k−1's fused apply, so every bucket after the
+    first can ride behind compute — 0.0 for the monolithic vote, ≈(B−1)/B
+    for B equal buckets. The measured counterpart (step-time actually
+    recovered on hardware) comes from bench.py's overlap-ablation rows.
+    """
     acct = wire_bytes_per_param(num_params, world, wire,
-                                vote_every=vote_every, accum_steps=accum_steps)
+                                vote_every=vote_every, accum_steps=accum_steps,
+                                vote_buckets=vote_buckets)
     out = {
         "wire": acct["wire"],
         "comm_bytes_per_step": acct["bytes_per_step"],
         "comm_bits_per_param": acct["bits_per_param"],
         "comm_bits_per_param_per_microbatch": acct["bits_per_param_per_microbatch"],
+        "vote_buckets": acct["vote_buckets"],
+        "comm_overlap_frac": acct["overlappable_wire_frac"],
         "vs_bf16_allreduce": acct["vs_bf16_allreduce"],
         "vs_reference_wire": acct["bytes_per_step"]
         / max(acct["reference_bytes_per_step"], 1),

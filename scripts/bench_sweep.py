@@ -46,7 +46,7 @@ def _row_key(d: dict) -> tuple:
     return (d.get("remat"), d.get("batch_per_dev"), d.get("attn"),
             d.get("accum"), d.get("dtype"), d.get("vocab_chunks", 0),
             d.get("mom_dtype", "f32"), d.get("vocab_pad", 0),
-            d.get("block", 1024))
+            d.get("block", 1024), d.get("vote_buckets", 1))
 
 
 def _captured_keys() -> set:
@@ -74,7 +74,8 @@ def _captured_keys() -> set:
 
 def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         accum: int = 1, dtype: str = "f32", vocab_chunks: int = 0,
-        mom_dtype: str = "", vocab_pad: int = 0, block: int = 1024) -> float:
+        mom_dtype: str = "", vocab_pad: int = 0, block: int = 1024,
+        vote_buckets: int = 1) -> float:
     row = {
         "remat": remat, "batch_per_dev": batch_per_dev, "attn": attn_impl,
         "accum": accum, "dtype": dtype, "vocab_chunks": vocab_chunks,
@@ -82,6 +83,10 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
     }
     if block != 1024:
         row["block"] = block
+    if vote_buckets != 1:
+        # only carried when non-default so pre-buckets rows keep matching
+        # their skip keys / evidence markers (same treatment as block)
+        row["vote_buckets"] = vote_buckets
     env = dict(os.environ)
     env.update({
         "BENCH_REMAT": remat, "BENCH_BATCH": str(batch_per_dev),
@@ -89,6 +94,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         "BENCH_DTYPE": dtype, "BENCH_VOCAB_CHUNKS": str(vocab_chunks),
         "BENCH_MOM_DTYPE": mom_dtype, "BENCH_VOCAB_PAD": str(vocab_pad),
         "BENCH_BLOCK": str(block),
+        "BENCH_VOTE_BUCKETS": str(vote_buckets),
     })
     try:
         rc, stdout, stderr = run_child(
@@ -120,7 +126,7 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
 
 if __name__ == "__main__":
     # spec: remat:batch[:attn[@bqxbkv[@bqbxbkvb]][:accum[:dtype[:chunks[
-    #   :mom[:pad[:T]]]]]]]
+    #   :mom[:pad[:T[:buckets]]]]]]]]
     install_child_teardown()
     DEFAULTS = ["auto", "1", "f32", "0", ""]
     consecutive_timeouts = 0
@@ -133,15 +139,16 @@ if __name__ == "__main__":
         mom = parts[6] if len(parts) > 6 else ""
         pad = int(parts[7]) if len(parts) > 7 else 0
         block = int(parts[8]) if len(parts) > 8 and parts[8] else 1024
+        buckets = int(parts[9]) if len(parts) > 9 and parts[9] else 1
         mom = "bfloat16" if mom in ("bf16", "bfloat16") else mom
         key = (remat_s, int(bs_s), attn, int(accum_s), dtype, vc,
-               mom or "f32", pad, block)
+               mom or "f32", pad, block, buckets)
         if key in captured:
             print(f"[sweep] skip (already captured): {spec}",
                   file=sys.stderr, flush=True)
             continue
         tps = run(remat_s, int(bs_s), attn, int(accum_s), dtype, vc,
-                  mom, pad, block)
+                  mom, pad, block, buckets)
         consecutive_timeouts = consecutive_timeouts + 1 if tps < 0 else 0
         if consecutive_timeouts >= 2:
             # two full-budget child timeouts back-to-back = the backend is

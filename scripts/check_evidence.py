@@ -7,6 +7,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py sweep2
     python scripts/check_evidence.py sft7b
     python scripts/check_evidence.py bench_best
+    python scripts/check_evidence.py overlap        # buckets {1,4,16} rows
     python scripts/check_evidence.py all
 """
 
@@ -160,8 +161,9 @@ def _window_captured(path: str, marker: dict, result_key: str) -> bool:
 
 
 # absent row fields fall back to the emitting script's defaults before the
-# marker compare (round-3 sweep2 rows omit block when it is 1024)
-_MARKER_DEFAULTS = {"block": 1024}
+# marker compare (round-3 sweep2 rows omit block when it is 1024;
+# pre-buckets rows omit vote_buckets when it is 1)
+_MARKER_DEFAULTS = {"block": 1024, "vote_buckets": 1}
 
 # the LAST config of each runbook window's spec list, as structural field
 # markers (stages run sequentially, so the last config's result row
@@ -193,6 +195,22 @@ def sft7b() -> bool:
 
 def bench_best() -> bool:
     return os.path.exists(os.path.join(OUT, "bench_best.done"))
+
+
+# the vote-wire overlap ablation (ISSUE 1): the flagship anchor config at
+# vote_buckets ∈ {1, 4, 16} — every cell must hold a RESULT row, because the
+# measured comm_overlap_frac (bench.overlap_from_ablation) needs the B=1
+# anchor AND at least one pipelined row, and the {4, 16} pair shows whether
+# more buckets keep buying overlap or launch latency wins
+OVERLAP_BUCKETS = (1, 4, 16)
+
+
+def overlap() -> bool:
+    path = os.path.join(OUT, "overlap.jsonl")
+    return all(
+        _window_captured(path, {"vote_buckets": b}, "tokens_per_sec_per_chip")
+        for b in OVERLAP_BUCKETS
+    )
 
 
 def dpo(tpu_only: bool = False) -> bool:
@@ -241,6 +259,7 @@ STAGES = [
     ("sweep2", sweep2),
     ("sweep3", sweep3),
     ("bench_best", bench_best),
+    ("overlap", overlap),
     ("sft7b", sft7b),
     ("parity:local", lambda: parity("local")),
     ("parity:vote", lambda: parity("vote")),
@@ -272,6 +291,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return sft7b()
     if what == "bench_best":
         return bench_best()
+    if what == "overlap":
+        return overlap()
     if what == "conv":
         return conv()
     if what == "conv_full":

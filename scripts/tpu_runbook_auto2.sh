@@ -178,6 +178,23 @@ bench_best_stage bench_best2
   rc=$?; echo "$(stamp) sweep2 rc=$rc" | tee -a "$OUT/log.txt"
 }
 
+# ---- 4b. vote-wire overlap ablation (ISSUE 1): the flagship anchor config
+# at vote_buckets {1, 4, 16} — same workload and trajectory (elections are
+# bit-identical at any B), only WHEN the ballot bytes move changes, so the
+# ms_per_step deltas measure how much wire the bucket pipeline hides behind
+# the fused apply. bench.overlap_from_ablation derives the recorded
+# comm_overlap_frac from these rows; check_evidence stage 'overlap'.
+if python scripts/check_evidence.py overlap; then
+  echo "$(stamp) overlap ablation already captured — skip" | tee -a "$OUT/log.txt"
+else
+  timeout 3000 env SWEEP_SKIP_FILE="$OUT/overlap.jsonl" BENCH_REQUIRE_TPU=1 python scripts/bench_sweep.py \
+      noremat:4:flash@512x1024:16:bf16:8:bfloat16:0:1024:1 \
+      noremat:4:flash@512x1024:16:bf16:8:bfloat16:0:1024:4 \
+      noremat:4:flash@512x1024:16:bf16:8:bfloat16:0:1024:16 \
+      >> "$OUT/overlap.jsonl" 2>> "$OUT/overlap.err"
+  rc=$?; echo "$(stamp) overlap rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 5. 7B QLoRA evidence with the FIXED spec parser + host-side init
 # (the "axon,cpu" platform list exposes the host backend the init path
 # uses; axon stays first = default, so compute still runs on the chip)

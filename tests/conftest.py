@@ -16,3 +16,9 @@ import jax  # noqa: E402
 # before first backend use.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+# Publish jax.shard_map on jax versions that predate it, BEFORE test modules
+# that do `from jax import shard_map` at module scope are collected.
+from distributed_lion_tpu import compat as _compat  # noqa: E402
+
+_compat.install()

@@ -29,6 +29,9 @@ def test_big_replicated_dp_gets_budget_recipe(mesh8):
     r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
                           params_replicated=True)
     assert (r.wire, r.vote_every) == ("packed_a2a", 4)
+    # and the 31M-coordinate per-step slice is big enough for the
+    # pipelined (bucketed) wire — tests/test_vote_buckets.py pins the rest
+    assert r.vote_buckets == 4
 
 
 def test_tiny_ballot_keeps_strict_vote(mesh8):
@@ -54,8 +57,13 @@ def test_world_one_is_silent(mesh8):
 
 
 def test_explicit_choice_is_never_overridden(mesh8):
-    cfg = TrainConfig(wire="sign_psum", vote_every=1)
+    cfg = TrainConfig(wire="sign_psum", vote_every=1, vote_buckets=1)
     assert resolve_auto_comm(cfg, mesh8, 124_000_000, True) is cfg
+    # explicit wire/cadence with the buckets sentinel still resolvable:
+    # only vote_buckets may change
+    part = TrainConfig(wire="sign_psum", vote_every=1)
+    r = resolve_auto_comm(part, mesh8, 124_000_000, True)
+    assert (r.wire, r.vote_every, r.vote_buckets) == ("sign_psum", 1, 4)
 
 
 def test_trainer_resolves_and_steps_with_auto_recipe(mesh8):

@@ -98,9 +98,9 @@ def test_sweep_skip_keys_round_trip(tmp_path, monkeypatch):
     spec.loader.exec_module(bs)
     keys = bs._captured_keys()
     assert ("noremat", 4, "flash@512x1024", 16, "bf16", 8, "bfloat16",
-            0, 1024) in keys
+            0, 1024, 1) in keys
     assert ("noremat", 2, "flash@512x1024", 16, "bf16", 8, "bfloat16",
-            0, 2048) in keys
+            0, 2048, 1) in keys
     assert len(keys) == 2  # the error row contributed nothing
 
 
@@ -233,6 +233,70 @@ def test_conv_dual_directory(tmp_path, monkeypatch):
     assert not ce.conv()
 
 
+def test_overlap_stage_needs_all_three_bucket_rows(tmp_path, monkeypatch):
+    """The vote-wire overlap ablation is captured only when buckets
+    {1, 4, 16} ALL hold result rows — a lone B=1 anchor (or a window that
+    errored on the pipelined legs) must not mark the stage done."""
+    import json as _json
+
+    monkeypatch.setattr(ce, "OUT", str(tmp_path))
+    assert not ce.overlap()
+    base = {"remat": "noremat", "batch_per_dev": 4, "attn": "flash@512x1024",
+            "accum": 16, "dtype": "bf16", "vocab_chunks": 8,
+            "mom_dtype": "bfloat16", "vocab_pad": 0,
+            "tokens_per_sec_per_chip": 98000.0, "ms_per_step": 668.0,
+            "backend": "tpu"}
+    p = tmp_path / "overlap.jsonl"
+    # B=1 rows omit the field (bench_sweep default-elision) — the marker's
+    # _MARKER_DEFAULTS fill must still match them
+    rows = [_json.dumps(base),
+            _json.dumps({**base, "vote_buckets": 4, "ms_per_step": 640.0})]
+    p.write_text("\n".join(rows) + "\n")
+    assert not ce.overlap()  # 16 missing
+    rows.append(_json.dumps({**base, "vote_buckets": 16,
+                             "ms_per_step": 645.0, "error": "x"}))
+    p.write_text("\n".join(rows) + "\n")
+    assert ce.overlap()
+
+
+def test_bench_overlap_from_ablation(tmp_path, monkeypatch):
+    """bench.overlap_from_ablation: measured comm_overlap_frac =
+    (ms[1] − min_B ms[B]) / ms[1] over TPU rows of one config; CPU rows and
+    slower-than-anchor pipelined rows never produce a negative fraction."""
+    import importlib.util
+    import json as _json
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod3", os.path.join(REPO, "bench.py"))
+    b = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(b)
+    d = tmp_path / "SWEEP_r9_raw"
+    d.mkdir()
+    base = {"remat": "noremat", "batch_per_dev": 4, "attn": "flash",
+            "accum": 16, "dtype": "bf16", "tokens_per_sec_per_chip": 9.0}
+    rows = [
+        _json.dumps({**base, "ms_per_step": 700.0}),                # B=1
+        _json.dumps({**base, "ms_per_step": 630.0, "vote_buckets": 4}),
+        _json.dumps({**base, "ms_per_step": 665.0, "vote_buckets": 16}),
+        # a CPU-attested row must be ignored entirely
+        _json.dumps({**base, "ms_per_step": 1.0, "vote_buckets": 4,
+                     "backend": "cpu"}),
+    ]
+    (d / "overlap.jsonl").write_text("\n".join(rows) + "\n")
+    import glob as _glob
+    monkeypatch.setattr(
+        _glob, "glob", lambda pat: [str(d / "overlap.jsonl")])
+    got = b.overlap_from_ablation()
+    assert abs(got["comm_overlap_frac"] - (700.0 - 630.0) / 700.0) < 1e-9
+    assert set(got["ms_per_step"]) == {"1", "4", "16"}
+    # pipelined slower than anchor → clipped at 0, never negative
+    (d / "overlap.jsonl").write_text("\n".join([
+        _json.dumps({**base, "ms_per_step": 700.0}),
+        _json.dumps({**base, "ms_per_step": 800.0, "vote_buckets": 4}),
+    ]) + "\n")
+    assert b.overlap_from_ablation()["comm_overlap_frac"] == 0.0
+
+
 def test_sweep_row_promotable_rule():
     """bench.sweep_row_promotable: the ONE eligibility rule shared by
     _best_sweep_row and the runbook winner promotion."""
@@ -247,6 +311,9 @@ def test_sweep_row_promotable_rule():
     assert b.sweep_row_promotable({**ok, "backend": "tpu"})
     assert not b.sweep_row_promotable({**ok, "backend": "cpu"})
     assert not b.sweep_row_promotable({**ok, "block": 2048})  # not anchor
+    # pipelined-wire ablation rows never displace the monolithic anchor
+    # (the adoption probe in run_inner must carry this field too)
+    assert not b.sweep_row_promotable({**ok, "vote_buckets": 4})
     assert not b.sweep_row_promotable({"error": "boom"})
 
 
