@@ -336,11 +336,23 @@ def run_inner() -> None:
             # ablation (runbook stage → overlap.jsonl) sweeps {1, 4, 16}.
             "vote_buckets": int(knob("BENCH_VOTE_BUCKETS",
                                      "vote_buckets", 1)),
+            # vote-health telemetry in the timed step (train/telemetry).
+            # Default ON: the added device work is one extra ballot-width
+            # pass per OPTIMIZER step (margin bincount + packed-election
+            # XOR, ~0.5 GB of HBM traffic at 124M coords) amortized over
+            # accum microbatches of fwd/bwd — well under 1% of step time —
+            # and elections are pinned bit-identical. Recorded in the row's
+            # config; BENCH_TELEMETRY=0 gives the exact pre-telemetry
+            # methodology for an overhead A/B, and (like any env-moved
+            # knob) marks the run unpromotable.
+            "telemetry": int(knob("BENCH_TELEMETRY", "telemetry", 1)),
         }
         if k["remat"] not in ("noremat", "full", "dots"):
             raise ValueError(f"bad remat {k['remat']!r}")
         if k["vote_buckets"] < 1:
             raise ValueError(f"bad vote_buckets {k['vote_buckets']!r}")
+        if k["telemetry"] not in (0, 1):
+            raise ValueError(f"bad telemetry {k['telemetry']!r}")
         if k["dtype"] not in ("bf16", "f32"):
             raise ValueError(f"bad dtype {k['dtype']!r}")
         from distributed_lion_tpu.ops.attention import parse_attn_spec
@@ -362,6 +374,7 @@ def run_inner() -> None:
     mom_dtype, attn_spec, vocab_pad = (k["mom_dtype"], k["attn"],
                                        k["vocab_pad"])
     vote_buckets = k["vote_buckets"]
+    bench_telemetry = bool(k["telemetry"])
     steps_per_call = int(os.environ.get("BENCH_STEPS", STEPS_PER_CALL))
     timed_calls = int(os.environ.get("BENCH_CALLS", TIMED_CALLS))
     if (steps_per_call, timed_calls) != (STEPS_PER_CALL, TIMED_CALLS):
@@ -391,6 +404,12 @@ def run_inner() -> None:
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
+        # vote-health telemetry rides the timed step so BENCH_*.json tracks
+        # election dynamics (flip rate, margin, disagreement) alongside the
+        # throughput number — see the BENCH_TELEMETRY knob above for the
+        # overhead bound and the opt-out that reproduces the pre-telemetry
+        # methodology exactly.
+        telemetry=bench_telemetry,
         # pin the round-3 comm methodology: every committed sweep/bench row
         # measured every-step sign_psum voting. Left at the auto sentinels,
         # a W>1 backend would resolve to packed_a2a + vote_every=4 (less
@@ -432,18 +451,24 @@ def run_inner() -> None:
     base_key = jax.random.key(0)
 
     # warmup/compile + honest sync
-    trainer.params, trainer.state, m = trainer._train_chunk(
-        trainer.params, trainer.state, trainer._frozen_arg(), batches, base_key
-    )
+    trainer.params, trainer.state, trainer.vote_health, m = (
+        trainer._train_chunk(trainer.params, trainer.state,
+                             trainer.vote_health, trainer._frozen_arg(),
+                             batches, base_key))
     _ = float(np.asarray(jax.device_get(m["loss"])))
+    # drop the warmup window's vote stats: the recorded summary should
+    # describe the TIMED steps only
+    vote_health_summary = trainer.telemetry_summary(reset=True)
 
     t0 = time.perf_counter()
     for _ in range(timed_calls):
-        trainer.params, trainer.state, m = trainer._train_chunk(
-            trainer.params, trainer.state, trainer._frozen_arg(), batches, base_key
-        )
+        trainer.params, trainer.state, trainer.vote_health, m = (
+            trainer._train_chunk(trainer.params, trainer.state,
+                                 trainer.vote_health, trainer._frozen_arg(),
+                                 batches, base_key))
     final_loss = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
+    vote_health_summary = trainer.telemetry_summary()
 
     steps = steps_per_call * timed_calls
     tokens_per_sec = tokens_per_step * steps / dt
@@ -490,8 +515,15 @@ def run_inner() -> None:
                     "accum": accum, "vocab_pad": vocab_pad,
                     "remat": remat_s, "dtype": dtype_s, "block": block,
                     "vote_buckets": vote_buckets,
+                    "telemetry": int(bench_telemetry),
                 },
                 "vote_buckets": vote_buckets,
+                # election dynamics of the timed steps (train/telemetry):
+                # margin histogram (fractions per voted coordinate),
+                # elected-sign flip rate, worker disagreement — the
+                # signals that say whether the 1-bit vote is healthy at
+                # this config, now tracked per BENCH round
+                "vote_health": vote_health_summary,
                 # measured step-time fraction recovered by bucketing the
                 # vote wire, from the committed overlap-ablation rows
                 # (buckets ∈ {1,4,16}, scripts/SWEEP_r*_raw/overlap.jsonl);
@@ -635,7 +667,7 @@ def main() -> None:
           "BENCH_VOCAB_CHUNKS": "0", "BENCH_BATCH": "4",
           "BENCH_VOCAB_PAD": "0", "BENCH_REMAT": "noremat",
           "BENCH_DTYPE": "bf16", "BENCH_BLOCK": "1024",
-          "BENCH_VOTE_BUCKETS": "1",
+          "BENCH_VOTE_BUCKETS": "1", "BENCH_TELEMETRY": "1",
           # an inherited TPU-only pin must not kill the evidence-of-life
           # attempt — it exists precisely for when the TPU is unreachable
           "BENCH_REQUIRE_TPU": ""}),

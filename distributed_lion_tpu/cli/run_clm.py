@@ -18,6 +18,13 @@ sources (zero-egress substitutes for HF-hub streaming): ``synthetic``,
 ``text:<glob>`` (local files via the byte/HF-cache tokenizer), or
 ``bin:<path>`` (pre-tokenized uint16 memmap, e.g. an openwebtext dump).
 Set env ``DLION_PLATFORM=cpu8`` to force an 8-virtual-device CPU mesh.
+
+Observability flags (train/telemetry.py; README "Observability"):
+``--telemetry`` arms vote-health telemetry (on-device margin histogram /
+flip rate / disagreement, measured-vs-analytic wire drift, multi-host
+heartbeat), ``--nan_sentinel`` the per-step isfinite watch with crash
+bundles under ``output_dir/crash/``, ``--trace_on_anomaly`` a profiler
+window at the tripping step.
 """
 
 from __future__ import annotations
@@ -433,6 +440,18 @@ def main(argv=None):
 
     factory = Trainer.for_llama if family == "llama" else Trainer.for_gpt2
     trainer = factory(train_cfg, mesh, model_cfg, initial_params=initial_params)
+    if train_cfg.telemetry:
+        # name the regime the vote-health records will be in: only the
+        # tally wires carry exact margins; the ±1-proxy wires zero the
+        # histogram by design (train/telemetry.tally_wire)
+        from distributed_lion_tpu.train.telemetry import tally_wire
+
+        print("[run_clm] vote-health telemetry on: margin histogram "
+              + ("EXACT (tally wire "
+                 if tally_wire(trainer.cfg.wire) else "UNAVAILABLE (proxy wire ")
+              + f"{trainer.cfg.wire}); drained every "
+              f"{train_cfg.logging_steps} steps"
+              + (", NaN sentinel armed" if train_cfg.nan_sentinel else ""))
     native = make_native_pipeline(
         data_args, train_cfg.block_size, model_cfg.vocab_size,
         trainer.global_train_batch(), train_cfg.seed,

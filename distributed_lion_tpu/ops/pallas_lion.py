@@ -180,6 +180,66 @@ def fused_apply_window(
     return fused_apply(p_w, g_w, m_w, t_w, lr, wd, b2, interpret=interpret)
 
 
+def _stats_kernel(w: int, nbins: int, ballot_ref, tot_ref, mask_ref, out_ref):
+    """Per-bucket vote-health tallies, accumulated across grid steps into a
+    single resident VMEM tile (constant output index map → the buffer
+    persists between iterations; initialized at program_id 0). Row 0 lanes
+    [0, nbins) hold the margin bincount, row 1 lane 0 the local-ballot
+    disagreement count. Binning must match telemetry.margin_hist exactly
+    (pinned by test): bin = min(|total| * nbins // w, nbins − 1)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    t = tot_ref[:].astype(jnp.int32)
+    m = mask_ref[:] > 0  # zero-padded grid tail must not count
+    binidx = jnp.minimum((jnp.abs(t) * nbins) // w, nbins - 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, out_ref.shape, 1)
+    upd = jnp.zeros(out_ref.shape, jnp.int32)
+    for b in range(nbins):  # static unroll: nbins full-tile VPU reductions
+        cnt = jnp.sum(jnp.where(m & (binidx == b), 1, 0))
+        upd = upd + jnp.where((row == 0) & (lane == b), cnt, 0)
+    dis = jnp.sum(jnp.where(m & ((ballot_ref[:] > 0) != (t > 0)), 1, 0))
+    upd = upd + jnp.where((row == 1) & (lane == 0), dis, 0)
+    out_ref[...] = out_ref[...] + upd
+
+
+def bucket_vote_stats(
+    ballot: jnp.ndarray,
+    total: jnp.ndarray,
+    world: int,
+    nbins: int,
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One bucket's vote-health tallies from its int8 ballots and the
+    bucket's collective result: ``(margin bincount i32[nbins], local
+    disagreement count i32)`` — the per-bucket telemetry emitted by the
+    window-kernel optimizer path (optim.distributed_lion telemetry mode).
+    Reads arrays the bucket pipeline already has in VMEM; never touches
+    what is elected. Margin bins are only meaningful when ``total`` is an
+    exact tally (the caller zeroes the histogram for ±1-proxy wires)."""
+    b2, n = _pad_to_grid(ballot.astype(jnp.int8))
+    t2, _ = _pad_to_grid(total.astype(jnp.int32))
+    m2, _ = _pad_to_grid(jnp.ones((n,), jnp.int32))
+    rows, block = b2.shape[0], _grid_rows(n)[1]
+    spec = lambda: pl.BlockSpec((block, LANES), lambda i: (i, 0),  # noqa: E731
+                                memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_stats_kernel, world, nbins),
+        out_shape=jax.ShapeDtypeStruct((8, LANES), jnp.int32),
+        grid=(rows // block,),
+        in_specs=[spec(), spec(), spec()],
+        out_specs=pl.BlockSpec((8, LANES), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(b2, t2, m2)
+    return out[0, :nbins], out[1, 0]
+
+
 def pallas_available() -> bool:
     return jax.default_backend() == "tpu"
 

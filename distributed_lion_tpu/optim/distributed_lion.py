@@ -108,6 +108,7 @@ def distributed_lion(
     vote_buckets: int = 1,
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
+    telemetry: bool = False,
 ) -> FunctionalOptimizer:
     """Build the majority-vote Lion optimizer.
 
@@ -152,6 +153,14 @@ def distributed_lion(
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
             The Pallas path covers the deterministic mode with
             dtype-uniform pytrees; other cases fall back to XLA.
+        telemetry: True → ``step`` returns a third value, the per-step
+            vote-health *frame* (train.telemetry: margin bincount over the
+            voted coordinates for tally wires, packed elected-sign state,
+            local-ballot disagreement / stochastic-flip / valid-update
+            counts) — raw on-device arrays the trainer folds into its
+            ``VoteHealth`` accumulator. Telemetry only OBSERVES the vote:
+            elections, params and momentum are bit-identical to
+            ``telemetry=False`` (pinned by tests/test_telemetry.py).
 
     Returns:
         A :class:`FunctionalOptimizer` whose ``step`` MUST be traced inside
@@ -172,6 +181,11 @@ def distributed_lion(
                 "max_grad_norm (stochastic binarization) requires a vote axis; "
                 "pass axis_name or use lion() for the local optimizer"
             )
+        if telemetry:
+            raise ValueError(
+                "telemetry instruments the vote; with axis_name=None there "
+                "is no election to observe — use lion() for local training"
+            )
         return lion(learning_rate, b1, b2, weight_decay, mom_dtype)
 
     _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
@@ -183,6 +197,12 @@ def distributed_lion(
     from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
 
     interpret = resolve_kernel_mode(kernel)  # None → XLA path
+    if telemetry:
+        # train.telemetry is a leaf module (imports ops/parallel only), so
+        # this upward import cannot cycle; it stays out of the default path.
+        from distributed_lion_tpu.train import telemetry as _vt
+
+        wire_has_tally = _vt.tally_wire(wire)
 
     def init(params, rng: Optional[jax.Array] = None) -> LionState:
         if stochastic and rng is None:
@@ -229,8 +249,11 @@ def distributed_lion(
         w = collectives.axis_size(axis_name)
         bounds = bucket_bounds(n, vote_buckets, w, wire)
         if not bounds:  # zero-coordinate pytree: nothing to vote or apply
-            return params, LionState(state.count + 1, state.exp_avg,
-                                     state.rng, state.elected)
+            out_state = LionState(state.count + 1, state.exp_avg,
+                                  state.rng, state.elected)
+            if telemetry:
+                return params, out_state, _vt.empty_frame(0)
+            return params, out_state
         windows = _bucket_windows(bounds, sizes)
         pieces: list[list] = [[] for _ in sizes]  # per-leaf, in flat order
 
@@ -251,9 +274,26 @@ def distributed_lion(
                     interpret=interpret))
 
         totals = []
+        # telemetry rides the bucket pipeline: each bucket's stats kernel
+        # (margin bincount + local-ballot disagreement, pallas_lion.
+        # bucket_vote_stats) consumes ballots/totals already resident in
+        # VMEM, and packing the per-bucket elections concatenates to the
+        # full packed vector because bucket boundaries are byte-aligned.
+        # Purely observational — the vote/apply dataflow is untouched.
+        hist_acc = jnp.zeros((_vt.NBINS,), jnp.int32) if telemetry else None
+        dis_acc = jnp.zeros((), jnp.int32) if telemetry else None
+        packed_parts: list = []
+        if telemetry:
+            from distributed_lion_tpu.ops.codec import pack_signs
         for k in range(len(bounds)):
+            ballots = _bucket_ballots(k)
             totals.append(collectives.vote_total(
-                _bucket_ballots(k) > 0, axis_name, wire))
+                ballots > 0, axis_name, wire))
+            if telemetry:
+                h, d = pallas_lion.bucket_vote_stats(
+                    ballots, totals[k], w, _vt.NBINS, interpret=interpret)
+                hist_acc, dis_acc = hist_acc + h, dis_acc + d
+                packed_parts.append(pack_signs(totals[k] > 0))
             if k:  # apply k−1 while bucket k's collective is in flight
                 _bucket_apply(k - 1, totals[k - 1])
         _bucket_apply(len(bounds) - 1, totals[-1])
@@ -267,7 +307,7 @@ def distributed_lion(
 
         new_p = [_join(ws, p, 0) for ws, p in zip(pieces, p_leaves)]
         new_m = [_join(ws, m, 1) for ws, m in zip(pieces, m_leaves)]
-        return (
+        out = (
             jax.tree.unflatten(treedef, new_p),
             # this path is gated to vote_every == 1, where the elected-sign
             # cache is None — but the invariant is "state passes through",
@@ -276,10 +316,30 @@ def distributed_lion(
             LionState(state.count + 1, jax.tree.unflatten(treedef, new_m),
                       state.rng, state.elected),
         )
+        if not telemetry:
+            return out
+        frame = {
+            "margin_hist": (hist_acc if wire_has_tally
+                            else jnp.zeros((_vt.NBINS,), jnp.int32)),
+            "elected": (packed_parts[0] if len(packed_parts) == 1
+                        else jnp.concatenate(packed_parts)),
+            "disagree": dis_acc,
+            "voted": jnp.asarray(n, jnp.int32),
+            "valid": jnp.asarray(n, jnp.int32),
+            # this path is gated to the deterministic mode: no quantizer
+            "stoch_flip_frac": jnp.zeros((), jnp.float32),
+            # gated to vote_every == 1: every step is a full re-election
+            "flip_valid": jnp.asarray(True, jnp.bool_),
+        }
+        return out + (frame,)
 
     def _elect_lazy(flat_votes, state: LionState):
         """vote_every > 1: vote the rotating slice, refresh the packed sign
-        cache, return (full elected bools, update-validity mask, new cache)."""
+        cache, return (full elected bools, update-validity mask, new cache,
+        telemetry aux). The aux — (slice ballots, slice totals, slice
+        elections, real-coordinate mask over the padded slice) — feeds the
+        vote-health frame; it is dead code XLA prunes when telemetry is
+        off."""
         from distributed_lion_tpu.ops.codec import pack_signs, unpack_signs
 
         n = flat_votes.shape[0]
@@ -291,8 +351,9 @@ def distributed_lion(
         sl = lax.dynamic_slice(padded, (slot * chunk,), (chunk,))
         # the rotating 1/K slice votes bucket-wise too: same elected bits,
         # but the slice's wire splits into vote_buckets pipelineable chunks
-        elected_sl = collectives.majority_vote_bucketed(
+        totals_sl = collectives.vote_total_bucketed(
             sl, axis_name, wire, vote_buckets)
+        elected_sl = totals_sl > 0
         new_cache = lax.dynamic_update_slice(
             state.elected, pack_signs(elected_sl), (slot * chunk // 8,)
         )
@@ -301,7 +362,36 @@ def distributed_lion(
         # coordinates get no update (replicas agree — count is shared)
         slot_idx = jnp.arange(vote_every * chunk, dtype=jnp.int32) // chunk
         valid = slot_idx <= state.count
-        return bits[:n], valid[:n], new_cache
+        # only the LAST slot can run past n: alignment pads the slice there
+        mask_sl = (slot * chunk + jnp.arange(chunk, dtype=jnp.int32)) < n
+        return bits[:n], valid[:n], new_cache, (sl, totals_sl, elected_sl,
+                                                mask_sl)
+
+    def _make_frame(local, totals, elected, *, mask, voted, valid,
+                    elected_packed, flip_valid):
+        """Assemble the per-step vote-health frame (telemetry mode only) from
+        the XLA path's vote internals: local bool ballots, the (possibly
+        ±1-proxy) totals, the elected bools, and — under lazy refresh — the
+        real-coordinate mask over the padded slice plus the refreshed packed
+        cache. Observational: consumes the vote, never feeds back into it."""
+        from distributed_lion_tpu.ops.codec import pack_signs
+
+        w = collectives.axis_size(axis_name)
+        hist = (_vt.margin_hist(totals, w, mask=mask) if wire_has_tally
+                else jnp.zeros((_vt.NBINS,), jnp.int32))
+        dis = local != elected
+        if mask is not None:
+            dis = dis & mask
+        return {
+            "margin_hist": hist,
+            "elected": (pack_signs(elected) if elected_packed is None
+                        else elected_packed),
+            "disagree": jnp.sum(dis.astype(jnp.int32)),
+            "voted": jnp.asarray(voted, jnp.int32),
+            "valid": valid,
+            "stoch_flip_frac": jnp.zeros((), jnp.float32),
+            "flip_valid": jnp.asarray(flip_valid, jnp.bool_),
+        }
 
     def step(params, grads, state: LionState):
         # grad → momentum-dtype cast, hoisted ONCE for both kernel paths
@@ -335,12 +425,17 @@ def distributed_lion(
             )
 
         # 3) ONE collective for the whole pytree (vs per-tensor all_gather,
-        #    ref :81): flatten → vote → split.
+        #    ref :81): flatten → vote → split. The vote runs through
+        #    vote_total (elected ⇔ total > 0) so telemetry can read the
+        #    margin where the wire moves it; the election itself is the
+        #    same function majority_vote_bucketed computes.
         flat = _flatten_votes(votes)
         new_cache = state.elected
+        frame = None
         if vote_every == 1:
-            elected = collectives.majority_vote_bucketed(
+            totals = collectives.vote_total_bucketed(
                 flat, axis_name, wire, vote_buckets)
+            elected = totals > 0
             elected_tree = _split_votes(elected, votes)
             # 4) apply the elected ±1 update (ref :91-92). The psum output is
             #    identical on every worker, so replicated params stay replicated.
@@ -348,20 +443,48 @@ def distributed_lion(
                 lambda p, v: lion_math.apply_signed_update(p, v, lr),
                 decayed, elected_tree,
             )
+            if telemetry:
+                frame = _make_frame(flat, totals, elected, mask=None,
+                                    voted=flat.shape[0],
+                                    valid=jnp.asarray(flat.shape[0],
+                                                      jnp.int32),
+                                    elected_packed=None, flip_valid=True)
         else:
-            elected, valid, new_cache = _elect_lazy(flat, state)
+            elected, valid, new_cache, aux = _elect_lazy(flat, state)
             signs = jnp.where(elected, 1.0, -1.0) * valid
             signs_tree = _split_votes(signs, votes)
             new_params = jax.tree.map(
                 lambda p, s: p - jnp.asarray(lr, p.dtype) * s.astype(p.dtype),
                 decayed, signs_tree,
             )
+            if telemetry:
+                sl, totals_sl, elected_sl, mask_sl = aux
+                frame = _make_frame(
+                    sl, totals_sl, elected_sl, mask=mask_sl,
+                    voted=jnp.sum(mask_sl.astype(jnp.int32)),
+                    valid=jnp.sum(valid.astype(jnp.int32)),
+                    elected_packed=new_cache,
+                    # the refreshed slot last voted at count − K: before a
+                    # full rotation its cache bytes are the zero init, not
+                    # a previous election
+                    flip_valid=state.count >= vote_every)
+        if telemetry and stochastic:
+            # quantizer noise: how often the stochastic ballot differs from
+            # the deterministic sign it replaces (full-ballot local mean)
+            det_flat = _flatten_votes(jax.tree.map(
+                lambda g, m: lion_math.sign_vote_bool(g, m, b1),
+                grads, state.exp_avg))
+            frame["stoch_flip_frac"] = jnp.mean(
+                (flat != det_flat).astype(jnp.float32))
 
         # 5) momentum with the LOCAL gradient — divergent by design (ref :96).
         new_m = jax.tree.map(
             lambda g, m: lion_math.momentum_update(g, m, b2), grads, state.exp_avg
         )
-        return new_params, LionState(state.count + 1, new_m, state.rng, new_cache)
+        out_state = LionState(state.count + 1, new_m, state.rng, new_cache)
+        if telemetry:
+            return new_params, out_state, frame
+        return new_params, out_state
 
     return FunctionalOptimizer(init=init, step=step)
 

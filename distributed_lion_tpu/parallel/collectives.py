@@ -55,6 +55,56 @@ from distributed_lion_tpu.ops.codec import (
 )
 
 
+class WireTally:
+    """Measured wire counters, recorded at TRACE time from the operands the
+    vote collectives are actually handed.
+
+    Bytes on the wire are a pure function of operand shapes, and the call
+    sites below execute exactly once per compiled step — so a one-trace
+    capture (``telemetry.measure_step_wire`` wraps ``jax.eval_shape``)
+    yields the exact per-step ledger with zero runtime overhead. Each entry
+    is ``(leg, received_bytes)`` per collective launch: one entry per bucket
+    of the bucketed wire, per phase of the two-phase wires, per ring of the
+    hier wire ('dcn' for its cross-group leg, 'ici' for everything else).
+
+    The per-leg byte conventions deliberately mirror
+    ``ops.codec._recv_bytes`` (bytes RECEIVED per worker) — what makes the
+    cross-check against ``profiling.comm_report`` non-circular is that the
+    values here come from the LIVE padded/chunked array shapes at the call
+    sites, so any drift between the accounting's assumptions (alignment,
+    chunk padding, per-bucket splits, call counts) and what the collectives
+    actually move shows up as a nonzero ``comm_drift_bytes`` metric.
+    Recording is inert (None sink) outside a capture, and W = 1 records
+    nothing: every wire short-circuits on a 1-device axis.
+    """
+
+    def __init__(self):
+        self._entries: list | None = None
+
+    class _Capture:
+        def __init__(self, tally: "WireTally"):
+            self._tally = tally
+
+        def __enter__(self):
+            self._prev = self._tally._entries
+            self._tally._entries = []
+            return self._tally._entries
+
+        def __exit__(self, *exc):
+            self._tally._entries = self._prev
+            return False
+
+    def capture(self) -> "WireTally._Capture":
+        return WireTally._Capture(self)
+
+    def record(self, leg: str, nbytes: int) -> None:
+        if self._entries is not None and nbytes > 0:
+            self._entries.append((leg, int(nbytes)))
+
+
+WIRE_TALLY = WireTally()
+
+
 def axis_size(axis_name: str) -> int:
     """Static size of a bound mesh axis (the reference's world_size,
     distributed_lion.py:80)."""
@@ -80,12 +130,16 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
         # exactly for |sum| ≤ 127, so promote only for large worlds.
         acc = jnp.int8 if w <= 127 else jnp.int32
         ballots = jnp.where(vote_pos, 1, -1).astype(acc)
+        if w > 1:  # ring all-reduce: received ≈ the tensor once, on-fabric
+            WIRE_TALLY.record("ici", ballots.size * ballots.dtype.itemsize)
         return lax.psum(ballots, axis_name)
     if kind == "packed_allgather":
         # The reference's pack → all_gather → unpack → vote pipeline
         # (distributed_lion.py:71-91) with a true-uint8 wire format;
         # vote_pos must be 1-D (callers vote on a flattened pytree).
         packed = pack_signs(vote_pos)                  # [ceil(n/8)] uint8
+        if w > 1:
+            WIRE_TALLY.record("ici", w * packed.size)
         gathered = lax.all_gather(packed, axis_name)   # [W, ceil(n/8)] uint8
         bits = unpack_signs(gathered.reshape(-1), (w, gathered.shape[1] * 8))
         count = bits.astype(jnp.int32).sum(0)[: vote_pos.shape[0]]
@@ -150,11 +204,15 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndar
     pad = chunk * 8 * w - n
     padded = jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)]) if pad else vote_pos
     packed = pack_signs(padded).reshape(w, chunk)  # row j = my ballot for chunk j
+    if w > 1:  # phase 1: (W−1) peers each send me their copy of my chunk
+        WIRE_TALLY.record("ici", (w - 1) * chunk)
     # phase 1: worker j receives every worker's row j → [W, chunk]
     arrived = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0, tiled=True)
     bits = unpack_signs(arrived.reshape(-1), (w, chunk * 8))
     count = bits.astype(jnp.int32).sum(0)              # per-bit True tally
     verdict = count * 2 > w                            # tie → False (−1)
+    if w > 1:  # phase 2: (W−1) peers each send me their chunk's verdict
+        WIRE_TALLY.record("ici", (w - 1) * chunk)
     # phase 2: broadcast my chunk's packed verdict to everyone
     gathered = lax.all_gather(pack_signs(verdict), axis_name)  # [W, chunk]
     return unpack_signs(gathered.reshape(-1), (n,))
@@ -229,6 +287,8 @@ def _hier_elect(
         return msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0], None
 
     msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
+    if g > 1 and w > 1:  # leg 1: (g−1) ballot-chunk hops at the acc width
+        WIRE_TALLY.record("ici", (g - 1) * chunk * jnp.dtype(acc).itemsize)
     if g > 1:
         msg, _ = lax.scan(_rs_hop, msg, jnp.arange(g - 1))
     verdict_own = msg > 0  # subgroup tie → −1, for my owned coords
@@ -247,6 +307,8 @@ def _hier_elect(
         return (count + unpack_signs(rot, (chunk,)).astype(jnp.int32), rot), None
 
     count = verdict_own.astype(jnp.int32)
+    if n_groups > 1 and w > 1:  # leg 2: the ONLY cross-group (DCN) traffic
+        WIRE_TALLY.record("dcn", (n_groups - 1) * (chunk // 8))
     if n_groups > 1:
         (count, _), _ = lax.scan(
             _cross_hop, (count, pack_signs(verdict_own)), None,
@@ -265,6 +327,8 @@ def _hier_elect(
     packed_own = pack_signs(elected_own)  # [chunk/8] uint8
     out = jnp.zeros((g, chunk // 8), jnp.uint8)
     out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
+    if g > 1 and w > 1:  # leg 3: (g−1) packed elected-chunk hops
+        WIRE_TALLY.record("ici", (g - 1) * (chunk // 8))
     if g > 1:
         (out, _), _ = lax.scan(_ag_hop, (out, packed_own), jnp.arange(g - 1))
     return unpack_signs(out.reshape(-1), (g * chunk,))[:n]

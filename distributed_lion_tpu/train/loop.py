@@ -19,7 +19,10 @@ Grad accumulation is a ``lax.scan`` over microbatches (the reference's
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import math
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Iterator, Optional
@@ -55,6 +58,7 @@ from distributed_lion_tpu.parallel.mesh import (
     TENSOR_AXIS,
     data_axis_size,
 )
+from distributed_lion_tpu.train import telemetry
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import (
@@ -62,6 +66,7 @@ from distributed_lion_tpu.train.profiling import (
     StepTimer,
     comm_report,
     peak_hbm_gb,
+    peak_hbm_per_device,
 )
 from distributed_lion_tpu.train.schedule import (
     constant_schedule,
@@ -165,6 +170,25 @@ class TrainConfig:
     profile_dir: Optional[str] = None  # capture a jax.profiler trace window
     profile_start_step: int = 10
     profile_num_steps: int = 3
+    telemetry: bool = False  # vote-health telemetry (train/telemetry.py):
+    # an on-device VoteHealth accumulator rides the jitted step (margin
+    # histogram, elected-sign flip rate, worker disagreement, stochastic
+    # flip fraction, valid-update sparsity) and drains to the metrics log
+    # at logging_steps cadence — zero added host transfers per step, and
+    # elections stay bit-identical to telemetry-off (tests/test_telemetry).
+    # Also arms measured wire counters (trace-time byte ledger at the vote-
+    # collective call sites, cross-checked against the analytic comm_report
+    # as comm_drift_bytes) and the multi-host step heartbeat. Lion-only:
+    # the AdamW path has no election to observe.
+    nan_sentinel: bool = False  # per-step isfinite watch over loss + grad
+    # norm (checked one dispatch behind so the device pipeline stays full);
+    # on trip, writes a crash bundle (step, config, per-leaf finite masks
+    # naming the poisoned leaves, recent metrics window) to
+    # output_dir/crash/step_<n>/ and raises FloatingPointError.
+    trace_on_anomaly: bool = False  # with nan_sentinel: instead of raising
+    # immediately, arm a StepProfiler window at the tripping step (trace
+    # written into the crash bundle), run profile_num_steps more steps to
+    # capture the poisoned dataflow, then raise.
 
     def schedule(self) -> Callable:
         if self.lr_scheduler_type == "cosine":
@@ -313,6 +337,11 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             "see the same gradient for that chunk — with async_grad the "
             "all_gather would stitch together chunk-wise single-worker updates"
         )
+    if cfg.telemetry and not cfg.lion:
+        raise ValueError(
+            "--telemetry instruments the majority-vote election; the AdamW "
+            "path has no vote to observe — drop one of the two flags"
+        )
     if cfg.lion:
         mom_dtype = jnp.dtype(cfg.mom_dtype) if cfg.mom_dtype else None
         return distributed_lion(
@@ -331,6 +360,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             vote_buckets=cfg.vote_buckets or 1,
             kernel=cfg.kernel,
             mom_dtype=mom_dtype,
+            telemetry=cfg.telemetry,
         )
     if cfg.async_grad:
         raise ValueError(
@@ -479,6 +509,15 @@ class Trainer:
                     "stale signs would land on the wrong coordinates. Use "
                     "lazy vote refresh with replicated params (dp / dp x sp)."
                 )
+        if cfg.telemetry and _spec_sharded_axes(param_specs):
+            raise ValueError(
+                f"--telemetry is incompatible with params sharded over "
+                f"{sorted(_spec_sharded_axes(param_specs))}: each rank's "
+                "ballot covers its own local shards, so the packed election "
+                "state the accumulator carries would differ across ranks "
+                "while its P() spec declares it replicated. Use vote-health "
+                "telemetry with replicated params (dp / dp x sp)."
+            )
 
         self.params = jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, param_specs
@@ -536,6 +575,30 @@ class Trainer:
         else:
             self.state = jax.device_put(self.opt.init(self.params), NamedSharding(mesh, P()))
 
+        # Vote-health telemetry state (train/telemetry.py): a small
+        # replicated accumulator pytree threaded through the jitted step —
+        # the ONLY signature change telemetry makes ({} when off keeps the
+        # arity fixed, like the frozen arg). Drained + reset at log cadence.
+        self._telemetry_on = bool(cfg.telemetry and cfg.lion)
+        self._margin_exact = (self._telemetry_on
+                              and telemetry.tally_wire(cfg.wire))
+        if self._telemetry_on:
+            n_tel = sum(int(np.prod(p.shape))
+                        for p in jax.tree.leaves(self.params))
+            self._n_ballot = n_tel
+            self.vote_health = jax.device_put(
+                telemetry.init_vote_health(n_tel, cfg.vote_every or 1),
+                NamedSharding(mesh, P()),
+            )
+        else:
+            self._n_ballot = 0
+            self.vote_health = {}
+        self._wire_measured: Optional[dict] = None  # trace-time byte ledger
+        self._metrics_window: collections.deque = collections.deque(maxlen=16)
+        self._sentinel_pending = None   # (step, metrics) awaiting the check
+        self._anomaly_deadline = None   # step to stop the anomaly trace at
+        self._anomaly_reason = ""
+
         self.step_count = 0
         self._resume_skip_batches = 0
         self._schedule = cfg.schedule()
@@ -547,8 +610,13 @@ class Trainer:
 
         self.loss_fn = loss_fn
         self._train_step_core = self._build_train_step_core()
-        self._train_step = jax.jit(self._train_step_core, donate_argnums=(0, 1))
-        self._train_chunk = jax.jit(self._build_train_chunk(), donate_argnums=(0, 1))
+        # the accumulator (arg 2) is NOT donated: its zero-initialized
+        # scalar counters can alias one device buffer, which XLA rejects as
+        # a double donation — and its buffers are rebuilt every step anyway
+        self._train_step = jax.jit(self._train_step_core,
+                                   donate_argnums=(0, 1))
+        self._train_chunk = jax.jit(self._build_train_chunk(),
+                                    donate_argnums=(0, 1))
         self._eval_step = self._build_eval_step()
         self.checkpointer = (
             Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit)
@@ -580,6 +648,85 @@ class Trainer:
                            accum_steps=self.cfg.gradient_accumulation_steps,
                            vote_buckets=self.cfg.vote_buckets or 1)
 
+    # -------------------------------------------------------------- telemetry
+    def telemetry_summary(self, reset: bool = False) -> Optional[dict]:
+        """Current vote-health summary as host floats (None when telemetry
+        is off) — used by bench.py's record rows and available to callers
+        that drive the jitted steps directly instead of train()."""
+        if not self._telemetry_on:
+            return None
+        out = telemetry.drain(self.vote_health, self._margin_exact)
+        if reset:
+            self.vote_health = telemetry.reset_counters(self.vote_health)
+        return out
+
+    def _measure_wire_once(self, batch_example) -> None:
+        """Capture the measured per-step wire ledger (one abstract trace of
+        the step with the collectives' tally recording — zero steady-state
+        cost). Runs once, lazily, because the batch structure is only known
+        when training starts."""
+        if (self._wire_measured is not None or not self._telemetry_on
+                or self.world <= 1):
+            return
+        try:
+            self._wire_measured = telemetry.measure_step_wire(
+                self._train_step_core, self.params, self.state,
+                self.vote_health, self._frozen_arg(), batch_example,
+                jax.random.key(0),
+            )
+        except Exception as e:  # measurement must never take down training
+            print(f"[telemetry] wire measurement unavailable: {e}")
+            self._wire_measured = {}
+
+    def _check_sentinel(self, step: int, metrics,
+                        force_raise: bool = False) -> None:
+        """The NaN sentinel's host half: isfinite over the step's loss (and
+        pre-clip grad norm). On trip, writes the crash bundle and raises —
+        or, under --trace_on_anomaly, arms a profiler window at the
+        tripping step first so the poisoned dataflow lands in a trace."""
+        if self._anomaly_deadline is not None and not force_raise:
+            return  # already tripped; the armed trace window is draining
+        vals = {}
+        for k in ("loss", "grad_norm"):
+            if k in metrics:
+                vals[k] = float(np.asarray(jax.device_get(metrics[k])))
+        bad = {k: v for k, v in vals.items() if not math.isfinite(v)}
+        if not bad:
+            return
+        reason = ("non-finite " + ", ".join(f"{k}={v!r}"
+                                            for k, v in bad.items())
+                  + f" at step {step}")
+        print(f"[trainer] ANOMALY: {reason}")
+        crash_dir = None
+        if self.cfg.output_dir:
+            window = list(self._metrics_window)
+            window.append({"step": step, "tripped": True, **{
+                k: float(np.asarray(jax.device_get(v)))
+                for k, v in metrics.items()}})
+            crash_dir = telemetry.write_crash_bundle(
+                self.cfg.output_dir, step, reason,
+                dataclasses.asdict(self.cfg), self.params, self.state,
+                window)
+            print(f"[trainer] crash bundle written to {crash_dir}")
+        if self.cfg.trace_on_anomaly and not force_raise:
+            trace_base = crash_dir or self.cfg.profile_dir
+            if trace_base:
+                # a --profile_dir window may be mid-capture: flush it before
+                # swapping profilers, or the anomaly window's start_trace
+                # would raise on the still-open jax profiler session
+                self.profiler.close(sync=metrics)
+                self.profiler = StepProfiler(
+                    os.path.join(trace_base, "trace"),
+                    start_step=self.step_count,
+                    num_steps=self.cfg.profile_num_steps)
+                self._anomaly_deadline = (self.step_count
+                                          + self.cfg.profile_num_steps + 1)
+                self._anomaly_reason = reason
+                print("[trainer] armed anomaly trace window for steps "
+                      f"[{self.step_count}, {self._anomaly_deadline - 1})")
+                return
+        raise FloatingPointError(reason)
+
     # ------------------------------------------------------------------ steps
     def _build_train_step_core(self):
         cfg = self.cfg
@@ -596,16 +743,21 @@ class Trainer:
         ep = dict(self.mesh.shape).get(EXPERT_AXIS, 1)
         has_frozen = self.frozen is not None
         frozen_specs = self.frozen_specs if has_frozen else {}
+        telemetry_on = self._telemetry_on
+        n_ballot = self._n_ballot
+        world = self.world
+        nan_sentinel = cfg.nan_sentinel
+        vh_specs = jax.tree.map(lambda _: P(), self.vote_health)
 
         @partial(
             jax.shard_map,
             mesh=self.mesh,
-            in_specs=(self.param_specs, st_specs, frozen_specs,
+            in_specs=(self.param_specs, st_specs, vh_specs, frozen_specs,
                       self.batch_spec, P()),
-            out_specs=(self.param_specs, st_specs, P()),
+            out_specs=(self.param_specs, st_specs, vh_specs, P()),
             check_vma=False,
         )
-        def step(params, state, frozen, batch, base_key):
+        def step(params, state, vh, frozen, batch, base_key):
             call_loss = ((lambda p, b, k: loss_fn(p, frozen, b, k))
                          if has_frozen else loss_fn)
             # each batch leaf: [accum * local_bs, ...] → [accum, local_bs, ...]
@@ -662,6 +814,18 @@ class Trainer:
             # else: no gradient sync — the AsyncTrainer contract
             # (async_trainer.py:15). The ONLY collective is the vote in
             # opt.step.
+            shard_axes = tuple(a for a, flag in
+                               ((TENSOR_AXIS, tp_axis is not None),
+                                (PIPE_AXIS, pp > 1),
+                                (EXPERT_AXIS, ep > 1)) if flag)
+            gnorm = None
+            if nan_sentinel:
+                # pre-clip global norm (clipping would mask the explosion
+                # the sentinel exists to catch); same exact cross-axis sum
+                # the clipper uses, then meaned over workers for logging
+                gsq = global_grad_sq(grads, specs=param_specs,
+                                     shard_axes=shard_axes)
+                gnorm = jnp.sqrt(lax.pmean(gsq, DATA_AXIS))
             clip = (cfg.grad_clip_norm if cfg.grad_clip_norm is not None
                     else cfg.max_grad_norm)
             if clip:
@@ -670,10 +834,6 @@ class Trainer:
                 # clipping after the all-reduce). Under TP/PP the grads of
                 # sharded leaves get their norms psum'd across that axis so
                 # every rank derives the same scale.
-                shard_axes = tuple(a for a, flag in
-                                   ((TENSOR_AXIS, tp_axis is not None),
-                                    (PIPE_AXIS, pp > 1),
-                                    (EXPERT_AXIS, ep > 1)) if flag)
                 grads = clip_by_global_norm(grads, clip, specs=param_specs,
                                             shard_axes=shard_axes)
             if cfg.lion:
@@ -682,7 +842,15 @@ class Trainer:
                 st = squeeze_zero_state(state)
             else:
                 st = state
-            new_params, new_st = opt.step(params, grads, st)
+            if telemetry_on:
+                # the optimizer emits the per-step vote-health frame; fold
+                # it into the replicated accumulator on device (the only
+                # additions are two scalar psums — no host traffic, and the
+                # election itself is untouched)
+                new_params, new_st, frame = opt.step(params, grads, st)
+                vh = telemetry.fold(vh, frame, DATA_AXIS, world, n_ballot)
+            else:
+                new_params, new_st = opt.step(params, grads, st)
             if cfg.lion:
                 new_state = expand_worker_state(new_st)
             elif cfg.zero1:
@@ -691,7 +859,9 @@ class Trainer:
                 new_state = new_st
 
             mean_metrics = {k: lax.pmean(v.mean(), DATA_AXIS) for k, v in metrics.items()}
-            return new_params, new_state, mean_metrics
+            if gnorm is not None:
+                mean_metrics["grad_norm"] = gnorm
+            return new_params, new_state, vh, mean_metrics
 
         return step
 
@@ -701,16 +871,17 @@ class Trainer:
         host→device round trip per K steps instead of per step."""
         step = self._train_step_core
 
-        def chunk(params, state, frozen, batches, base_key):
+        def chunk(params, state, vh, frozen, batches, base_key):
             def body(carry, batch):
-                p, s = carry
-                p, s, m = step(p, s, frozen, batch, base_key)
-                return (p, s), m
+                p, s, v = carry
+                p, s, v, m = step(p, s, v, frozen, batch, base_key)
+                return (p, s, v), m
 
-            (params, state), ms = lax.scan(body, (params, state), batches)
+            (params, state, vh), ms = lax.scan(body, (params, state, vh),
+                                               batches)
             # per-chunk mean for logging (loss of the last step alone would
             # alias a single microbatch draw)
-            return params, state, jax.tree.map(lambda x: x.mean(0), ms)
+            return params, state, vh, jax.tree.map(lambda x: x.mean(0), ms)
 
         return chunk
 
@@ -776,27 +947,45 @@ class Trainer:
                 # fused K-step dispatch; the tail below K runs step-by-step
                 # (avoids a second jit specialization for the remainder)
                 stack = [next(train_iter) for _ in range(k)]
+                self._measure_wire_once(stack[0])
                 batches = jax.device_put(
                     jax.tree.map(lambda *xs: np.stack(xs), *stack), chunk_spec
                 )
                 with self.profiler.annotate(self.step_count):
-                    self.params, self.state, metrics = self._train_chunk(
-                        self.params, self.state, self._frozen_arg(), batches,
-                        base_key
+                    (self.params, self.state, self.vote_health,
+                     metrics) = self._train_chunk(
+                        self.params, self.state, self.vote_health,
+                        self._frozen_arg(), batches, base_key
                     )
                 self.step_count += k
                 self.timer.tick(k)
             else:
-                batch = jax.device_put(next(train_iter), data_spec)
+                raw_batch = next(train_iter)
+                self._measure_wire_once(raw_batch)
+                batch = jax.device_put(raw_batch, data_spec)
                 with self.profiler.annotate(self.step_count):
-                    self.params, self.state, metrics = self._train_step(
-                        self.params, self.state, self._frozen_arg(), batch,
-                        base_key
+                    (self.params, self.state, self.vote_health,
+                     metrics) = self._train_step(
+                        self.params, self.state, self.vote_health,
+                        self._frozen_arg(), batch, base_key
                     )
                 self.step_count += 1
                 self.timer.tick()
                 advanced = 1
             self.profiler.maybe_stop(self.step_count, sync=metrics)
+            if cfg.nan_sentinel:
+                # trailing isfinite watch: the PREVIOUS dispatch's metrics
+                # are checked after this one is in flight, so the device
+                # pipeline stays full while anomalies are still caught one
+                # dispatch late (the bundle names the tripping step)
+                if self._sentinel_pending is not None:
+                    self._check_sentinel(*self._sentinel_pending)
+                self._sentinel_pending = (self.step_count, metrics)
+            if (self._anomaly_deadline is not None
+                    and self.step_count >= self._anomaly_deadline):
+                # trace_on_anomaly: the armed window has captured its steps
+                self.profiler.maybe_stop(self.step_count, sync=metrics)
+                raise FloatingPointError(self._anomaly_reason)
 
             # boundary tests are "crossed a multiple of N during this
             # dispatch" so chunked advances never skip a log/eval/save
@@ -819,8 +1008,41 @@ class Trainer:
                 hbm = peak_hbm_gb()
                 if hbm is not None:
                     m["peak_hbm_gb"] = hbm
+                if self._telemetry_on:
+                    # drain the on-device accumulator (the interval's ONLY
+                    # telemetry host transfer) and reset its counters; the
+                    # previous election carries over so flip rates stay
+                    # continuous across intervals
+                    vote = telemetry.drain(self.vote_health,
+                                           self._margin_exact)
+                    self.vote_health = telemetry.reset_counters(
+                        self.vote_health)
+                    m.update({f"vote/{k}": v for k, v in vote.items()})
+                    if self._wire_measured:
+                        mw = self._wire_measured
+                        m["comm_measured_bytes_per_step"] = mw[
+                            "bytes_per_step"]
+                        m["comm_measured_calls_per_step"] = mw[
+                            "calls_per_step"]
+                        if mw.get("dcn_bytes_per_step"):
+                            m["comm_measured_dcn_bytes_per_step"] = mw[
+                                "dcn_bytes_per_step"]
+                        if comm:
+                            # analytic-vs-measured drift, a first-class
+                            # metric: 0 unless the accounting and the
+                            # collectives have diverged
+                            m["comm_drift_bytes"] = (
+                                mw["bytes_per_step"]
+                                - comm["comm_bytes_per_step"])
+                    skew = telemetry.host_step_skew(self.step_count)
+                    if skew is not None:
+                        m["host_step_skew"] = skew
+                    per_dev = peak_hbm_per_device()
+                    if per_dev is not None and len(per_dev) > 1:
+                        m["peak_hbm_per_device"] = per_dev
                 t_last, s_last = now, self.step_count
                 self.logger.log(self.step_count, m, prefix="train")
+                self._metrics_window.append({"step": self.step_count, **m})
                 history.append({"step": self.step_count, **m})
 
             if eval_blocks is not None and self.step_count % cfg.eval_steps < advanced:
@@ -828,6 +1050,10 @@ class Trainer:
 
             if self.checkpointer and self.step_count % cfg.save_steps < advanced:
                 self.save()
+        if cfg.nan_sentinel and self._sentinel_pending is not None:
+            # the final dispatch's metrics were still awaiting their check
+            pending, self._sentinel_pending = self._sentinel_pending, None
+            self._check_sentinel(*pending, force_raise=True)
         return history
 
     def evaluate(self, eval_blocks: np.ndarray) -> dict:
@@ -1351,41 +1577,48 @@ def _count_of(state) -> jnp.ndarray:
     return state.count
 
 
+def global_grad_sq(grads, specs=None, shard_axes: tuple = ()):
+    """Exact squared global L2 norm of a gradient pytree inside shard_map.
+
+    Under tensor/pipeline/expert parallelism (``shard_axes`` + ``specs``),
+    the squared norm of each leaf SHARDED over one of those axes is psum'd
+    across that axis (each rank holds one shard of that gradient) while
+    replicated leaves — whose grads are complete and identical on every
+    rank, via the copy_to_tp_region boundary / the pipe-axis grad psum —
+    are counted once, so every rank derives the same value. The data axis
+    is deliberately never summed: per-worker grads get per-worker norms
+    (they are different gradients, not shards of one). Shared by the
+    clipper and the NaN sentinel's grad-norm metric."""
+    def _sq(g):
+        return jnp.sum(jnp.square(g.astype(jnp.float32)))
+
+    if not shard_axes:
+        return sum(_sq(g) for g in jax.tree.leaves(grads))
+    from distributed_lion_tpu.parallel.tensor_parallel import spec_uses_axis
+
+    flat_g, gdef = jax.tree.flatten(grads)
+    flat_s = gdef.flatten_up_to(specs)  # P leaves; same structure as grads
+    # accumulate per axis-subset: a leaf sharded over axis A contributes
+    # its local sq, psum'd over A; leaves sharded over several axes are
+    # psum'd over each in turn
+    sq = jnp.float32(0)
+    by_axes: dict = {}
+    for g, s in zip(flat_g, flat_s):
+        axes = tuple(a for a in shard_axes if spec_uses_axis(s, a))
+        by_axes[axes] = by_axes.get(axes, jnp.float32(0)) + _sq(g)
+    for axes, part in by_axes.items():
+        for a in axes:
+            part = lax.psum(part, a)
+        sq = sq + part
+    return sq
+
+
 def clip_by_global_norm(grads, clip: float, specs=None,
                         shard_axes: tuple = ()):
     """Scale the whole pytree so its global L2 norm is ≤ ``clip`` — the
     torch.nn.utils.clip_grad_norm_ semantics HF Trainer applies before every
     optimizer step (default max_grad_norm=1.0), which the reference inherits.
-
-    Inside shard_map under tensor/pipeline parallelism (``shard_axes`` +
-    ``specs``), the squared norm of each leaf SHARDED over one of those axes
-    is psum'd across that axis (each rank holds one shard of that gradient)
-    while replicated leaves — whose grads are complete and identical on every
-    rank, via the copy_to_tp_region boundary / the pipe-axis grad psum — are
-    counted once. Every rank then applies the same scale. The data axis is
-    deliberately never summed: per-worker grads get per-worker norms (they
-    are different gradients, not shards of one)."""
-    def _sq(g):
-        return jnp.sum(jnp.square(g.astype(jnp.float32)))
-
-    if not shard_axes:
-        sq = sum(_sq(g) for g in jax.tree.leaves(grads))
-    else:
-        from distributed_lion_tpu.parallel.tensor_parallel import spec_uses_axis
-
-        flat_g, gdef = jax.tree.flatten(grads)
-        flat_s = gdef.flatten_up_to(specs)  # P leaves; same structure as grads
-        # accumulate per axis-subset: a leaf sharded over axis A contributes
-        # its local sq, psum'd over A; leaves sharded over several axes are
-        # psum'd over each in turn
-        sq = jnp.float32(0)
-        by_axes: dict = {}
-        for g, s in zip(flat_g, flat_s):
-            axes = tuple(a for a in shard_axes if spec_uses_axis(s, a))
-            by_axes[axes] = by_axes.get(axes, jnp.float32(0)) + _sq(g)
-        for axes, part in by_axes.items():
-            for a in axes:
-                part = lax.psum(part, a)
-            sq = sq + part
+    Norm semantics under model parallelism: see :func:`global_grad_sq`."""
+    sq = global_grad_sq(grads, specs=specs, shard_axes=shard_axes)
     scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
     return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)

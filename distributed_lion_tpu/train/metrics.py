@@ -10,6 +10,7 @@ environment (env-var/netrc auth, never a literal key in code).
 from __future__ import annotations
 
 import json
+import math
 import os
 import pathlib
 import sys
@@ -45,7 +46,15 @@ class MetricsLogger:
                         for k, v in record.items())
         print(line, flush=True)
         if self.jsonl:
-            self.jsonl.write(json.dumps(record) + "\n")
+            # allow_nan=False: json.dumps(nan) silently emits the bare token
+            # `NaN`, which is NOT JSON — every strict consumer downstream
+            # (jq, pandas read_json, check_evidence) chokes on the whole
+            # line. Non-finite floats become null with the raw value
+            # preserved under "<k>_repr" (jsonable_record), and the flag
+            # turns any future regression into a loud error instead of a
+            # corrupt log. scripts/validate_metrics.py is the CI check.
+            self.jsonl.write(
+                json.dumps(jsonable_record(record), allow_nan=False) + "\n")
         if self.wandb:
             self.wandb.log(record, step=step)
 
@@ -61,3 +70,25 @@ def _scalar(v):
         return float(v)
     except (TypeError, ValueError):
         return v
+
+
+def jsonable_record(record: dict) -> dict:
+    """Strict-JSON view of a flat metrics record: NaN/±Inf floats become
+    ``null`` with the raw value preserved as a string under ``"<k>_repr"``
+    (so a diverged loss is still visible in the log, in valid JSON). Lists
+    (e.g. the vote-margin histogram, per-device HBM) are sanitized
+    elementwise — a non-finite element inside one would corrupt the line
+    just the same."""
+    out: dict = {}
+    for k, v in record.items():
+        if isinstance(v, float) and not math.isfinite(v):
+            out[k] = None
+            out[f"{k}_repr"] = repr(v)
+        elif isinstance(v, (list, tuple)):
+            out[k] = [None if isinstance(x, float) and not math.isfinite(x)
+                      else x for x in v]
+            if any(isinstance(x, float) and not math.isfinite(x) for x in v):
+                out[f"{k}_repr"] = repr(list(v))
+        else:
+            out[k] = v
+    return out

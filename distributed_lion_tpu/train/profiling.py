@@ -19,6 +19,7 @@ profiling is a first-class trainer subsystem:
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Optional
 
@@ -93,8 +94,11 @@ class StepTimer:
     def __init__(self, ema_alpha: float = 0.1, window: int = 256):
         self.alpha = ema_alpha
         self.window = window
+        # deque(maxlen) evicts in O(1); the old list.pop(0) shifted the
+        # whole 256-sample window on every steady-state step
+        self._samples: collections.deque[float] = collections.deque(
+            maxlen=window)
         self.ema: Optional[float] = None
-        self._samples: list[float] = []
         self._last: Optional[float] = None
 
     def tick(self, n_steps: int = 1) -> Optional[float]:
@@ -108,8 +112,6 @@ class StepTimer:
         self._last = now
         self.ema = dt if self.ema is None else self.alpha * dt + (1 - self.alpha) * self.ema
         self._samples.append(dt)
-        if len(self._samples) > self.window:
-            self._samples.pop(0)
         return dt
 
     def stats(self) -> dict:
@@ -123,18 +125,31 @@ class StepTimer:
         }
 
 
-def peak_hbm_gb() -> Optional[float]:
-    """Peak device-memory high-water mark in GiB, or None where the backend
-    exposes no memory_stats (host CPU)."""
+def peak_hbm_per_device() -> Optional[list[float]]:
+    """Peak device-memory high-water mark in GiB for EVERY local device (in
+    ``jax.local_devices()`` order), or None where the backend exposes no
+    memory_stats (host CPU). Per-device values matter because sharded
+    workloads are limited by the WORST device — an imbalanced shard or a
+    stray buffer on one chip is invisible in a device-0-only reading."""
     try:
         import jax
 
-        ms = jax.local_devices()[0].memory_stats()
-        if not ms or "peak_bytes_in_use" not in ms:
-            return None
-        return round(ms["peak_bytes_in_use"] / 2**30, 3)
+        out = []
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if not ms or "peak_bytes_in_use" not in ms:
+                return None
+            out.append(round(ms["peak_bytes_in_use"] / 2**30, 3))
+        return out or None
     except Exception:
         return None
+
+
+def peak_hbm_gb() -> Optional[float]:
+    """The high-water mark across ALL local devices (the number an OOM is
+    actually decided by), not device 0's alone."""
+    per = peak_hbm_per_device()
+    return max(per) if per else None
 
 
 def comm_report(num_params: int, world: int, wire: str,

@@ -44,16 +44,20 @@ def main(batch_per_dev=8, remat=True):
     batch = jax.device_put(blocks[:global_bs].astype(np.int32),
                            NamedSharding(mesh, P("data")))
     key = jax.random.key(0)
-    trainer.params, trainer.state, m = trainer._train_step(
-        trainer.params, trainer.state, trainer._frozen_arg(), batch, key)
+    trainer.params, trainer.state, trainer.vote_health, m = (
+        trainer._train_step(trainer.params, trainer.state,
+                            trainer.vote_health, trainer._frozen_arg(),
+                            batch, key))
     print("warmup loss:", float(np.asarray(jax.device_get(m["loss"]))), flush=True)
 
     for steps, sync in [(5, "get"), (20, "get"), (50, "get"), (20, "block"),
                         (20, "get_each")]:
         t0 = time.perf_counter()
         for _ in range(steps):
-            trainer.params, trainer.state, m = trainer._train_step(
-                trainer.params, trainer.state, trainer._frozen_arg(), batch, key)
+            trainer.params, trainer.state, trainer.vote_health, m = (
+                trainer._train_step(trainer.params, trainer.state,
+                                    trainer.vote_health,
+                                    trainer._frozen_arg(), batch, key))
             if sync == "get_each":
                 _ = float(np.asarray(jax.device_get(m["loss"])))
         if sync == "block":

@@ -145,15 +145,19 @@ def run(quant: str = "nf4", batch_per_dev: int = 1, accum: int = 4,
     )
     key = jax.random.key(0)
     t0 = time.perf_counter()
-    trainer.params, trainer.state, m = trainer._train_chunk(
-        trainer.params, trainer.state, trainer._frozen_arg(), batches, key)
+    trainer.params, trainer.state, trainer.vote_health, m = (
+        trainer._train_chunk(trainer.params, trainer.state,
+                             trainer.vote_health, trainer._frozen_arg(),
+                             batches, key))
     _ = float(np.asarray(jax.device_get(m["loss"])))
     compile_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for _ in range(TIMED_CALLS):
-        trainer.params, trainer.state, m = trainer._train_chunk(
-            trainer.params, trainer.state, trainer._frozen_arg(), batches, key)
+        trainer.params, trainer.state, trainer.vote_health, m = (
+            trainer._train_chunk(trainer.params, trainer.state,
+                                 trainer.vote_health, trainer._frozen_arg(),
+                                 batches, key))
     loss = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
     steps = K * TIMED_CALLS

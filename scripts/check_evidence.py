@@ -8,6 +8,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py sft7b
     python scripts/check_evidence.py bench_best
     python scripts/check_evidence.py overlap        # buckets {1,4,16} rows
+    python scripts/check_evidence.py telemetry      # vote-health JSONL
     python scripts/check_evidence.py all
 """
 
@@ -252,6 +253,43 @@ def conv(dirname: str | None = None) -> bool:
     return False
 
 
+# vote-health telemetry artifact (ISSUE 2): the runbook's telemetry stage
+# runs a short --telemetry --nan_sentinel training (runs/telemetry) whose
+# metrics.jsonl must hold vote-health rows with a CONSERVED margin
+# histogram: the histogram is normalized per voted coordinate, so its mass
+# times the voted-coordinate count must equal the voted-coordinate count
+# (mass == 1 ⇔ every voted coordinate landed in a bin — the invariant that
+# catches binning/masking bugs in the on-device accumulator). Only rows
+# from tally wires are judged (margin_exact == 1; the two-phase wires ship
+# a ±1 proxy and zero the histogram by design).
+TELEMETRY_MASS_RTOL = 0.01
+
+
+def telemetry_ok(dirname: str = "telemetry") -> bool:
+    path = os.path.join(REPO, "runs", dirname, "metrics.jsonl")
+    found = False
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                hist = r.get("train/vote/margin_hist")
+                if hist is None or r.get("train/vote/margin_exact") != 1:
+                    continue
+                voted = r.get("train/vote/voted_per_step", 0)
+                if not voted or None in hist:
+                    return False
+                mass = sum(hist)
+                if abs(mass * voted - voted) > TELEMETRY_MASS_RTOL * voted:
+                    return False  # histogram lost/invented coordinates
+                found = True
+    except OSError:
+        return False
+    return found
+
+
 # the ONE stage list both check("all") and the CLI printout derive from —
 # adding a stage here updates the watcher exit condition and the operator
 # status display together
@@ -267,6 +305,7 @@ STAGES = [
     ("parity:PASS", parity_pass),
     ("conv", conv),
     ("dpo", dpo),
+    ("telemetry", telemetry_ok),
 ]
 
 
@@ -306,6 +345,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return parity_full(arg or "local")
     if what == "dpo":
         return dpo(tpu_only=arg == "tpu")
+    if what == "telemetry":
+        return telemetry_ok(arg or "telemetry")
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -220,6 +220,33 @@ else
   rc=$?; echo "$(stamp) dpo rc=$rc" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5c. vote-health telemetry artifact (ISSUE 2, ~2 min): a short
+# --telemetry --nan_sentinel run on the chip mesh emits the vote-health
+# JSONL (margin histogram / flip rate / disagreement / measured-vs-analytic
+# comm drift) that check_evidence's 'telemetry' stage validates — the stage
+# asserts the margin histogram conserves the voted-coordinate count and the
+# JSONL is strict JSON (validate_metrics). sign_psum + vote_every 1 pins a
+# tally wire so the margin histogram is exact; kernel stays auto so the
+# Pallas stats kernel runs on real hardware at least once per round.
+if python scripts/check_evidence.py telemetry; then
+  echo "$(stamp) telemetry artifact already captured — skip" | tee -a "$OUT/log.txt"
+else
+  mkdir -p runs/telemetry
+  timeout 900 python -m distributed_lion_tpu.cli.run_clm \
+      --model_name tiny --dataset synthetic --lion --async_grad \
+      --telemetry --nan_sentinel \
+      --wire sign_psum --vote_every 1 --vote_buckets 4 \
+      --per_device_train_batch_size 2 --gradient_accumulation_steps 1 \
+      --block_size 128 --max_steps 60 --warmup_steps 5 \
+      --logging_steps 10 --eval_steps 100000 --save_steps 100000 \
+      --output_dir runs/telemetry \
+      >> "$OUT/telemetry.log" 2>&1
+  rc=$?
+  python scripts/validate_metrics.py runs/telemetry/metrics.jsonl \
+      >> "$OUT/telemetry.log" 2>&1 || rc=$?
+  echo "$(stamp) telemetry rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

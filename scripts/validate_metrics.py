@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""JSONL schema smoke check for metrics logs — the CI guard behind
+MetricsLogger's strict-JSON contract.
+
+``json.dumps(float('nan'))`` emits the bare token ``NaN``, which is not
+JSON: one diverged loss used to corrupt the whole line for every strict
+consumer (jq, pandas, check_evidence). MetricsLogger now serializes
+non-finite floats as ``null`` with the raw value under ``"<k>_repr"``; this
+script asserts a metrics file actually honors that contract:
+
+- every non-empty line parses as STRICT JSON (the NaN/Infinity/-Infinity
+  tokens Python's json module happily reads back are rejected);
+- every record is an object carrying an integer ``step``;
+- every value is a JSON scalar or a flat list of JSON scalars (the shapes
+  downstream tooling indexes by key).
+
+A torn final line (a run killed mid-write) is tolerated once, at EOF —
+append-mode logs legitimately end that way.
+
+    python scripts/validate_metrics.py runs/telemetry/metrics.jsonl [...]
+
+Exit 0 = every file valid. Used by tests/test_telemetry.py and the
+runbook's telemetry stage (scripts/tpu_runbook_auto2.sh).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _reject_constant(name: str):
+    raise ValueError(f"non-finite JSON constant {name!r} (invalid JSON; "
+                     "MetricsLogger must serialize it as null + _repr)")
+
+
+def _scalar_ok(v) -> bool:
+    return v is None or isinstance(v, (str, int, float, bool))
+
+
+def validate_file(path: str) -> list[str]:
+    """Return a list of violation strings (empty = valid)."""
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    n_records = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as e:
+            if i == len(lines) and "constant" not in str(e):
+                continue  # torn last line from a mid-write kill: tolerated
+            errors.append(f"{path}:{i}: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: record is {type(rec).__name__}, "
+                          "not an object")
+            continue
+        n_records += 1
+        if not isinstance(rec.get("step"), int):
+            errors.append(f"{path}:{i}: missing integer 'step'")
+        for k, v in rec.items():
+            if _scalar_ok(v):
+                continue
+            if isinstance(v, list) and all(_scalar_ok(x) for x in v):
+                continue
+            errors.append(f"{path}:{i}: key {k!r} holds a "
+                          f"{type(v).__name__} (want scalar or flat list)")
+    if n_records == 0:
+        errors.append(f"{path}: no metrics records")
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    failed = False
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            failed = True
+            for e in errors:
+                print(f"INVALID {e}")
+        else:
+            print(f"ok {path}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -346,3 +346,35 @@ def test_unpromoted_capture_cannot_clobber_promoted_artifact(tmp_path):
     art.write_text(_json.dumps({"value": 1.0, "promoted": False}))
     b._record_tpu_measurement({"value": 2.0, "promoted": False})
     assert _json.loads(art.read_text())["value"] == 2.0
+
+
+def test_telemetry_stage_mass_conservation(tmp_path, monkeypatch):
+    """The 'telemetry' stage (ISSUE 2): a vote-health row passes only when
+    its margin histogram conserves the voted-coordinate count (mass ~= 1 of
+    per-voted-coordinate fractions), comes from a tally wire
+    (margin_exact == 1), and parses as strict JSON. A lossy histogram, a
+    proxy-wire row alone, or an absent artifact must all read MISSING."""
+    import json as _json
+
+    monkeypatch.setattr(ce, "REPO", str(tmp_path))
+    d = tmp_path / "runs" / "telemetry"
+    d.mkdir(parents=True)
+    path = d / "metrics.jsonl"
+
+    def row(hist, exact=1, voted=124672.0):
+        return _json.dumps({
+            "step": 10, "train/vote/margin_hist": hist,
+            "train/vote/margin_exact": exact,
+            "train/vote/voted_per_step": voted,
+        })
+
+    good = row([0.25, 0.0, 0.4, 0.0, 0.2, 0.0, 0.1, 0.05])
+    assert not ce.telemetry_ok()            # absent artifact
+    path.write_text(row([0.1] * 8, exact=0) + "\n")
+    assert not ce.telemetry_ok()            # proxy-wire rows alone: no
+    path.write_text(good + "\n")
+    assert ce.telemetry_ok()                # conserved mass: captured
+    path.write_text(good + "\n" + row([0.2] * 8) + "\n")
+    assert not ce.telemetry_ok()            # any lossy row fails the stage
+    path.write_text(row([0.5, None] + [0.1] * 6) + "\n")
+    assert not ce.telemetry_ok()            # null bin (NaN leaked): fail

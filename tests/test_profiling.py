@@ -26,6 +26,69 @@ def test_step_timer_stats():
     assert len(t._samples) == 8  # sliding window bounded
 
 
+def test_step_timer_math_regression(monkeypatch):
+    """The deque(maxlen) satellite must not change the numbers: feed a
+    deterministic clock and pin EMA + window eviction + percentiles against
+    hand-computed values (list.pop(0) -> deque changed complexity, not
+    math)."""
+    import distributed_lion_tpu.train.profiling as prof
+
+    now = [0.0]
+    monkeypatch.setattr(prof.time, "perf_counter", lambda: now[0])
+    t = StepTimer(ema_alpha=0.5, window=4)
+    assert t.tick() is None
+    # dts: 1, 2, 3, 4, 5, 6 with window 4 -> keeps [3, 4, 5, 6]
+    expected_ema = None
+    for dt in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        now[0] += dt
+        got = t.tick()
+        assert got == pytest.approx(dt)
+        expected_ema = dt if expected_ema is None else (
+            0.5 * dt + 0.5 * expected_ema)
+    assert list(t._samples) == [3.0, 4.0, 5.0, 6.0]
+    s = t.stats()
+    assert s["step_time_ema_s"] == pytest.approx(expected_ema)
+    assert s["step_time_p50_s"] == pytest.approx(
+        float(np.percentile([3.0, 4.0, 5.0, 6.0], 50)))
+    assert s["step_time_p95_s"] == pytest.approx(
+        float(np.percentile([3.0, 4.0, 5.0, 6.0], 95)))
+    # multi-step dispatch divides the interval by n_steps
+    now[0] += 8.0
+    assert t.tick(n_steps=4) == pytest.approx(2.0)
+
+
+def test_peak_hbm_is_max_over_all_local_devices(monkeypatch):
+    """peak_hbm_gb must report the WORST local device (an OOM is decided by
+    the max, not device 0), and the per-device view must expose every
+    device for the telemetry report."""
+    import jax
+
+    from distributed_lion_tpu.train.profiling import (
+        peak_hbm_gb,
+        peak_hbm_per_device,
+    )
+
+    class _Dev:
+        def __init__(self, peak):
+            self._peak = peak
+
+        def memory_stats(self):
+            return {"peak_bytes_in_use": self._peak}
+
+    devs = [_Dev(1 * 2**30), _Dev(3 * 2**30), _Dev(2 * 2**30)]
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    assert peak_hbm_per_device() == [1.0, 3.0, 2.0]
+    assert peak_hbm_gb() == 3.0  # device 1, not device 0
+
+    class _NoStats:
+        def memory_stats(self):
+            return None
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [devs[0], _NoStats()])
+    assert peak_hbm_per_device() is None  # partial stats -> honest None
+    assert peak_hbm_gb() is None
+
+
 def test_profiler_inactive_without_dir():
     p = StepProfiler(None)
     p.maybe_start(10)
