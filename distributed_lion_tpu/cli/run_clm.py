@@ -465,6 +465,15 @@ def main(argv=None):
         it = batch_iterator(train_blocks, trainer.global_train_batch(), seed=train_cfg.seed)
     try:
         trainer.train(it, eval_blocks=eval_blocks)
+        if trainer.preempted:
+            # drained + emergency checkpoint already durable; exit 0 so the
+            # watcher restarts this command into a normal resume
+            print("[run_clm] preempted: "
+                  + ("checkpoint durable, " if trainer.checkpointer
+                     else "NO checkpointer (no --output_dir) — nothing "
+                          "saved, ")
+                  + "exiting cleanly")
+            return
         if eval_blocks is not None and len(eval_blocks):
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:

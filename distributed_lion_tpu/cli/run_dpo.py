@@ -277,6 +277,13 @@ def main(argv=None):
     it = dpo_batch_iterator(train_data, trainer.global_train_batch(), seed=train_cfg.seed)
     try:
         trainer.train(it, eval_blocks=eval_data)
+        if trainer.preempted:
+            print("[run_dpo] preempted: "
+                  + ("checkpoint durable, " if trainer.checkpointer
+                     else "NO checkpointer (no --output_dir) — nothing "
+                          "saved, ")
+                  + "exiting cleanly")
+            return
         if eval_data is not None:
             trainer.evaluate(eval_data)
         if trainer.checkpointer:

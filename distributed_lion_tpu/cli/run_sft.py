@@ -359,6 +359,13 @@ def main(argv=None):
 
     try:
         trainer.train(train_iter, eval_blocks=eval_blocks)
+        if trainer.preempted:
+            print("[run_sft] preempted: "
+                  + ("checkpoint durable, " if trainer.checkpointer
+                     else "NO checkpointer (no --output_dir) — nothing "
+                          "saved, ")
+                  + "exiting cleanly")
+            return
         if eval_blocks is not None:
             trainer.evaluate(eval_blocks)
         if trainer.checkpointer:

@@ -173,6 +173,13 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
             block_q, block_kv = 512, 1024
         else:
             impl = "xla"
+            # auto resolved AWAY from flash (no TPU backend): pinned tiles
+            # — bwd like fwd — are flash knobs with nothing left to tune.
+            # Drop them instead of tripping the explicit-impl guard below:
+            # an auto@...@BQBxBKVB spec must degrade off-TPU exactly like
+            # auto@... does, not raise the flash-knob ValueError that
+            # exists for EXPLICIT xla/splash requests
+            block_q_bwd = block_kv_bwd = 0
     if impl == "flash":
         return attention_flash(q, k, v, causal=causal,
                                block_q=block_q, block_kv=block_kv,

@@ -2,6 +2,7 @@ from distributed_lion_tpu.optim.lion import lion, LionState
 from distributed_lion_tpu.optim.distributed_lion import (
     distributed_lion,
     init_global_state,
+    remap_worker_momentum,
     squeeze_worker_state,
     expand_worker_state,
 )

@@ -523,3 +523,52 @@ def expand_worker_state(state: LionState) -> LionState:
     """Inside shard_map: restore the leading [1] axis before returning."""
     return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg),
                      state.rng, state.elected)
+
+
+def remap_worker_momentum(exp_avg, old_world: int, new_world: int):
+    """Remap stacked ``[W, ...]`` per-worker Lion momenta to ``[W', ...]``
+    for elastic resume (train/loop._maybe_resume + --elastic_resume).
+
+    The per-worker momenta are the algorithm's only divergent state; the
+    defined remap preserves their cross-worker MEAN exactly in every case,
+    so the center of the vote distribution — what the majority election
+    estimates — is unchanged by a world-size change:
+
+    - ``W' == W``: identity (bit-exact round trip, pinned by tests).
+    - ``W' < W``, ``W % W' == 0`` (e.g. 4→2, 4→1): **shard-group
+      re-averaging** — new worker i takes the mean of old workers
+      ``[i*g, (i+1)*g)`` with ``g = W/W'``; the mean of group means over
+      equal-size groups is the overall mean.
+    - ``W' > W``, ``W' % W == 0`` (e.g. 2→4): each old worker's momentum is
+      replicated to its ``W'/W`` successors (``repeat`` along axis 0); every
+      old momentum appears equally often, so the mean is unchanged. The
+      clones re-diverge immediately through their per-worker gradients (and,
+      under stochastic binarization, per-worker RNG folds of the new index).
+    - otherwise (coprime W→W'): every new worker starts from the old
+      cross-worker mean — per-worker diversity is deliberately collapsed
+      rather than invented, and the vote center is still preserved.
+
+    Reductions run in f32 and cast back (bf16 ``mom_dtype`` momenta must not
+    lose their mean to accumulation order)."""
+    if new_world == old_world:
+        return exp_avg
+    if new_world < 1 or old_world < 1:
+        raise ValueError(f"invalid world sizes {old_world}->{new_world}")
+
+    def _remap(m):
+        if m.shape[0] != old_world:
+            raise ValueError(
+                f"momentum leaf has leading dim {m.shape[0]}, expected "
+                f"old world {old_world}")
+        f32 = jnp.asarray(m, jnp.float32)
+        if old_world % new_world == 0:
+            g = old_world // new_world
+            out = f32.reshape((new_world, g) + f32.shape[1:]).mean(axis=1)
+        elif new_world % old_world == 0:
+            out = jnp.repeat(f32, new_world // old_world, axis=0)
+        else:
+            out = jnp.broadcast_to(f32.mean(axis=0, keepdims=True),
+                                   (new_world,) + f32.shape[1:])
+        return out.astype(m.dtype)
+
+    return jax.tree.map(_remap, exp_avg)

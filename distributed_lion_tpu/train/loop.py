@@ -40,6 +40,7 @@ from distributed_lion_tpu.optim import (
     distributed_lion,
     expand_worker_state,
     init_global_state,
+    remap_worker_momentum,
     squeeze_worker_state,
 )
 from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
@@ -58,7 +59,7 @@ from distributed_lion_tpu.parallel.mesh import (
     TENSOR_AXIS,
     data_axis_size,
 )
-from distributed_lion_tpu.train import telemetry
+from distributed_lion_tpu.train import resilience, telemetry
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import (
@@ -166,6 +167,29 @@ class TrainConfig:
     save_total_limit: Optional[int] = 2
     output_dir: Optional[str] = None
     resume_from_checkpoint: bool = True
+    async_ckpt: bool = True  # async double-buffered checkpointing
+    # (train/checkpoint.py): save() kicks off the Orbax async write and
+    # returns after the device→host copy; the blocking drain moves to the
+    # NEXT save boundary (and close()/anomaly paths), so serialization and
+    # disk I/O hide behind the following train steps. The ckpt_stall_s
+    # metric logs the loop's actual checkpoint tax; tests pin it below the
+    # synchronous baseline. False = the old blocking save.
+    ckpt_integrity: bool = True  # per-file sha256 manifest + COMMITTED
+    # marker written last (atomic commit): resume autodetect verifies
+    # newest-first and falls back to the newest GOOD checkpoint, so a torn
+    # leaf file or corrupted manifest costs one save interval, not the run.
+    on_preempt: str = "save_exit"  # save_exit | off. save_exit installs a
+    # SIGTERM guard (train/resilience.PreemptionGuard) checked once per
+    # dispatch: on trip the loop drains the in-flight async save, writes an
+    # emergency checkpoint tagged 'preempt', and returns cleanly so the
+    # process exits 0 and the watcher restarts into a normal resume.
+    elastic_resume: bool = False  # allow resuming a checkpoint written at a
+    # DIFFERENT data-parallel world size: the stacked [W, ...] Lion momenta
+    # are remapped to [W', ...] by optim.distributed_lion.
+    # remap_worker_momentum (shard-group re-averaging W'<W, replication
+    # W'>W, mean broadcast otherwise — the cross-worker momentum mean, the
+    # vote distribution's center, is preserved exactly in every case).
+    # Off by default: a world-size mismatch is loud, not silently remapped.
     report_to_wandb: bool = False
     profile_dir: Optional[str] = None  # capture a jax.profiler trace window
     profile_start_step: int = 10
@@ -619,10 +643,19 @@ class Trainer:
                                     donate_argnums=(0, 1))
         self._eval_step = self._build_eval_step()
         self.checkpointer = (
-            Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit)
+            Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit,
+                         async_save=cfg.async_ckpt,
+                         integrity=cfg.ckpt_integrity)
             if cfg.output_dir
             else None
         )
+        if cfg.on_preempt not in ("save_exit", "off"):
+            raise ValueError(
+                f"--on_preempt {cfg.on_preempt!r}: expected 'save_exit' "
+                "(drain + emergency checkpoint + clean return) or 'off'")
+        self.preempted = False
+        self._preempt_guard = (resilience.PreemptionGuard()
+                               if cfg.on_preempt == "save_exit" else None)
         self.logger = MetricsLogger(cfg.output_dir, use_wandb=cfg.report_to_wandb)
         self.profiler = StepProfiler(cfg.profile_dir, cfg.profile_start_step,
                                      cfg.profile_num_steps)
@@ -725,6 +758,10 @@ class Trainer:
                 print("[trainer] armed anomaly trace window for steps "
                       f"[{self.step_count}, {self._anomaly_deadline - 1})")
                 return
+        if self.checkpointer:
+            # don't die with an async save half-committed: the last good
+            # checkpoint must be durable before the anomaly unwinds us
+            self.checkpointer.finalize()
         raise FloatingPointError(reason)
 
     # ------------------------------------------------------------------ steps
@@ -985,6 +1022,8 @@ class Trainer:
                     and self.step_count >= self._anomaly_deadline):
                 # trace_on_anomaly: the armed window has captured its steps
                 self.profiler.maybe_stop(self.step_count, sync=metrics)
+                if self.checkpointer:
+                    self.checkpointer.finalize()
                 raise FloatingPointError(self._anomaly_reason)
 
             # boundary tests are "crossed a multiple of N during this
@@ -1008,6 +1047,11 @@ class Trainer:
                 hbm = peak_hbm_gb()
                 if hbm is not None:
                     m["peak_hbm_gb"] = hbm
+                if self.checkpointer:
+                    # seconds the loop spent blocked on checkpointing since
+                    # the last log — async saves keep this near 0 while the
+                    # sync path pays the full serialize+write here
+                    m["ckpt_stall_s"] = self.checkpointer.pop_stall_s()
                 if self._telemetry_on:
                     # drain the on-device accumulator (the interval's ONLY
                     # telemetry host transfer) and reset its counters; the
@@ -1050,6 +1094,26 @@ class Trainer:
 
             if self.checkpointer and self.step_count % cfg.save_steps < advanced:
                 self.save()
+
+            if (self._preempt_guard is not None
+                    and self._preempt_guard.should_stop()):
+                # preemption drain: flag was set by SIGTERM/maintenance;
+                # checked once per dispatch so we act at a consistent
+                # boundary. Drain the in-flight async save, make the
+                # emergency checkpoint durable, and return cleanly — the
+                # caller exits 0 and the watcher restarts into a resume.
+                if self.checkpointer:
+                    print(f"[trainer] preemption at step {self.step_count}:"
+                          " draining in-flight save, writing emergency "
+                          "checkpoint")
+                    self.save(tag="preempt")
+                    self.checkpointer.finalize()
+                else:
+                    print(f"[trainer] preemption at step {self.step_count}:"
+                          " no output_dir — NOTHING SAVED; a restart "
+                          "begins from step 0")
+                self.preempted = True
+                break
         if cfg.nan_sentinel and self._sentinel_pending is not None:
             # the final dispatch's metrics were still awaiting their check
             pending, self._sentinel_pending = self._sentinel_pending, None
@@ -1100,35 +1164,240 @@ class Trainer:
         return out
 
     # ------------------------------------------------------------ checkpoints
-    def _payload(self):
+    @staticmethod
+    def _pack_state_rng(state):
+        """Typed PRNG keys are not serializable (Orbax sees an opaque
+        key dtype); store the raw key data and re-wrap on restore. A
+        stochastic-binarization checkpoint without this loses its RNG —
+        save simply failed before the resilience PR."""
+        rng = getattr(state, "rng", None)
+        if rng is None or not jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            return state
+        return state._replace(rng=jax.random.key_data(rng))
+
+    @staticmethod
+    def _unpack_state_rng(state):
+        rng = getattr(state, "rng", None)
+        if rng is None or jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+            return state
+        return state._replace(rng=jax.random.wrap_key_data(rng))
+
+    def _payload(self, world: Optional[int] = None):
         # 0-d ndarray, not np.int64 scalar: older orbax StandardCheckpointHandler
         # versions only accept ndarray/jax.Array leaves
-        return {"params": self.params, "opt_state": self.state,
-                "step": np.asarray(self.step_count, np.int64)}
+        payload = {"params": self.params,
+                   "opt_state": self._pack_state_rng(self.state),
+                   "step": np.asarray(self.step_count, np.int64),
+                   # data-iterator position (1 batch per step) and the world
+                   # size the momenta were stacked at, explicit in the
+                   # payload so resume doesn't have to infer either
+                   "batches_consumed": np.asarray(self.step_count, np.int64),
+                   "world": np.asarray(world or self.world, np.int64)}
+        if self._telemetry_on:
+            # the vote-health accumulator rides the checkpoint so flip
+            # rates / histograms stay continuous across a restart
+            payload["vote_health"] = self.vote_health
+        return payload
 
-    def save(self) -> None:
+    def save(self, tag: str = "periodic") -> None:
         assert self.checkpointer is not None
         if self.checkpointer.latest_step() == self.step_count:
             return  # already saved at this step (e.g. final save on a save_steps boundary)
-        self.checkpointer.save(self.step_count, self._payload())
+        self.checkpointer.save(
+            self.step_count, self._payload(),
+            meta={"world": self.world, "tag": tag,
+                  "step": self.step_count,
+                  "batches_consumed": self.step_count,
+                  "has_vote_health": self._telemetry_on,
+                  "wire": self.cfg.wire, "vote_every": self.cfg.vote_every})
+
+    def _vote_health_template(self, ckpt_vote_every: int):
+        """A restore template for the checkpoint's vote_health accumulator,
+        sized by the CHECKPOINT's vote_every (prev_elected's packed length
+        depends on it) — the current config's value may differ, in which
+        case the restored accumulator is discarded after restore. The
+        template must still match what was saved: Orbax rejects templates
+        missing (or mis-shaping) a saved key."""
+        return jax.device_put(
+            telemetry.init_vote_health(self.n_params, ckpt_vote_every),
+            NamedSharding(self.mesh, P()))
+
+    def _elastic_template(self, ckpt_world: int, meta: dict):
+        """Restore template for a checkpoint stacked at a DIFFERENT world
+        size: momentum leaves get a [ckpt_world, ...] leading dim. Params
+        restore straight into their real shardings (same shapes at any
+        world); the momentum stack shards its leading axis over 'data'
+        whenever ckpt_world divides by the current world — only the
+        non-divisible upscale case (e.g. 2→4) falls back to replicated
+        restore, the one shape the mesh can't split evenly."""
+        repl = NamedSharding(self.mesh, P())
+
+        def _repl(x):
+            if isinstance(x, jax.Array):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=repl)
+            return x
+
+        tpl = jax.tree.map(_repl, self._payload())
+        tpl["params"] = jax.tree.map(
+            lambda p, s: jax.ShapeDtypeStruct(
+                p.shape, p.dtype, sharding=NamedSharding(self.mesh, s)),
+            self.params, self.param_specs)
+        # shape the vote_health slot to what the CHECKPOINT holds (it is
+        # restored then discarded — its normalizations reference the old
+        # world, so the telemetry window restarts fresh after remap)
+        tpl.pop("vote_health", None)
+        if meta.get("has_vote_health"):
+            tpl["vote_health"] = self._vote_health_template(
+                int(meta.get("vote_every", 1)) or 1)
+        mom_shard = (NamedSharding(self.mesh, P(DATA_AXIS))
+                     if ckpt_world % self.world == 0 else repl)
+        tpl["opt_state"] = tpl["opt_state"]._replace(
+            exp_avg=jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(
+                    (ckpt_world,) + m.shape[1:], m.dtype,
+                    sharding=mom_shard),
+                tpl["opt_state"].exp_avg),
+        )
+        return tpl
+
+    def _restore_step(self, step: int, meta: dict, ckpt_world: int) -> None:
+        ckpt_ve = int(meta.get("vote_every", self.cfg.vote_every or 1)) or 1
+        if ckpt_world == self.world:
+            tpl = self._payload()
+            # shape the template to what the checkpoint actually holds —
+            # Orbax rejects templates missing (or mis-shaping) a saved key,
+            # so the meta's has_vote_health/vote_every stamps decide the
+            # vote_health slot, not the current run's flags
+            has_vh = meta.get("has_vote_health")
+            if has_vh is False:
+                tpl.pop("vote_health", None)
+            elif has_vh:
+                tpl["vote_health"] = self._vote_health_template(ckpt_ve)
+            tries = [tpl]
+            if has_vh is None:
+                # no manifest meta (--ckpt_integrity false / legacy dir):
+                # the checkpoint's vote_health presence is unknown, so a
+                # telemetry-flag toggle between save and resume would brick
+                # the first template — also try the opposite shape
+                alt = dict(tpl)
+                if "vote_health" in alt:
+                    alt.pop("vote_health")
+                else:
+                    alt["vote_health"] = self._vote_health_template(ckpt_ve)
+                tries.append(alt)
+            # pre-resilience checkpoints lack the world/batches_consumed/
+            # vote_health keys entirely; the legacy payload shape last
+            tries.append({"params": self.params,
+                          "opt_state": self._pack_state_rng(self.state),
+                          "step": np.asarray(self.step_count, np.int64)})
+            restored = None
+            for i, t in enumerate(tries):
+                try:
+                    restored = self.checkpointer.restore(step, t)
+                    break
+                except Exception:
+                    if i == len(tries) - 1:
+                        raise
+            self.params = restored["params"]
+            self.state = self._unpack_state_rng(restored["opt_state"])
+            if ("vote_health" in restored and self._telemetry_on
+                    and ckpt_ve == (self.cfg.vote_every or 1)):
+                # adopt the accumulator only when its packing still matches
+                # this run (vote_every sizes prev_elected); otherwise the
+                # telemetry window restarts fresh
+                self.vote_health = restored["vote_health"]
+        else:
+            restored = self.checkpointer.restore(
+                step, self._elastic_template(ckpt_world, meta))
+            self.params = jax.tree.map(
+                lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
+                restored["params"], self.param_specs)
+            st = self._unpack_state_rng(restored["opt_state"])
+            st = st._replace(
+                exp_avg=remap_worker_momentum(st.exp_avg, ckpt_world,
+                                              self.world))
+            self.state = jax.device_put(
+                st,
+                LionState(
+                    count=NamedSharding(self.mesh, P()),
+                    exp_avg=jax.tree.map(
+                        lambda s: NamedSharding(self.mesh, s),
+                        self._exp_avg_specs),
+                    rng=(None if st.rng is None
+                         else NamedSharding(self.mesh, P())),
+                    elected=(None if st.elected is None
+                             else NamedSharding(self.mesh, P())),
+                ),
+            )
+            # the accumulator's normalizations reference the old world; a
+            # fresh window is honest, stale continuity is not
+            print(f"[trainer] elastic resume: remapped [{ckpt_world}, ...] "
+                  f"momenta to [{self.world}, ...] "
+                  f"({'group mean' if ckpt_world > self.world else 'replicate'}"
+                  f" policy, cross-worker mean preserved)")
+        self.step_count = int(restored["step"])
+        self._resume_skip_batches = int(
+            restored.get("batches_consumed", restored["step"]))
 
     def _maybe_resume(self) -> None:
         if not (self.checkpointer and self.cfg.resume_from_checkpoint):
             return
-        last = self.checkpointer.latest_step()
-        if last is None:
+        # verified autodetect, newest GOOD first: a torn leaf / corrupted
+        # manifest / uncommitted save falls back one save interval instead
+        # of poisoning the run (or killing the resume outright)
+        candidates = (self.checkpointer.valid_steps()
+                      if self.cfg.ckpt_integrity else
+                      [s for s in [self.checkpointer.latest_step()]
+                       if s is not None])
+        for step in candidates:
+            meta = (self.checkpointer.manifest_meta(step)
+                    if self.cfg.ckpt_integrity else None) or {}
+            ckpt_world = int(meta.get("world", self.world))
+            if ckpt_world != self.world:
+                # a mismatched world is an operator decision, not a bad
+                # checkpoint — never silently fall back past it
+                if not self.cfg.elastic_resume:
+                    raise ValueError(
+                        f"checkpoint step {step} holds momenta for world="
+                        f"{ckpt_world} but this mesh has world="
+                        f"{self.world}; pass --elastic_resume to remap "
+                        "them (or match the chip count)")
+                if not self.cfg.lion:
+                    raise NotImplementedError(
+                        "--elastic_resume remaps the stacked per-worker "
+                        "Lion momenta; the AdamW/ZeRO-1 states have no "
+                        "defined remap")
+            try:
+                self._restore_step(step, meta, ckpt_world)
+            except Exception as e:
+                print(f"[trainer] checkpoint step {step} failed to restore "
+                      f"({e}); falling back to the previous good checkpoint")
+                continue
+            purged = self.checkpointer.purge_steps_after(step)
+            if purged:
+                print(f"[trainer] purged stale newer checkpoints {purged}: "
+                      "left on disk they make Orbax silently drop every "
+                      "post-resume save below them (the deterministic "
+                      "replay re-creates them bit-identically)")
+            print(f"[trainer] resumed from checkpoint step {step}")
             return
-        restored = self.checkpointer.restore(last, self._payload())
-        self.params = restored["params"]
-        self.state = restored["opt_state"]
-        self.step_count = int(restored["step"])
-        # one batch per step: the step counter doubles as the data-iterator
-        # position (consumed by train() to fast-forward the iterator)
-        self._resume_skip_batches = self.step_count
-        print(f"[trainer] resumed from checkpoint step {last}")
+        if candidates:
+            # every verified checkpoint failed to restore — that's a
+            # structural mismatch (model/optimizer config changed), not a
+            # bad checkpoint. Restarting from step 0 underneath them would
+            # also be unsaveable (Orbax drops saves below existing steps).
+            raise RuntimeError(
+                f"resume_from_checkpoint: all {len(candidates)} verified "
+                f"checkpoint(s) (steps {candidates}) failed to restore "
+                "into this run's state structure — likely a model/optimizer"
+                " config change since they were written. Refusing to "
+                "silently restart from step 0; pass --resume_from_checkpoint"
+                " false (or point --output_dir elsewhere) to start fresh")
 
     def close(self) -> None:
         self.profiler.close()
+        if self._preempt_guard is not None:
+            self._preempt_guard.close()
         if self.checkpointer:
             self.checkpointer.close()
         self.logger.close()

@@ -1,0 +1,291 @@
+"""Resilience subsystem: preemption drain + fault injection.
+
+Distributed Lion's whole optimizer state is the stacked ``[world, ...]``
+per-worker momentum pytree — losing it or tearing it silently changes every
+future election, so durability is a correctness feature here, not an ops
+nicety. This module holds the pieces that are about *surviving the
+environment* rather than writing bytes (that's ``train/checkpoint.py``):
+
+- :class:`PreemptionGuard` — a SIGTERM/maintenance handler that sets a flag
+  the Trainer checks once per dispatch. On trip the loop drains the
+  in-flight async save, writes an emergency checkpoint tagged ``preempt``,
+  and returns cleanly so the process exits 0 and the watcher
+  (scripts/tpu_watch_loop.sh) restarts it into a normal resume.
+- A **fault-injection registry** consumed by ``train/checkpoint.py``'s save
+  pipeline, so tests (tests/test_resilience.py) and the runbook's
+  resilience stage can simulate a crash mid-save, a slow serializer, or
+  flaky save I/O *inside* the real code path instead of monkeypatching it.
+- File-corruption helpers (:func:`tear_leaf_file`, :func:`corrupt_manifest`)
+  that damage a committed checkpoint the way real incidents do — a torn
+  write, a bit-flipped manifest — for the recovery matrix.
+
+The elastic world-size remap itself lives with the optimizer
+(``optim.distributed_lion.remap_worker_momentum``) because its semantics are
+a statement about the vote distribution; the Trainer's resume path drives it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import signal
+import threading
+from typing import Any, Iterable, Optional
+
+# --------------------------------------------------------------------------
+# Fault injection
+# --------------------------------------------------------------------------
+# A process-global name -> value registry. checkpoint.py consults it at the
+# few points where real failures strike (the serializer call, the commit
+# thread); everything else — torn files, corrupt manifests — is injected by
+# mutating the on-disk checkpoint post-commit with the helpers below.
+# Supported names (value semantics in parentheses):
+#   ckpt_save_raise      (int: fail the first N manager.save calls)
+#   ckpt_crash_before_manifest (bool: commit dies before the manifest lands)
+#   ckpt_crash_before_marker   (bool: manifest lands, commit marker doesn't)
+#   ckpt_slow_commit     (float: seconds the commit thread stalls, i.e. a
+#                         slow serialize/write — what async saving must hide)
+_FAULTS: dict[str, Any] = {}
+_FAULTS_LOCK = threading.Lock()
+
+
+def inject_fault(name: str, value: Any = True) -> None:
+    with _FAULTS_LOCK:
+        _FAULTS[name] = value
+
+
+def clear_faults() -> None:
+    with _FAULTS_LOCK:
+        _FAULTS.clear()
+
+
+def fault(name: str, default: Any = None) -> Any:
+    with _FAULTS_LOCK:
+        return _FAULTS.get(name, default)
+
+
+def consume_fault_count(name: str) -> bool:
+    """Decrement a counted fault; True while it still has charges. Lets a
+    test say 'the first two save attempts fail' and have the retry loop
+    observe exactly that."""
+    with _FAULTS_LOCK:
+        n = _FAULTS.get(name, 0)
+        if isinstance(n, bool):
+            return n
+        if n and n > 0:
+            _FAULTS[name] = n - 1
+            return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# Manifest verification (pure stdlib — importable by scripts/check_evidence
+# without dragging jax/orbax in; train/checkpoint.py writes these artifacts
+# and re-exports the readers)
+# --------------------------------------------------------------------------
+
+MANIFEST = "manifest.json"
+MARKER = "COMMITTED"
+# root-level stamp: "steps in this directory are committed with manifests".
+# Its presence flips the no-marker interpretation from 'legacy checkpoint,
+# assume good' to 'commit never finished, reject' — without it a crash
+# before the first manifest would masquerade as a legacy checkpoint.
+MANIFESTS_STAMP = "MANIFESTS_ENABLED"
+MANIFEST_FORMAT = 1
+
+
+def sha256_file(path: pathlib.Path | str, chunk: int = 1 << 20) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def read_manifest(sdir: pathlib.Path | str) -> Optional[dict]:
+    """The manifest of a COMMITTED step, after checking it against the
+    marker's recorded digest (cheap — no data-file hashing). None when the
+    step is uncommitted or its manifest doesn't match the marker."""
+    import hashlib
+
+    sdir = pathlib.Path(sdir)
+    marker = read_json(sdir / MARKER)
+    if not marker:
+        return None
+    try:
+        raw = (sdir / MANIFEST).read_bytes()
+    except OSError:
+        return None
+    if hashlib.sha256(raw).hexdigest() != marker.get("manifest_sha256"):
+        return None
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        return None
+
+
+def verify_step_dir(sdir: pathlib.Path | str) -> bool:
+    """Full integrity check of one committed step: marker → manifest digest
+    → every data file present with matching size and sha256."""
+    sdir = pathlib.Path(sdir)
+    manifest = read_manifest(sdir)
+    if manifest is None:
+        return False
+    for rel, info in manifest.get("files", {}).items():
+        p = sdir / rel
+        try:
+            if p.stat().st_size != info["bytes"]:
+                return False
+            if sha256_file(p) != info["sha256"]:
+                return False
+        except OSError:
+            return False
+    return True
+
+
+def latest_valid_step_in(directory: str | os.PathLike) -> Optional[int]:
+    """Standalone verified autodetect over a checkpoint root (no
+    CheckpointManager needed — scripts/check_evidence.py's resilience stage
+    runs this). Mirrors ``Checkpointer.latest_valid_step``: newest GOOD
+    step wins; marker-less steps are valid only in pre-manifest (unstamped)
+    directories."""
+    root = pathlib.Path(directory)
+    try:
+        steps = sorted((int(p.name) for p in root.iterdir()
+                        if p.is_dir() and p.name.isdigit()), reverse=True)
+    except OSError:
+        return None
+    stamped = (root / MANIFESTS_STAMP).exists()
+    for s in steps:
+        sdir = root / str(s)
+        if verify_step_dir(sdir):
+            return s
+        if not stamped and read_json(sdir / MARKER) is None:
+            return s  # legacy pre-manifest checkpoint: assumed good
+    return None
+
+
+# --------------------------------------------------------------------------
+# Checkpoint corruption helpers (the recovery matrix's torn/corrupt legs)
+# --------------------------------------------------------------------------
+
+def step_dir(directory: str | os.PathLike, step: int) -> pathlib.Path:
+    """The Orbax step directory for ``step`` under a checkpoint root."""
+    return pathlib.Path(directory) / str(step)
+
+
+def tear_leaf_file(directory: str | os.PathLike, step: int) -> pathlib.Path:
+    """Truncate the largest data file of a committed checkpoint in place —
+    the classic torn write (process/node died mid-flush, filesystem kept
+    the prefix). Returns the torn path. The manifest's digest for that
+    file no longer matches, so verification must reject the step."""
+    sdir = step_dir(directory, step)
+    candidates = [
+        p for p in sdir.rglob("*") if p.is_file()
+        and p.name not in (MANIFEST, MARKER)
+        and p.stat().st_size > 0
+    ]
+    if not candidates:
+        raise FileNotFoundError(f"no data files under {sdir}")
+    victim = max(candidates, key=lambda p: p.stat().st_size)
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.truncate(max(size // 2, 1) - 1 if size > 1 else 0)
+    return victim
+
+
+def corrupt_manifest(directory: str | os.PathLike, step: int) -> pathlib.Path:
+    """Flip bytes inside a committed checkpoint's manifest. The commit
+    marker records the manifest's own digest, so verification must reject
+    the step without even re-hashing the data files."""
+    path = step_dir(directory, step) / MANIFEST
+    raw = bytearray(path.read_bytes())
+    if not raw:
+        raise OSError(f"empty manifest at {path}")
+    mid = len(raw) // 2
+    raw[mid] = raw[mid] ^ 0xFF
+    path.write_bytes(bytes(raw))
+    return path
+
+
+def delete_commit_marker(directory: str | os.PathLike, step: int) -> None:
+    """Simulate a crash between the manifest write and the commit marker:
+    the checkpoint's bytes are all present but it was never committed."""
+    (step_dir(directory, step) / MARKER).unlink()
+
+
+# --------------------------------------------------------------------------
+# Preemption
+# --------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """Signal-driven preemption flag, checked once per train dispatch.
+
+    Installs handlers for ``signals`` (default SIGTERM — what TPU
+    maintenance events and the watcher's ``timeout`` deliver) that only set
+    a :class:`threading.Event`; all actual work (draining the in-flight
+    save, writing the ``preempt``-tagged checkpoint) happens on the train
+    loop's thread at the next dispatch boundary, where the program state is
+    consistent. Off the main thread (bench harnesses drive Trainers from
+    worker threads) signal installation is impossible; the guard degrades
+    to a manually-triggerable flag (:meth:`trigger`) instead of failing.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._flag = threading.Event()
+        self._prev: dict[int, Any] = {}
+        for sig in signals:
+            try:
+                self._prev[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:  # not the main thread
+                pass
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._flag.is_set():
+            # second delivery: the loop never reached a dispatch boundary
+            # (hung collective, wedged step) — stop absorbing the signal.
+            # Restore the previous disposition and re-deliver so `timeout`
+            # and operators can still kill a stuck process with TERM.
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        # first delivery, async-signal-safe: set the flag, nothing else
+        self._flag.set()
+
+    def trigger(self) -> None:
+        """Programmatic preemption (tests; cluster agents that learn of
+        maintenance through an API rather than a signal)."""
+        self._flag.set()
+
+    def should_stop(self) -> bool:
+        return self._flag.is_set()
+
+    def close(self) -> None:
+        """Restore the previous handlers (Trainers are created and torn
+        down many times per test process)."""
+        for sig, prev in self._prev.items():
+            try:
+                if signal.getsignal(sig) == self._on_signal:
+                    signal.signal(sig, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+
+
+# --------------------------------------------------------------------------
+# Small shared utilities
+# --------------------------------------------------------------------------
+
+def read_json(path: str | os.PathLike) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None

@@ -161,3 +161,39 @@ def test_auto_picks_tuned_flash_at_swept_flagship_shape(monkeypatch):
     q, k, v = _qkv(T=1024)
     A.attention(q, k, v, impl="auto")
     assert calls[-1] == "xla"  # no TPU: never the pallas kernel
+
+
+def test_auto_bwd_only_tiles_dispatch(monkeypatch):
+    """ISSUE 3 satellite: `auto` with ONLY backward tiles pinned must
+    dispatch to flash on TPU (honoring the tiles), and off TPU must degrade
+    to xla with the flash-only knobs dropped — never fall into the
+    explicit-impl flash-knob ValueError (that guard is for explicit
+    xla/splash requests that would silently tune nothing)."""
+    from distributed_lion_tpu.ops import attention as A
+
+    calls = []
+
+    def fake_flash(q, k, v, *, causal=True, block_q=0, block_kv=0,
+                   block_q_bwd=0, block_kv_bwd=0):
+        calls.append((block_q, block_kv, block_q_bwd, block_kv_bwd))
+        return q
+
+    def fake_xla(q, k, v, *, causal=True, score_dtype=None):
+        calls.append("xla")
+        return q
+
+    monkeypatch.setattr(A, "attention_flash", fake_flash)
+    monkeypatch.setattr(A, "attention_xla", fake_xla)
+
+    q, k, v = _qkv(T=512)
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    A.attention(q, k, v, impl="auto", block_q_bwd=256, block_kv_bwd=512)
+    assert calls[-1] == (0, 0, 256, 512)  # bwd-only pins reach flash intact
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "cpu")
+    A.attention(q, k, v, impl="auto", block_q_bwd=256, block_kv_bwd=512)
+    assert calls[-1] == "xla"  # degrades like bare auto, no ValueError
+
+    # the explicit-impl guard stays loud
+    with pytest.raises(ValueError, match="flash-kernel knob"):
+        A.attention(q, k, v, impl="xla", block_q_bwd=256)
