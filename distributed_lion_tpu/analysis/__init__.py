@@ -1,0 +1,46 @@
+"""graft-check: JAX-aware static analysis for the vote framework.
+
+The vote IS the compiled program (ARCHITECTURE "The train step"), so the
+most dangerous bugs here are the ones runtime telemetry only sees after a
+chip run: a host sync slipped into the jitted step, a collective that
+doesn't match the wire recipe, a typed PRNG key that silently fails to
+serialize (the exact latent bug the resilience PR had to fix), an
+unexpected retrace that doubles step time. This package verifies those
+contracts BEFORE a single step runs, in two tiers:
+
+- **Tier 1 — source lint** (:mod:`analysis.lint`): pure-stdlib ``ast``
+  rules codifying pitfalls this repo has already paid for (host syncs and
+  nondeterminism in traced scope, raw PRNG keys reaching serialization,
+  hardcoded mesh-axis literals, swallowed exceptions, non-strict JSON,
+  mutable defaults). No jax import — scripts (check_evidence, ci_static)
+  load ``lint.py`` by file path and run it on boxes without an
+  accelerator toolchain, like ``train/resilience.py``'s manifest readers.
+- **Tier 2 — program contract check** (:mod:`analysis.trace_check`):
+  walk the jaxpr of the ACTUAL compiled train step (one abstract trace
+  per config, the ``telemetry.measure_step_wire`` pattern) and assert the
+  collective-primitive inventory exactly matches the wire recipe's
+  expected set — the static counterpart of the ``comm_drift_bytes``
+  runtime metric — plus zero host callbacks, donation actually applied,
+  and no f32 upcast of bf16 param leaves.
+
+The runtime third leg — the retrace guard that hashes the step's abstract
+signature at first dispatch — lives in ``train/loop.py``
+(``--retrace_guard``); this package is everything that runs before
+dispatch.
+
+CLI (exit 0 = clean, 1 = findings, 2 = usage error)::
+
+    python -m distributed_lion_tpu.analysis            # tier 1 over the package
+    python -m distributed_lion_tpu.analysis --tier2    # jaxpr contract check
+
+This ``__init__`` deliberately imports nothing heavy: tier 1 stays
+importable everywhere, tier 2 is imported lazily by ``__main__``.
+"""
+
+from distributed_lion_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,134 @@
+"""graft-check CLI.
+
+Tier 1 (default — pure stdlib, no accelerator needed)::
+
+    python -m distributed_lion_tpu.analysis [paths...]
+
+Lints the package (or the given files/dirs) with :mod:`analysis.lint`.
+Exit 0 = clean, 1 = findings, 2 = usage error. (On a box without jax, run
+``python distributed_lion_tpu/analysis/lint.py`` instead — same linter,
+no package import.)
+
+Tier 2 (jaxpr contract check — needs jax; honors ``DLION_PLATFORM``)::
+
+    python -m distributed_lion_tpu.analysis --tier2 \
+        [--json-out FILE] [--wires sign_psum,packed_a2a,...] \
+        [--vote-buckets 1,4]
+
+Builds the real train step (a small GPT-2 Trainer on a data mesh over all
+local devices) for every wire × vote_buckets cell and asserts the
+collective inventory matches the wire recipe, zero host callbacks,
+donation applied, and no bf16-param f32 upcasts
+(:func:`analysis.trace_check.check_trainer`). ``--json-out`` writes the
+report the runbook's static stage captures for
+``scripts/check_evidence.py static``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _tier1(paths: list[str]) -> int:
+    # one implementation of target resolution / printing / exit codes:
+    # lint.main is also the `python .../lint.py` file-path entry point
+    from distributed_lion_tpu.analysis import lint
+
+    return lint.main(paths)
+
+
+def _default_wires(world: int) -> list[str]:
+    wires = ["sign_psum", "packed_allgather", "packed_a2a"]
+    hier_g = next((g for g in (4, 2) if world % g == 0 and world > g), None)
+    wires.append(f"hier:{hier_g}" if hier_g else f"hier:{world}")
+    return wires
+
+
+def _tier2(wires: list[str], buckets: list[int],
+           json_out: str | None) -> int:
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
+
+    force_cpu_platform()  # honor DLION_PLATFORM before first device use
+    import jax
+    import numpy as np
+
+    from distributed_lion_tpu.analysis import trace_check
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+    from distributed_lion_tpu.train.loop import TrainConfig, Trainer
+
+    mesh = make_mesh()
+    world = mesh.shape["data"]
+    if not wires:
+        wires = _default_wires(world)
+    model_cfg = GPT2Config.tiny(vocab_size=512, n_layer=2, n_head=4,
+                                d_model=128, n_ctx=64)
+    reports = []
+    for wire in wires:
+        for vb in buckets:
+            cfg = TrainConfig(
+                lion=True, async_grad=True, wire=wire, vote_every=1,
+                vote_buckets=vb, per_device_train_batch_size=1,
+                gradient_accumulation_steps=1, block_size=32,
+                output_dir=None)
+            tr = Trainer.for_gpt2(cfg, mesh, model_cfg)
+            batch = np.zeros((tr.global_train_batch(), cfg.block_size),
+                             np.int32)
+            rep = trace_check.check_trainer(tr, batch)
+            tr.close()
+            reports.append(rep)
+            verdict = "ok" if rep["ok"] else "CONTRACT VIOLATION"
+            print(f"graft-check tier2: wire={wire} vote_buckets={vb} "
+                  f"world={world}: {verdict} "
+                  f"(collectives {len(rep['observed'])}, scalar reductions "
+                  f"{rep['scalar_reductions']}, callbacks "
+                  f"{len(rep['host_callbacks'])}, aliased outputs "
+                  f"{rep['donation']['aliased_outputs']})")
+            if not rep["ok"]:
+                print(f"  expected: {rep['expected']}")
+                print(f"  observed: {rep['observed']}")
+                if rep["host_callbacks"]:
+                    print(f"  host callbacks: {rep['host_callbacks']}")
+                if rep["param_upcasts"]:
+                    print(f"  bf16 param upcasts: {rep['param_upcasts']}")
+    ok = all(r["ok"] for r in reports)
+    if json_out:
+        out = {"ok": ok, "world": world, "jax": jax.__version__,
+               "backend": jax.default_backend(), "configs": reports}
+        with open(json_out, "w") as f:
+            json.dump(out, f, indent=1, allow_nan=False)
+            f.write("\n")
+        print(f"graft-check tier2: report written to {json_out}")
+    print(f"graft-check tier2: {'PASS' if ok else 'FAIL'} "
+          f"({len(reports)} configs)")
+    return 0 if ok else 1
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_lion_tpu.analysis",
+        description="graft-check: JAX-aware static analysis "
+                    "(tier 1 AST lint / tier 2 jaxpr contract)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--tier2", action="store_true",
+                    help="run the jaxpr contract check instead of the lint")
+    ap.add_argument("--wires", default="",
+                    help="comma-separated wires for --tier2 "
+                         "(default: all four for this device count)")
+    ap.add_argument("--vote-buckets", default="1,4",
+                    help="comma-separated bucket counts for --tier2")
+    ap.add_argument("--json-out", default=None,
+                    help="write the --tier2 report to this JSON file")
+    args = ap.parse_args(argv)
+    if not args.tier2:
+        return _tier1(args.paths)
+    wires = [w for w in args.wires.split(",") if w]
+    buckets = [int(b) for b in args.vote_buckets.split(",") if b]
+    return _tier2(wires, buckets, args.json_out)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

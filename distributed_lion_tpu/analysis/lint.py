@@ -1,0 +1,517 @@
+"""graft-check tier 1: pure-stdlib AST lint for JAX/TPU pitfalls.
+
+Every rule codifies a failure mode this repo has already paid for (or
+refused to pay for twice). The linter is deliberately structural, not a
+dataflow engine: *traced scope* is what it can prove syntactically — a
+function decorated with (or wrapped in) ``jax.jit`` / ``jax.shard_map`` /
+``partial(jax.shard_map, ...)``, a function passed by name to a tracing
+higher-order function (``lax.scan``, ``jax.vmap``, ``jax.grad``, ...), or
+any function nested inside one. Helpers *called from* traced scope across
+module boundaries are the jaxpr tier's job (:mod:`analysis.trace_check`
+sees the whole compiled program); this tier catches the mistake at the
+line where it is written.
+
+Rules (the table ARCHITECTURE.md "Static analysis" renders):
+
+==========  ================================================================
+DLT001      host-sync call in traced scope: ``float()``/``int()``/``bool()``
+            on a traced value, ``.item()``/``.tolist()``/
+            ``.block_until_ready()``, ``np.asarray``/``np.array``,
+            ``jax.device_get`` — each forces a device→host transfer and a
+            pipeline stall inside the compiled step (or a tracer error at
+            run time, which is the lucky case).
+DLT002      nondeterminism in traced scope: ``time.time()``, ``random.*``,
+            ``np.random.*``, ``datetime.now()``, ``os.urandom``, ``uuid.*``
+            — traced once, the "random" value is baked into the compiled
+            program as a constant and silently identical every step.
+DLT003      host callback in traced scope: ``print``, ``jax.debug.print``/
+            ``jax.debug.callback``, ``pure_callback``, ``io_callback`` —
+            the compiled-step contract here is ZERO host callbacks (the
+            jaxpr tier asserts it on the real program; this rule names the
+            offending line).
+DLT004      raw PRNG key reaching serialization: a ``save``-like call whose
+            payload mentions an ``rng`` leaf in a function with no
+            ``key_data``/``pack_state_rng`` shim. Typed PRNG keys are not
+            serializable — stochastic-mode checkpoints simply FAILED to
+            save until the resilience PR added the pack/unpack shim
+            (train/loop._pack_state_rng); this rule pins the lesson.
+DLT005      hardcoded mesh-axis-name string literal (``"data"`` /
+            ``"tensor"`` / ``"seq"`` / ``"pipe"`` / ``"expert"``) used as a
+            call argument or parameter default outside ``parallel/mesh.py``
+            — the axis-name constants exist so a mesh rename is one edit,
+            not a grep-and-pray.
+DLT006      swallowed exception: a broad ``except Exception:`` (or bare
+            ``except:``) whose body neither raises, calls, nor assigns —
+            the failure vanishes. Finalizers (``__del__``) are exempt (they
+            must not raise). Committer-thread and save-I/O paths are where
+            this has actually bitten (train/checkpoint.py).
+DLT007      non-strict ``json.dump``/``dumps``: without ``allow_nan=False``
+            a single NaN emits the bare token ``NaN`` — not JSON — and
+            corrupts the line for every strict consumer (the MetricsLogger
+            bug validate_metrics.py now guards).
+DLT008      mutable default argument (``def f(x, acc=[])``): the default is
+            created once and shared across calls — a classic aliasing bug,
+            and in config dataclass helpers a cross-run state leak.
+==========  ================================================================
+
+Suppression syntax (both forms take a comma-separated rule list):
+
+- line:  ``some_call()  # graft: disable=DLT004`` — suppresses on that line
+  (use sparingly, with a justification in the surrounding comment);
+- file:  a comment line ``# graft: disable-file=DLT005`` anywhere in the
+  file suppresses the rule for the whole file.
+
+This module imports ONLY the stdlib and has no package-relative imports,
+so dependency-light scripts (scripts/check_evidence.py, scripts/
+ci_static.sh) load it by file path and run it without jax installed. It is
+also directly runnable: ``python distributed_lion_tpu/analysis/lint.py
+[paths...]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import pathlib
+import re
+import sys
+import tokenize
+from typing import Iterable, Optional
+
+MESH_AXES = ("data", "tensor", "seq", "pipe", "expert")
+MESH_MODULE_SUFFIX = "parallel/mesh.py"
+
+# function/decorator names that put their function argument under a jax
+# trace; terminal-name match so jax.jit / lax.scan / plain jit all hit
+TRACE_WRAPPERS = frozenset({
+    "jit", "shard_map", "pmap", "vmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "custom_jvp", "custom_vjp",
+})
+TRACE_HOFS = TRACE_WRAPPERS | frozenset({
+    "scan", "cond", "while_loop", "fori_loop", "switch", "associative_scan",
+    "make_jaxpr", "eval_shape",
+})
+
+RULES = {
+    "DLT001": "host-sync call inside traced scope",
+    "DLT002": "nondeterministic host call inside traced scope",
+    "DLT003": "host callback inside traced scope",
+    "DLT004": "raw PRNG key reaching serialization without a pack shim",
+    "DLT005": "hardcoded mesh-axis-name string literal outside parallel/mesh",
+    "DLT006": "swallowed exception (broad except with an inert body)",
+    "DLT007": "json.dump/dumps without allow_nan=False",
+    "DLT008": "mutable default argument",
+}
+
+_DISABLE_LINE = re.compile(r"#\s*graft:\s*disable=([A-Z0-9,\s]+)")
+_DISABLE_FILE = re.compile(r"#\s*graft:\s*disable-file=([A-Z0-9,\s]+)")
+
+
+class Finding:
+    """One lint finding. A plain class (not a dataclass/NamedTuple) on
+    purpose: this module is loaded by FILE PATH from jax-less scripts, and
+    the annotation-resolving class machineries require a sys.modules entry
+    that path-loading doesn't guarantee."""
+
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def __repr__(self) -> str:
+        return str(self)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Finding) and str(self) == str(other)
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# --------------------------------------------------------------------- helpers
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain (jax.lax.scan →
+    'scan'), or None for anything else."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted path of a Name/Attribute chain ('jax.debug.print');
+    non-name links render as '?'."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "?")
+    return ".".join(reversed(parts))
+
+
+def _mentions_name(tree: ast.AST, names: Iterable[str]) -> bool:
+    needles = tuple(names)
+    for node in ast.walk(tree):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident and any(n in ident for n in needles):
+            return True
+    return False
+
+
+def _is_traced_decorator(dec: ast.AST) -> bool:
+    """True when a decorator expression mentions a trace wrapper anywhere —
+    covers @jax.jit, @jit, @partial(jax.shard_map, mesh=...), nested
+    partials, and jax.jit(f, donate_argnums=...) used as a decorator."""
+    for node in ast.walk(dec):
+        if _terminal_name(node) in TRACE_WRAPPERS:
+            return True
+    return False
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class _Suppressions:
+    def __init__(self, src: str):
+        self.by_line: dict[int, set] = {}
+        self.file_wide: set = set()
+        # only COMMENT tokens count: regex over raw source lines would also
+        # match suppression syntax quoted inside strings/docstrings (e.g. a
+        # module documenting the syntax would silently disable rules on
+        # itself — this very docstring included)
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            return  # unparseable source: DLT000 already reports it
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_FILE.search(tok.string)
+            if m:
+                self.file_wide |= {r.strip() for r in m.group(1).split(",")}
+                continue
+            m = _DISABLE_LINE.search(tok.string)
+            if m:
+                self.by_line[tok.start[0]] = {
+                    r.strip() for r in m.group(1).split(",")}
+
+    def active(self, rule: str, line: int) -> bool:
+        return rule in self.file_wide or rule in self.by_line.get(line, set())
+
+
+# ----------------------------------------------------------------- the linter
+class _Linter(ast.NodeVisitor):
+    def __init__(self, tree: ast.Module, path: str, src: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self.suppress = _Suppressions(src)
+        self.in_mesh_module = path.replace("\\", "/").endswith(
+            MESH_MODULE_SUFFIX)
+        self._func_stack: list[ast.AST] = []
+        self._traced_depth = 0
+        # pre-pass: names passed as function args to tracing HOFs anywhere in
+        # the module mark those functions traced (lax.scan(body, ...),
+        # jax.jit(step), shard_map(f, mesh=...)); lambdas in that position
+        # are marked by node identity
+        self._hof_traced_names: set = set()
+        self._hof_traced_nodes: set = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) not in TRACE_HOFS:
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self._hof_traced_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    self._hof_traced_nodes.add(id(arg))
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if self.suppress.active(rule, line):
+            return
+        self.findings.append(Finding(rule, self.path, line,
+                                     getattr(node, "col_offset", 0), message))
+
+    # ------------------------------------------------------- scope tracking
+    def _function_is_traced(self, node) -> bool:
+        if self._traced_depth:  # nested inside a traced function
+            return True
+        if isinstance(node, _FUNC_NODES):
+            if any(_is_traced_decorator(d) for d in node.decorator_list):
+                return True
+            if node.name in self._hof_traced_names:
+                return True
+        if id(node) in self._hof_traced_nodes:
+            return True
+        return False
+
+    def _visit_function(self, node) -> None:
+        if isinstance(node, _FUNC_NODES):
+            self._check_mutable_defaults(node)
+            self._check_axis_literal_defaults(node)
+        traced = self._function_is_traced(node)
+        self._func_stack.append(node)
+        self._traced_depth += traced
+        self.generic_visit(node)
+        self._traced_depth -= traced
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+    visit_Lambda = _visit_function
+
+    # ------------------------------------------------------------ rule bodies
+    def _check_mutable_defaults(self, node) -> None:
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None]
+        for d in defaults:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                     ast.DictComp, ast.SetComp)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set", "bytearray"))
+            if mutable:
+                self.emit("DLT008", d,
+                          f"mutable default in {node.name}() is created once "
+                          "and shared across calls; default to None and "
+                          "build inside the body")
+
+    def _check_axis_literal_defaults(self, node) -> None:
+        if self.in_mesh_module:
+            return
+        for d in list(node.args.defaults) + [x for x in node.args.kw_defaults
+                                             if x is not None]:
+            if isinstance(d, ast.Constant) and d.value in MESH_AXES:
+                self.emit("DLT005", d,
+                          f"axis name {d.value!r} hardcoded as a parameter "
+                          "default; use the parallel.mesh axis constants")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._traced_depth:
+            self._check_traced_call(node)
+        self._check_prng_serialization(node)
+        self._check_json_dump(node)
+        if not self.in_mesh_module:
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and arg.value in MESH_AXES:
+                    self.emit("DLT005", arg,
+                              f"axis name {arg.value!r} hardcoded in a call "
+                              "argument; use the parallel.mesh axis "
+                              "constants")
+        self.generic_visit(node)
+
+    def _check_traced_call(self, node: ast.Call) -> None:
+        func = node.func
+        name = _terminal_name(func)
+        dotted = _dotted(func) if name else ""
+        # DLT001 — host syncs
+        if (isinstance(func, ast.Name) and func.id in ("float", "int", "bool")
+                and node.args
+                and not all(isinstance(a, ast.Constant) for a in node.args)):
+            self.emit("DLT001", node,
+                      f"{func.id}() on a traced value forces a host sync "
+                      "(or a tracer error) inside the compiled step")
+        elif isinstance(func, ast.Attribute) and func.attr in (
+                "item", "tolist", "block_until_ready"):
+            self.emit("DLT001", node,
+                      f".{func.attr}() inside traced scope forces a "
+                      "device→host transfer")
+        elif dotted in ("np.asarray", "np.array", "numpy.asarray",
+                        "numpy.array", "jax.device_get"):
+            self.emit("DLT001", node,
+                      f"{dotted}() materializes a traced value on the host")
+        # DLT002 — nondeterminism baked in at trace time
+        elif dotted in ("time.time", "time.monotonic", "time.perf_counter",
+                        "time.time_ns", "os.urandom", "uuid.uuid4",
+                        "uuid.uuid1"):
+            self.emit("DLT002", node,
+                      f"{dotted}() is evaluated ONCE at trace time and baked "
+                      "into the compiled step as a constant")
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)
+              and func.value.id == "random"):
+            self.emit("DLT002", node,
+                      f"stdlib random.{func.attr}() in traced scope: traced "
+                      "once, constant every step — use jax.random with a "
+                      "threaded key")
+        elif dotted.startswith(("np.random.", "numpy.random.")):
+            self.emit("DLT002", node,
+                      f"{dotted}() in traced scope: host RNG is baked in at "
+                      "trace time — use jax.random")
+        elif isinstance(func, ast.Attribute) and func.attr in (
+                "now", "utcnow") and _terminal_name(func.value) in (
+                "datetime", "date"):
+            self.emit("DLT002", node,
+                      f"{dotted}() is trace-time constant inside the "
+                      "compiled step")
+        # DLT003 — host callbacks
+        elif isinstance(func, ast.Name) and func.id == "print":
+            self.emit("DLT003", node,
+                      "print() in traced scope runs at TRACE time only (and "
+                      "never per step); the compiled-step contract here is "
+                      "zero host callbacks")
+        elif name in ("pure_callback", "io_callback", "debug_callback") or (
+                dotted in ("jax.debug.print", "jax.debug.callback",
+                           "debug.print", "debug.callback")):
+            self.emit("DLT003", node,
+                      f"{dotted or name} injects a host callback into the "
+                      "compiled step (the step contract is zero host "
+                      "callbacks; see analysis.trace_check)")
+
+    def _check_prng_serialization(self, node: ast.Call) -> None:
+        if _terminal_name(node.func) not in (
+                "save", "StandardSave", "savez", "savez_compressed"):
+            return
+        payload = list(node.args) + [k.value for k in node.keywords]
+        rng_mention = None
+        for arg in payload:
+            for sub in ast.walk(arg):
+                ident = None
+                if isinstance(sub, ast.Name):
+                    ident = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    ident = sub.attr
+                elif isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                                  str):
+                    ident = sub.value
+                if ident and "rng" in ident.lower():
+                    rng_mention = sub
+                    break
+            if rng_mention is not None:
+                break
+        if rng_mention is None:
+            return
+        scope = self._func_stack[-1] if self._func_stack else None
+        shims = ("key_data", "pack_state_rng", "_pack_state")
+        if scope is not None and _mentions_name(scope, shims):
+            return
+        self.emit("DLT004", node,
+                  "an 'rng' leaf reaches a save call with no key_data/"
+                  "pack_state_rng shim in scope: typed PRNG keys are not "
+                  "serializable — the save fails (or silently drops the "
+                  "key) at run time")
+
+    def _check_json_dump(self, node: ast.Call) -> None:
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in ("dump", "dumps")
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "json"):
+            return
+        for kw in node.keywords:
+            if kw.arg == "allow_nan":
+                if (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True):
+                    break  # explicit True: as bad as the default
+                return  # False (or dynamic): caller made the choice
+        self.emit("DLT007", node,
+                  f"json.{func.attr} without allow_nan=False: one NaN emits "
+                  "the bare token `NaN` — invalid JSON that corrupts the "
+                  "line for every strict consumer")
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException")) or (
+            isinstance(node.type, ast.Tuple)
+            and any(isinstance(e, ast.Name)
+                    and e.id in ("Exception", "BaseException")
+                    for e in node.type.elts))
+        if broad and self._body_is_inert(node.body) and not self._in_del():
+            self.emit("DLT006", node,
+                      "broad except with an inert body swallows the failure "
+                      "entirely; attach context and re-raise (or at least "
+                      "record it) — finalizers (__del__) are exempt")
+        self.generic_visit(node)
+
+    def _in_del(self) -> bool:
+        return any(isinstance(f, _FUNC_NODES) and f.name == "__del__"
+                   for f in self._func_stack)
+
+    @staticmethod
+    def _body_is_inert(body) -> bool:
+        """Inert = nothing escapes: only pass/continue/break, bare returns
+        or constant returns, and docstrings. A call, assignment, or raise
+        means the handler did SOMETHING with the failure."""
+        for stmt in body:
+            if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+                continue
+            if isinstance(stmt, ast.Return) and (
+                    stmt.value is None
+                    or isinstance(stmt.value, ast.Constant)):
+                continue
+            if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                         ast.Constant):
+                continue  # docstring / ellipsis
+            return False
+        return True
+
+
+# ------------------------------------------------------------------ front end
+def lint_source(src: str, path: str = "<string>") -> list[Finding]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("DLT000", path, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    linter = _Linter(tree, path, src)
+    linter.visit(tree)
+    return sorted(linter.findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_file(path: str | pathlib.Path) -> list[Finding]:
+    p = pathlib.Path(path)
+    try:
+        src = p.read_text(encoding="utf-8", errors="replace")
+    except OSError as e:
+        return [Finding("DLT000", str(p), 0, 0, f"unreadable: {e}")]
+    return lint_source(src, str(p))
+
+
+def lint_paths(paths: Iterable[str | pathlib.Path]) -> list[Finding]:
+    """Lint files and/or directories (directories are walked for ``*.py``,
+    skipping hidden and ``__pycache__`` entries)."""
+    findings: list[Finding] = []
+    for path in paths:
+        p = pathlib.Path(path)
+        if p.is_dir():
+            # skip hidden/__pycache__ components BELOW the root only: the
+            # root itself may live under a hidden ancestor (~/.cache, a
+            # .worktrees dir) and must still lint, not false-green
+            files = sorted(
+                f for f in p.rglob("*.py")
+                if "__pycache__" not in f.relative_to(p).parts
+                and not any(part.startswith(".")
+                            for part in f.relative_to(p).parts))
+        else:
+            files = [p]
+        for f in files:
+            findings.extend(lint_file(f))
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    """Standalone entry point (no package import, no jax):
+    ``python distributed_lion_tpu/analysis/lint.py [paths...]``."""
+    targets = argv or [str(pathlib.Path(__file__).resolve().parents[1])]
+    findings = lint_paths(targets)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"graft-check tier1: {len(findings)} finding(s)")
+        return 1
+    print(f"graft-check tier1: clean ({', '.join(map(str, targets))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

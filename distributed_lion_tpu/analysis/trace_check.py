@@ -1,0 +1,291 @@
+"""graft-check tier 2: jaxpr contract checks on the ACTUAL compiled step.
+
+The wire recipe (README) is a promise about what the compiled train step
+puts on the interconnect; PR 2 verifies it at runtime as
+``comm_drift_bytes == 0``. This module verifies it STATICALLY, before a
+single step runs, by walking the jaxpr of the real train step (one
+abstract trace per config — the same ``jax.eval_shape``-cost pattern as
+``telemetry.measure_step_wire``) and asserting:
+
+- the **collective-primitive inventory** — every psum / all_gather /
+  all_to_all / ppermute call site with its axis names and operand element
+  count — exactly matches the expected set derived from
+  ``codec.bucket_bounds`` for the configured wire × ``vote_buckets`` ×
+  ``vote_every``. Scalar reductions (metric pmeans, telemetry's two
+  psums; operands ≤ ``SCALAR_MAX`` elements) are tallied separately: the
+  contract is that every LARGE operand on the wire belongs to the vote.
+- **zero host callbacks** (``pure_callback`` / ``io_callback`` /
+  ``jax.debug.*``) anywhere in the step's jaxpr — a callback is a hidden
+  per-step host round-trip that telemetry only sees as a slow step.
+- **donated buffers are actually donated**: the lowered module carries
+  input-output aliasing (``tf.aliasing_output``) for the params/state
+  arguments, so the step updates in place instead of doubling HBM.
+- **no f32 upcast of bf16 param leaves**: a ``convert_element_type``
+  consuming a bf16 *param input* into f32 doubles the param read traffic
+  the bf16 storage opted out of; loss/norm/clip math upcasting computed
+  values is fine and not flagged (the check follows the param inputs
+  only).
+
+Counts are at the call-site (eqn) level: a ppermute under ``lax.scan``
+executes ring-length times per step but is ONE wire call site, exactly how
+``collectives.WIRE_TALLY`` ledgers it.
+
+Requires jax (this is the tier the CLI gates behind ``--tier2``); the
+pure-stdlib source lint lives in :mod:`analysis.lint`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_lion_tpu.ops.codec import (
+    a2a_chunk_bytes,
+    bucket_bounds,
+    packed_size,
+    parse_wire,
+    vote_chunk_elems,
+)
+from distributed_lion_tpu.parallel.mesh import DATA_AXIS
+
+COLLECTIVE_PRIMS = ("psum", "all_gather", "all_to_all", "ppermute")
+# any primitive whose name contains one of these is a host round-trip
+HOST_CALLBACK_MARKERS = ("callback", "debug_print")
+# operands at or below this element count are "scalar reductions" (metric
+# pmeans, telemetry's two per-step psums) — bookkeeping, not wire payload.
+# Vote operands are ballot-bucket-sized (≥ thousands of elements for any
+# real model), so the two classes cannot collide.
+SCALAR_MAX = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCall:
+    """One collective call site in the step's jaxpr."""
+
+    prim: str
+    axes: tuple
+    nelems: int
+    dtype: str
+
+    @property
+    def key(self) -> tuple:
+        return (self.prim, self.axes, self.nelems)
+
+
+# ----------------------------------------------------------------- jaxpr walk
+def _inner_jaxprs(eqn) -> list:
+    """Sub-jaxprs of an eqn (pjit/shard_map/scan/remat/custom_* bodies),
+    as ClosedJaxpr-or-Jaxpr objects."""
+    out = []
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                out.append(item)
+    return out
+
+
+def _as_jaxpr(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def iter_eqns(jaxpr) -> Iterable:
+    """Depth-first over every eqn, descending into sub-jaxprs."""
+    for eqn in _as_jaxpr(jaxpr).eqns:
+        yield eqn
+        for sub in _inner_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _axes_of(eqn) -> tuple:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(axes, (str, int)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def collective_calls(fn, *args) -> tuple[list[CollectiveCall], list[str]]:
+    """Trace ``fn`` abstractly and return (collective call sites, host
+    callback primitive names) over its whole jaxpr."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    calls: list[CollectiveCall] = []
+    callbacks: list[str] = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMS:
+            for v in eqn.invars:
+                aval = getattr(v, "aval", None)
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                nelems = 1
+                for d in aval.shape:
+                    nelems *= int(d)
+                calls.append(CollectiveCall(name, _axes_of(eqn), nelems,
+                                            str(aval.dtype)))
+        elif any(m in name for m in HOST_CALLBACK_MARKERS):
+            callbacks.append(name)
+    return calls, callbacks
+
+
+# ----------------------------------------------------------- expected recipe
+def expected_wire_calls(n_params: int, world: int, wire: str, *,
+                        vote_every: int = 1, vote_buckets: int = 1,
+                        axis_name: str = DATA_AXIS) -> list[tuple]:
+    """The wire recipe's expected collective call sites, as a sorted list of
+    ``(prim, axes, nelems)`` — derived from the SAME single sources of truth
+    the collectives slice by (``codec.bucket_bounds`` /
+    ``codec.vote_chunk_elems`` / ``codec.a2a_chunk_bytes``), so the
+    expectation cannot drift from the accounting.
+
+    Mirrors ``parallel.collectives`` call sites exactly:
+
+    - ``sign_psum``: one psum of the (int-cast) ballot per bucket;
+    - ``packed_allgather``: one all_gather of the packed bytes per bucket;
+    - ``packed_a2a``: per bucket, one all_to_all of the ``[W, chunk]``
+      packed ballots + one all_gather of the ``[chunk]`` packed verdicts;
+    - ``hier:<g>``: per bucket, the three scan-ring ppermute call sites —
+      ballot reduce-scatter (``[chunk]`` at the accumulator width, g > 1),
+      cross-group packed-verdict ring (``[chunk/8]``, W/g > 1), intra-group
+      packed-elected all-gather (``[chunk/8]``, g > 1).
+    """
+    kind, group = parse_wire(wire)
+    ballot = (n_params if vote_every <= 1
+              else vote_chunk_elems(n_params, vote_every))
+    axes = (axis_name,)
+    out: list[tuple] = []
+    for _, size in bucket_bounds(ballot, max(vote_buckets, 1), world, wire):
+        if kind == "sign_psum":
+            out.append(("psum", axes, size))
+        elif kind == "packed_allgather":
+            out.append(("all_gather", axes, packed_size(size)))
+        elif kind == "packed_a2a":
+            chunk = a2a_chunk_bytes(size, world)
+            out.append(("all_to_all", axes, world * chunk))
+            out.append(("all_gather", axes, chunk))
+        else:  # hier:<g>
+            g = group
+            n_groups = world // g
+            chunk = 8 * a2a_chunk_bytes(size, g)
+            if g > 1:
+                out.append(("ppermute", axes, chunk))
+            if n_groups > 1:
+                out.append(("ppermute", axes, chunk // 8))
+            if g > 1:
+                out.append(("ppermute", axes, chunk // 8))
+    return sorted(out)
+
+
+# ------------------------------------------------------------- param upcasts
+def param_upcasts(fn, args, param_argnum: int = 0) -> list[tuple]:
+    """``convert_element_type`` eqns that consume a bf16 PARAM INPUT leaf
+    directly into f32, followed through pjit/shard_map/scan bodies by
+    positional invar mapping. Returns ``(shape,)`` tuples of the upcast
+    leaves; [] when params are not bf16 or never upcast wholesale."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    flat_before = sum(len(jax.tree.leaves(a)) for a in args[:param_argnum])
+    n_leaves = len(jax.tree.leaves(args[param_argnum]))
+    top = _as_jaxpr(jaxpr)
+    pvars = set(top.invars[flat_before:flat_before + n_leaves])
+    found: list[tuple] = []
+
+    def walk(jx, pv) -> None:
+        for eqn in jx.eqns:
+            subs = _inner_jaxprs(eqn)
+            if subs:
+                for sub in subs:
+                    sj = _as_jaxpr(sub)
+                    if len(sj.invars) == len(eqn.invars):
+                        # Literal invars are unhashable (and never params)
+                        inner = {sj.invars[i]
+                                 for i, v in enumerate(eqn.invars)
+                                 if type(v).__name__ != "Literal"
+                                 and v in pv}
+                        walk(sj, inner)
+                    else:  # conservative: positions unknown — don't follow
+                        walk(sj, set())
+                continue
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            v = eqn.invars[0]
+            aval = getattr(v, "aval", None)
+            if (v in pv and aval is not None
+                    and aval.dtype == jnp.bfloat16
+                    and eqn.params.get("new_dtype") == jnp.float32):
+                found.append(tuple(aval.shape))
+
+    walk(top, pvars)
+    return found
+
+
+# ---------------------------------------------------------------- the checks
+def donation_report(jitted, *args) -> dict:
+    """Lower the jitted step and count donation annotations — the
+    lowering-level proof that ``donate_argnums`` buffers really alias
+    outputs (zero of both means donation silently failed and params +
+    momentum exist twice in HBM). jax marks resolved aliases as
+    ``tf.aliasing_output`` and donation intent under sharded lowering as
+    ``jax.buffer_donor``; either proves the request survived lowering."""
+    text = jitted.lower(*args).as_text()
+    return {
+        "aliased_outputs": text.count("tf.aliasing_output"),
+        "buffer_donors": text.count("jax.buffer_donor"),
+    }
+
+
+def check_step(fn, args: tuple, *, n_params: int, world: int, wire: str,
+               vote_every: int = 1, vote_buckets: int = 1,
+               axis_name: str = DATA_AXIS,
+               scalar_max: int = SCALAR_MAX) -> dict:
+    """Run the jaxpr contract over one step function + example args.
+
+    Returns a report dict; ``report["ok"]`` is the CI verdict (inventory
+    matches AND zero host callbacks). Donation is checked separately
+    (:func:`donation_report` needs the jitted wrapper, not the core fn).
+    """
+    calls, callbacks = collective_calls(fn, *args)
+    wire_calls = sorted(c.key for c in calls if c.nelems > scalar_max)
+    scalar_calls = [c for c in calls if c.nelems <= scalar_max]
+    expected = expected_wire_calls(
+        n_params, world, wire, vote_every=vote_every,
+        vote_buckets=vote_buckets, axis_name=axis_name)
+    inventory_ok = wire_calls == expected
+    return {
+        "ok": bool(inventory_ok and not callbacks),
+        "inventory_ok": bool(inventory_ok),
+        "observed": [list(c) for c in wire_calls],
+        "expected": [list(c) for c in expected],
+        "scalar_reductions": len(scalar_calls),
+        "host_callbacks": callbacks,
+        "wire": wire,
+        "world": world,
+        "vote_every": vote_every,
+        "vote_buckets": vote_buckets,
+    }
+
+
+def check_trainer(trainer, batch_example, *,
+                  rng: Optional[Any] = None) -> dict:
+    """The whole tier-2 contract against a live ``train.loop.Trainer``:
+    collective inventory + host callbacks on the step core, donation on the
+    jitted wrapper, param-upcast scan. One abstract trace + one lowering —
+    startup cost, nothing per step."""
+    cfg = trainer.cfg
+    args = (trainer.params, trainer.state, trainer.vote_health,
+            trainer._frozen_arg(), batch_example,
+            rng if rng is not None else jax.random.key(0))
+    report = check_step(
+        trainer._train_step_core, args,
+        n_params=trainer.n_params, world=trainer.world, wire=cfg.wire,
+        vote_every=cfg.vote_every or 1, vote_buckets=cfg.vote_buckets or 1)
+    report["donation"] = donation_report(trainer._train_step, *args)
+    report["donation_ok"] = (report["donation"]["aliased_outputs"] > 0
+                             or report["donation"]["buffer_donors"] > 0)
+    report["param_upcasts"] = [list(s) for s in
+                              param_upcasts(trainer._train_step_core, args)]
+    report["upcast_ok"] = not report["param_upcasts"]
+    report["ok"] = bool(report["ok"] and report["donation_ok"]
+                        and report["upcast_ok"])
+    return report

@@ -160,7 +160,7 @@ def main(argv=None) -> None:
         "shards": writer.paths,
     }
     with open(os.path.join(args.output_dir, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=1)
+        json.dump(meta, f, indent=1, allow_nan=False)
     print(f"[tokenize_corpus] {n_docs} docs -> {writer.total:,} tokens in "
           f"{len(writer.paths)} shard(s) ({np.dtype(dtype).name}) at "
           f"{args.output_dir}")

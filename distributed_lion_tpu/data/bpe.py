@@ -274,7 +274,7 @@ class BPETokenizer:
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, "vocab.json"), "w", encoding="utf-8") as f:
-            json.dump(self.vocab, f, ensure_ascii=False)
+            json.dump(self.vocab, f, ensure_ascii=False, allow_nan=False)
         ordered = sorted(self.ranks.items(), key=lambda kv: kv[1])
         with open(os.path.join(path, "merges.txt"), "w", encoding="utf-8") as f:
             f.write("#version: 0.2\n")

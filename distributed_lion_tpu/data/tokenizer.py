@@ -114,8 +114,10 @@ def load_tokenizer(name_or_path: str | None):
                     return tok.decode(list(ids))
 
             return _HFAdapter()
-        except Exception:
-            pass
+        except Exception:  # graft: disable=DLT006
+            pass  # deliberate fallback chain: no `tokenizers` wheel / no
+            # tokenizer.json here is an expected miss, and the loud WARNING
+            # below names every path that was tried
         import sys
 
         print(

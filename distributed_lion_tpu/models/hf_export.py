@@ -58,7 +58,7 @@ def _save_state_dict(sd: dict, path: str, config: dict) -> None:
         tensors[k] = t
     _write_tensors(tensors, path, "model")
     with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump(config, f, indent=1)
+        json.dump(config, f, indent=1, allow_nan=False)
 
 
 # ----------------------------------------------------------------------- GPT-2
@@ -276,7 +276,7 @@ def lora_to_peft(adapters: dict, model_cfg: Any, lora_cfg: Any,
         "base_model_name_or_path": base_model_name,
     }
     with open(os.path.join(path, "adapter_config.json"), "w") as f:
-        json.dump(config, f, indent=1)
+        json.dump(config, f, indent=1, allow_nan=False)
 
 
 def llama_to_hf(params: dict, cfg: Any, path: str) -> None:

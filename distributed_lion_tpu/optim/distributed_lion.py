@@ -54,6 +54,7 @@ from distributed_lion_tpu.optim.lion import (
     resolve_lr,
 )
 from distributed_lion_tpu.parallel import collectives
+from distributed_lion_tpu.parallel.mesh import DATA_AXIS
 
 
 def _flatten_votes(vote_tree):
@@ -101,7 +102,7 @@ def distributed_lion(
     b2: float = 0.99,
     weight_decay: float = 0.0,
     *,
-    axis_name: Optional[str] = "data",
+    axis_name: Optional[str] = DATA_AXIS,
     max_grad_norm: Optional[float] = None,
     wire: str = "sign_psum",
     vote_every: int = 1,

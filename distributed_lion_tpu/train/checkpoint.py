@@ -81,7 +81,7 @@ def write_manifest(sdir: pathlib.Path, step: int,
     raw = json.dumps(
         {"format": MANIFEST_FORMAT, "step": int(step), "files": files,
          "meta": meta or {}},
-        sort_keys=True).encode()
+        sort_keys=True, allow_nan=False).encode()
     _atomic_write(sdir / MANIFEST, raw)
     return hashlib.sha256(raw).hexdigest()
 
@@ -147,8 +147,16 @@ class Checkpointer:
         t0 = time.monotonic()
         drained = 0.0
         try:
-            drained = self.finalize()  # accounts its own stall; subtracted
-            # below so the drain isn't double-counted in this save's ledger
+            try:
+                drained = self.finalize()  # accounts its own stall;
+                # subtracted below so the drain isn't double-counted in
+                # this save's ledger
+            except Exception:
+                # finalize's finally already ledgered the drain seconds;
+                # mark them consumed so this save's finally doesn't add
+                # the same wall time again on the way out
+                drained = time.monotonic() - t0
+                raise
             delay = self.retry_backoff_s
             for attempt in range(self.max_retries + 1):
                 try:
@@ -158,7 +166,17 @@ class Checkpointer:
                     break
                 except Exception as e:
                     if attempt == self.max_retries:
-                        raise
+                        # out of retries: re-raise with step/path context
+                        # attached, same exception class so callers (and
+                        # tests) matching on the original type still do
+                        try:
+                            wrapped = type(e)(
+                                f"checkpoint save(step={step}) under "
+                                f"{self.directory} failed after "
+                                f"{attempt + 1} attempts: {e}")
+                        except Exception:
+                            raise e  # exotic ctor signature: original as-is
+                        raise wrapped from e
                     print(f"[ckpt] save({step}) attempt {attempt + 1} failed "
                           f"({e}); retrying in {delay:.2f}s")
                     time.sleep(delay)
@@ -190,13 +208,18 @@ class Checkpointer:
         _atomic_write(
             sdir / MARKER,
             json.dumps({"manifest_sha256": digest, "step": int(step),
-                        "committed_at_unix": time.time()}).encode())
+                        "committed_at_unix": time.time()},
+                       allow_nan=False).encode())
         return step
 
     def finalize(self) -> float:
         """Drain the in-flight async save, if any; returns the seconds this
-        call blocked. A failed commit is a warning, not a crash: the step
-        simply stays uncommitted and resume falls back past it."""
+        call blocked. An exception the committer thread hit (Orbax
+        finalization, manifest I/O) is re-raised HERE — the drain boundary
+        — with step/path context attached: swallowing it left the run
+        believing in checkpoints that were never committed. (Injected
+        crash-faults simulate death by returning early, not by raising, so
+        the fault matrix still exercises the fall-back-past-it path.)"""
         if self._inflight is None:
             return 0.0
         t0 = time.monotonic()
@@ -205,10 +228,14 @@ class Checkpointer:
         try:
             fut.result()
         except Exception as e:
-            print(f"[ckpt] WARNING: commit for step {step} failed: {e}; "
-                  "that checkpoint will not be resumed from")
-        dt = time.monotonic() - t0
-        self._add_stall(dt)
+            raise RuntimeError(
+                f"checkpoint commit for step {step} under "
+                f"{self._step_dir(step)} failed on the committer thread; "
+                "that checkpoint was never committed and will not be "
+                "resumed from") from e
+        finally:
+            dt = time.monotonic() - t0
+            self._add_stall(dt)
         return dt
 
     def _add_stall(self, dt: float) -> None:
@@ -264,15 +291,26 @@ class Checkpointer:
         below them they are an abandoned future, and the deterministic
         replay re-creates them bit-identically anyway."""
         purged: list[int] = []
+        failures: list[tuple[int, Exception]] = []
         for s in sorted(int(x) for x in self.manager.all_steps()):
             if s > step:
                 try:
                     self.manager.delete(s)
                 except Exception as e:
-                    print(f"[ckpt] WARNING: could not purge stale "
-                          f"checkpoint step {s}: {e}")
+                    # keep purging the rest, then raise with full context:
+                    # a stale step left on disk silently eats every future
+                    # save below it — "could not purge" is not a warning
+                    failures.append((s, e))
                     continue
                 purged.append(s)
+        if failures:
+            detail = "; ".join(f"step {s} ({self._step_dir(s)}): {e}"
+                               for s, e in failures)
+            raise RuntimeError(
+                f"could not purge stale checkpoint step(s) "
+                f"{[s for s, _ in failures]} newer than the resumed step "
+                f"{step} — left on disk they make Orbax silently drop every "
+                f"post-resume save below them: {detail}") from failures[0][1]
         return purged
 
     def manifest_meta(self, step: int) -> Optional[dict]:
@@ -290,11 +328,15 @@ class Checkpointer:
         return self.manager.restore(step, args=ocp.args.StandardRestore(template))
 
     def close(self) -> None:
-        self.finalize()
-        if self._executor is not None:
-            self._executor.shutdown(wait=True)
-            self._executor = None
-        self.manager.close()
+        # the drain may re-raise a committer-thread failure; the executor
+        # and Orbax manager must still be torn down before it propagates
+        try:
+            self.finalize()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
+            self.manager.close()
 
 
 def _as_abstract(x):

@@ -209,6 +209,17 @@ class TrainConfig:
     # on trip, writes a crash bundle (step, config, per-leaf finite masks
     # naming the poisoned leaves, recent metrics window) to
     # output_dir/crash/step_<n>/ and raises FloatingPointError.
+    retrace_guard: str = "warn"  # off | warn | error. The runtime leg of the
+    # static-analysis subsystem (analysis/): hash the jitted train step's
+    # abstract input signature (leaf shapes/dtypes) at each dispatch and
+    # surface any UNSEEN signature after the first — a recompilation: new
+    # batch shape/dtype, a drifted state structure; signatures jax already
+    # compiled and cached re-dispatch freely — as a loud retraces metric +
+    # warning, or a RuntimeError under 'error', instead of a silent 2x
+    # step-time cliff. Checked BEFORE dispatch (host-side hash over leaf
+    # avals, no device traffic), so 'error' refuses the recompile before
+    # paying for it. Purely observational under 'warn': elections and
+    # trajectories are bit-identical to 'off'.
     trace_on_anomaly: bool = False  # with nan_sentinel: instead of raising
     # immediately, arm a StepProfiler window at the tripping step (trace
     # written into the crash bundle), run profile_num_steps more steps to
@@ -653,6 +664,17 @@ class Trainer:
             raise ValueError(
                 f"--on_preempt {cfg.on_preempt!r}: expected 'save_exit' "
                 "(drain + emergency checkpoint + clean return) or 'off'")
+        if cfg.retrace_guard not in ("off", "warn", "error"):
+            raise ValueError(
+                f"--retrace_guard {cfg.retrace_guard!r}: expected 'off', "
+                "'warn' (count + log recompilations) or 'error' (refuse "
+                "them before compiling)")
+        # retrace guard state: the abstract input signatures each jitted
+        # entry point has ALREADY compiled ('step' and 'chunk' specialize
+        # separately by design) — a set, because jax caches every
+        # specialization: only an UNSEEN signature costs a compile
+        self._retrace_sigs: dict = {}
+        self.retrace_count = 0
         self.preempted = False
         self._preempt_guard = (resilience.PreemptionGuard()
                                if cfg.on_preempt == "save_exit" else None)
@@ -710,6 +732,47 @@ class Trainer:
         except Exception as e:  # measurement must never take down training
             print(f"[telemetry] wire measurement unavailable: {e}")
             self._wire_measured = {}
+
+    def _check_retrace(self, kind: str, *args) -> None:
+        """The retrace guard (--retrace_guard): compare this dispatch's
+        abstract input signature against the first dispatch's. A change
+        means jax is about to compile a second specialization of the train
+        step — a one-off multi-second stall plus a silently cached second
+        program, which on a chip reads as a 2x step-time cliff with no
+        error anywhere. Host-side hash over leaf shapes/dtypes, checked
+        BEFORE dispatch so 'error' mode refuses the recompile before
+        paying for it."""
+        if self.cfg.retrace_guard == "off":
+            return
+        # the treedef is part of the signature: structure drift with an
+        # identical leaf sequence (a renamed key, same-shaped leaves
+        # swapped between containers) recompiles just the same
+        sig = hash((jax.tree.structure(args), tuple(
+            (getattr(leaf, "shape", None),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in jax.tree.leaves(args))))
+        seen = self._retrace_sigs.setdefault(kind, set())
+        if not seen or sig in seen:
+            # first dispatch, or a specialization jax already compiled and
+            # cached (e.g. a short last-epoch batch alternating with the
+            # full one) — re-dispatching a cached signature costs nothing
+            # and must not re-warn forever
+            seen.add(sig)
+            return
+        self.retrace_count += 1
+        msg = (f"RETRACE: the jitted train {kind} saw a new abstract input "
+               f"signature at step {self.step_count} — jax will compile "
+               "another specialization (multi-second stall now, a silent "
+               "step-time cliff if it recurs). Usual causes: a batch "
+               "shape/dtype change mid-run, or optimizer-state structure "
+               "drift. --retrace_guard off silences; error refuses.")
+        if self.cfg.retrace_guard == "error":
+            # do NOT adopt the refused signature: a caller that catches and
+            # re-dispatches the same shapes must be refused again, not
+            # silently recompiled on the retry
+            raise RuntimeError(msg)
+        seen.add(sig)
+        print(f"[trainer] {msg}")
 
     def _check_sentinel(self, step: int, metrics,
                         force_raise: bool = False) -> None:
@@ -988,6 +1051,9 @@ class Trainer:
                 batches = jax.device_put(
                     jax.tree.map(lambda *xs: np.stack(xs), *stack), chunk_spec
                 )
+                self._check_retrace("chunk", self.params, self.state,
+                                    self.vote_health, self._frozen_arg(),
+                                    batches)
                 with self.profiler.annotate(self.step_count):
                     (self.params, self.state, self.vote_health,
                      metrics) = self._train_chunk(
@@ -1000,6 +1066,9 @@ class Trainer:
                 raw_batch = next(train_iter)
                 self._measure_wire_once(raw_batch)
                 batch = jax.device_put(raw_batch, data_spec)
+                self._check_retrace("step", self.params, self.state,
+                                    self.vote_health, self._frozen_arg(),
+                                    batch)
                 with self.profiler.annotate(self.step_count):
                     (self.params, self.state, self.vote_health,
                      metrics) = self._train_step(
@@ -1052,6 +1121,10 @@ class Trainer:
                     # the last log — async saves keep this near 0 while the
                     # sync path pays the full serialize+write here
                     m["ckpt_stall_s"] = self.checkpointer.pop_stall_s()
+                if self.retrace_count:
+                    # recompilations the retrace guard observed (should stay
+                    # 0 for the whole run; see --retrace_guard)
+                    m["retraces"] = self.retrace_count
                 if self._telemetry_on:
                     # drain the on-device accumulator (the interval's ONLY
                     # telemetry host transfer) and reset its counters; the
@@ -1398,9 +1471,13 @@ class Trainer:
         self.profiler.close()
         if self._preempt_guard is not None:
             self._preempt_guard.close()
-        if self.checkpointer:
-            self.checkpointer.close()
-        self.logger.close()
+        try:
+            if self.checkpointer:
+                # may re-raise a committer-thread commit failure (the drain
+                # boundary); the metrics log must still be flushed/closed
+                self.checkpointer.close()
+        finally:
+            self.logger.close()
 
     # ------------------------------------------------------------- factories
     @staticmethod

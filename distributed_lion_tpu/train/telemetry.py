@@ -343,6 +343,6 @@ def write_crash_bundle(output_dir: str, step: int, reason: str,
         "metrics_window": list(metrics_window),
     }
     with open(os.path.join(crash_dir, "bundle.json"), "w") as f:
-        json.dump(_json_safe(bundle), f, indent=1)
+        json.dump(_json_safe(bundle), f, indent=1, allow_nan=False)
         f.write("\n")
     return crash_dir

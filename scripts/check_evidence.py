@@ -9,6 +9,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py bench_best
     python scripts/check_evidence.py overlap        # buckets {1,4,16} rows
     python scripts/check_evidence.py telemetry      # vote-health JSONL
+    python scripts/check_evidence.py static         # graft-check both tiers
     python scripts/check_evidence.py all
 """
 
@@ -16,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -334,6 +336,34 @@ def resilience_ok(dirname: str = "resilience") -> bool:
     return a is not None and s is not None and s > 0 and a < s
 
 
+# static-analysis gate (ISSUE 4): the stage is green when (a) the
+# ci_static.sh gate passes RIGHT NOW — ruff baseline + graft-check tier-1
+# AST lint + shellcheck, each skipped gracefully where not installed — and
+# (b) the jaxpr contract tier's report (written by the runbook's static
+# stage via `python -m distributed_lion_tpu.analysis --tier2 --json-out`)
+# exists with ok=true. Tier 1 re-runs on every poll (sub-second, no jax);
+# tier 2 traces the real train step, so it is captured once per runbook
+# pass like every other evidence artifact.
+STATIC_TIER2_REPORT = os.path.join(OUT, "static_tier2.json")
+
+
+def static_ok() -> bool:
+    try:
+        gate = subprocess.run(
+            ["bash", os.path.join(REPO, "scripts", "ci_static.sh")],
+            capture_output=True, timeout=600)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if gate.returncode != 0:
+        return False
+    try:
+        with open(STATIC_TIER2_REPORT) as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return report.get("ok") is True
+
+
 # the ONE stage list both check("all") and the CLI printout derive from —
 # adding a stage here updates the watcher exit condition and the operator
 # status display together
@@ -351,6 +381,7 @@ STAGES = [
     ("dpo", dpo),
     ("telemetry", telemetry_ok),
     ("resilience", resilience_ok),
+    ("static", static_ok),
 ]
 
 
@@ -394,6 +425,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return telemetry_ok(arg or "telemetry")
     if what == "resilience":
         return resilience_ok(arg or "resilience")
+    if what == "static":
+        return static_ok()
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -30,6 +30,24 @@ stamp() { date -u +%FT%TZ; }
 
 echo "$(stamp) stage-2 runbook start" | tee -a "$OUT/log.txt"
 
+# ---- 0. static-analysis gate (ISSUE 4, ~1 min, no chip time): ruff +
+# graft-check tier-1 AST lint + shellcheck via ci_static.sh, then the
+# jaxpr contract tier — trace the REAL train step for every wire x
+# vote_buckets cell on this backend and assert the collective inventory
+# matches the wire recipe (the static counterpart of comm_drift_bytes),
+# zero host callbacks, donation applied, no bf16-param upcasts. The
+# tier-2 report is the capture artifact check_evidence's `static` stage
+# reads; tier 1 re-runs inside check_evidence on every poll.
+if python scripts/check_evidence.py static; then
+  echo "$(stamp) static gate already green — skip" | tee -a "$OUT/log.txt"
+else
+  bash scripts/ci_static.sh >> "$OUT/static.log" 2>&1
+  rc=$?; echo "$(stamp) ci_static rc=$rc" | tee -a "$OUT/log.txt"
+  timeout -k 30 900 python -m distributed_lion_tpu.analysis --tier2 \
+      --json-out "$OUT/static_tier2.json" >> "$OUT/static.log" 2>&1
+  rc=$?; echo "$(stamp) graft-check tier2 rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
 # Pick the best promotable sweep row across sweep*.jsonl and re-bench
 # bench.py under it via env knobs so last_tpu_measurement.json reflects
 # the best measured config. $1 names the run-at-most-once marker: without
@@ -91,7 +109,7 @@ EOF
     fi
     return
   fi
-  cat "$OUT/winner.env" | tee -a "$OUT/log.txt"
+  tee -a "$OUT/log.txt" < "$OUT/winner.env"
   # shellcheck disable=SC1090
   . "$OUT/winner.env" 2>/dev/null || true
   # bench.py rewrites the headline artifact on every successful TPU run;

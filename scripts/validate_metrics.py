@@ -17,15 +17,29 @@ script asserts a metrics file actually honors that contract:
 A torn final line (a run killed mid-write) is tolerated once, at EOF —
 append-mode logs legitimately end that way.
 
-    python scripts/validate_metrics.py runs/telemetry/metrics.jsonl [...]
+Non-JSONL arguments (``*.json``) are validated as strict single-document
+JSON artifacts, so EVERY JSON artifact the repo writes passes one
+validator: crash bundles (``crash/step_*/bundle.json`` — must carry
+step/reason/config, telemetry.write_crash_bundle) and checkpoint
+manifests (``manifest.json`` — must carry format/step/files with
+sha256+bytes per file, checkpoint.write_manifest). The same NaN-token
+rejection applies: all three writers pass ``allow_nan=False`` and this
+script is the CI check that they keep doing so.
 
-Exit 0 = every file valid. Used by tests/test_telemetry.py and the
-runbook's telemetry stage (scripts/tpu_runbook_auto2.sh).
+    python scripts/validate_metrics.py runs/telemetry/metrics.jsonl \
+        runs/telemetry/crash/step_*/bundle.json \
+        runs/resilience/checkpoints/*/manifest.json
+
+Exit 0 = every file valid. Used by tests/test_telemetry.py,
+tests/test_validate_artifacts.py and the runbook's telemetry stage
+(scripts/tpu_runbook_auto2.sh).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import re
 import sys
 
 
@@ -77,13 +91,63 @@ def validate_file(path: str) -> list[str]:
     return errors
 
 
+# required top-level keys per known single-document artifact name
+_DOC_SCHEMAS = {
+    "bundle.json": ("step", "reason", "config"),
+    "manifest.json": ("format", "step", "files"),
+}
+_SHA256 = re.compile(r"^[0-9a-f]{64}$")
+
+
+def validate_json_doc(path: str) -> list[str]:
+    """Strict single-document JSON artifact check (crash bundles,
+    checkpoint manifests, and any other ``*.json`` the repo writes):
+    strict parse (NaN/Infinity tokens rejected), a top-level object, and —
+    for the known artifact names — the writer's required keys with sane
+    shapes. Returns violation strings (empty = valid)."""
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    try:
+        doc = json.loads(raw, parse_constant=_reject_constant)
+    except ValueError as e:
+        return [f"{path}: {e}"]
+    if not isinstance(doc, dict):
+        return [f"{path}: document is {type(doc).__name__}, not an object"]
+    name = os.path.basename(path)
+    for key in _DOC_SCHEMAS.get(name, ()):
+        if key not in doc:
+            errors.append(f"{path}: missing required key {key!r}")
+    if name in _DOC_SCHEMAS and not isinstance(doc.get("step"), int):
+        errors.append(f"{path}: 'step' must be an integer")
+    if name == "manifest.json" and isinstance(doc.get("files"), dict):
+        for rel, info in doc["files"].items():
+            if not isinstance(info, dict):
+                errors.append(f"{path}: files[{rel!r}] is not an object")
+                continue
+            if not _SHA256.match(str(info.get("sha256", ""))):
+                errors.append(f"{path}: files[{rel!r}] has no valid sha256")
+            if not isinstance(info.get("bytes"), int):
+                errors.append(f"{path}: files[{rel!r}] has no integer bytes")
+    elif name == "manifest.json" and "files" in doc:
+        errors.append(f"{path}: 'files' must be an object")
+    if name == "bundle.json" and "config" in doc and not isinstance(
+            doc["config"], dict):
+        errors.append(f"{path}: 'config' must be an object")
+    return errors
+
+
 def main(argv: list[str]) -> int:
     if not argv:
         print(__doc__)
         return 2
     failed = False
     for path in argv:
-        errors = validate_file(path)
+        errors = (validate_file(path) if path.endswith(".jsonl")
+                  else validate_json_doc(path))
         if errors:
             failed = True
             for e in errors:

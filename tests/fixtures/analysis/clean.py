@@ -1,0 +1,27 @@
+"""Fixture: a file every graft-check tier-1 rule must pass."""
+import json
+
+import jax
+from jax import lax
+
+DATA_AXIS = "data"  # module constant assignment, not a call-site literal
+
+
+@jax.jit
+def step(params, grads):
+    votes = jax.tree.map(lambda g: g > 0, grads)
+    total = lax.psum(
+        jax.tree.leaves(votes)[0].astype(jax.numpy.int8), DATA_AXIS)
+    return jax.tree.map(lambda p: p - 0.1, params), total
+
+
+def save_metrics(path, record):
+    with open(path, "w") as f:
+        json.dump(record, f, allow_nan=False)
+
+
+def guarded(path):
+    try:
+        return path.read_bytes()
+    except OSError:
+        return None

@@ -1,0 +1,28 @@
+"""Fixture: DLT001 — host-sync calls inside traced scope. Never imported;
+parsed by graft-check's tier-1 tests (tests/test_analysis_lint.py)."""
+import jax
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def step(params, batch):
+    loss = (params["w"] * batch).sum()
+    bad1 = float(loss)            # DLT001: host sync in jitted fn
+    bad2 = loss.item()            # DLT001
+    bad3 = np.asarray(loss)       # DLT001
+    return bad1 + bad2 + bad3.sum()
+
+
+def outer(xs):
+    def body(carry, x):           # traced: passed to lax.scan by name
+        carry = carry + x
+        host = jax.device_get(carry)   # DLT001
+        return carry, host
+
+    return lax.scan(body, 0.0, xs)
+
+
+def host_side(metrics):
+    # NOT traced scope: float() on host values is fine here
+    return {k: float(v) for k, v in metrics.items()}

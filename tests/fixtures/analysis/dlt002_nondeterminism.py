@@ -1,0 +1,18 @@
+"""Fixture: DLT002 — nondeterminism baked in at trace time."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(params):
+    noise = random.random()       # DLT002: traced once, constant every step
+    t0 = time.time()              # DLT002
+    jitter = np.random.randn()    # DLT002
+    return params * noise + t0 + jitter
+
+
+def host_timer():
+    return time.time()  # NOT traced: wall-clock on the host is fine

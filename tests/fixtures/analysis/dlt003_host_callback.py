@@ -1,0 +1,14 @@
+"""Fixture: DLT003 — host callbacks inside traced scope."""
+import jax
+
+
+@jax.jit
+def step(params, batch):
+    loss = (params * batch).sum()
+    print("loss is", loss)             # DLT003: trace-time only
+    jax.debug.print("loss {}", loss)   # DLT003: per-step host callback
+    return loss
+
+
+def report(history):
+    print("final loss", history[-1])  # NOT traced: host logging is fine

@@ -1,0 +1,14 @@
+"""Fixture: DLT004 — a raw typed PRNG key reaching serialization (the
+resilience PR's latent bug: stochastic-mode checkpoints failed to save)."""
+import jax
+
+
+def save_state_bad(manager, step, state):
+    # DLT004: an rng leaf in the payload, no key_data/pack shim in scope
+    manager.save(step, {"params": state.params, "rng": state.rng})
+
+
+def save_state_good(manager, step, state):
+    # shimmed with key_data: not flagged
+    manager.save(step, {"params": state.params,
+                        "rng": jax.random.key_data(state.rng)})

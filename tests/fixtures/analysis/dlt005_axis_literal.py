@@ -1,0 +1,20 @@
+"""Fixture: DLT005 — hardcoded mesh-axis-name string literals."""
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def vote(ballots):
+    return lax.psum(ballots, "data")      # DLT005: literal axis name
+
+
+def specs():
+    return P("data", None)                # DLT005
+
+
+def make_opt(axis_name="data"):           # DLT005: literal default
+    return axis_name
+
+
+# the string in a plain comparison or docstring is not an axis *usage*
+def describe(name):
+    return name == "data axis"

@@ -1,0 +1,32 @@
+"""Fixture: DLT006 — swallowed exceptions (broad except, inert body)."""
+
+
+def commit(path, data):
+    try:
+        path.write_bytes(data)
+    except Exception:      # DLT006: the failure vanishes
+        pass
+
+
+def drain(futures):
+    for f in futures:
+        try:
+            f.result()
+        except Exception:  # DLT006: inert continue
+            continue
+
+
+def logged(path):
+    try:
+        return path.read_bytes()
+    except Exception as e:  # not flagged: the handler DOES something
+        print(f"read failed: {e}")
+        raise
+
+
+class Holder:
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # not flagged: finalizers must not raise
+            pass

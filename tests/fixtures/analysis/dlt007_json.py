@@ -1,0 +1,15 @@
+"""Fixture: DLT007 — non-strict json.dump/dumps."""
+import json
+
+
+def write_metrics(path, record):
+    with open(path, "w") as f:
+        json.dump(record, f)                     # DLT007
+
+
+def row(record):
+    return json.dumps(record, allow_nan=True)    # DLT007: explicit True
+
+
+def strict_row(record):
+    return json.dumps(record, allow_nan=False)   # not flagged

@@ -1,0 +1,16 @@
+"""Fixture: DLT008 — mutable default arguments."""
+
+
+def accumulate(x, acc=[]):      # DLT008
+    acc.append(x)
+    return acc
+
+
+def configure(overrides={}):    # DLT008
+    return dict(overrides)
+
+
+def fresh(x, acc=None):         # not flagged: the None idiom
+    acc = acc or []
+    acc.append(x)
+    return acc

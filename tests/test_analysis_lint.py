@@ -1,0 +1,190 @@
+"""graft-check tier 1 (analysis/lint.py): every rule has a fixture file
+proving it fires, the suppression syntax works, traced-scope detection has
+the documented boundary, and — the CI pin — the package itself lints
+clean (zero findings), so any future violation of a codified pitfall
+fails tier-1 instead of waiting for a chip run."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "distributed_lion_tpu")
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "analysis")
+
+# load lint.py by FILE PATH, the way dependency-light scripts must be able
+# to (scripts/check_evidence.py runs on boxes without jax; importing the
+# package would pull in compat -> jax)
+_spec = importlib.util.spec_from_file_location(
+    "graft_lint", os.path.join(PKG, "analysis", "lint.py"))
+lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(lint)
+
+
+# ------------------------------------------------------------------ fixtures
+RULE_FIXTURES = {
+    "DLT001": ("dlt001_host_sync.py", 4),
+    "DLT002": ("dlt002_nondeterminism.py", 3),
+    "DLT003": ("dlt003_host_callback.py", 2),
+    "DLT004": ("dlt004_prng_save.py", 1),
+    "DLT005": ("dlt005_axis_literal.py", 3),
+    "DLT006": ("dlt006_swallowed.py", 2),
+    "DLT007": ("dlt007_json.py", 2),
+    "DLT008": ("dlt008_mutable_default.py", 2),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_fires_on_its_fixture(rule):
+    """Each rule fires exactly the marked number of times on its fixture —
+    and nothing else fires there (single-rule fixtures keep failures
+    attributable)."""
+    fixture, expected = RULE_FIXTURES[rule]
+    findings = lint.lint_file(os.path.join(FIXTURES, fixture))
+    assert [f.rule for f in findings] == [rule] * expected, (
+        f"{fixture}: {[str(f) for f in findings]}")
+
+
+def test_every_documented_rule_has_a_fixture():
+    assert set(RULE_FIXTURES) == set(lint.RULES)
+
+
+def test_clean_fixture_has_zero_findings():
+    assert lint.lint_file(os.path.join(FIXTURES, "clean.py")) == []
+
+
+# -------------------------------------------------------------- suppressions
+def test_line_suppression():
+    src = (
+        "import json\n"
+        "def f(r):\n"
+        "    return json.dumps(r)  # graft: disable=DLT007\n"
+    )
+    assert lint.lint_source(src) == []
+
+
+def test_file_suppression():
+    src = (
+        "# graft: disable-file=DLT008\n"
+        "def f(x, acc=[]):\n"
+        "    return acc\n"
+        "def g(x, acc=[]):\n"
+        "    return acc\n"
+    )
+    assert lint.lint_source(src) == []
+
+
+def test_suppression_in_string_or_docstring_is_inert():
+    """Suppressions live in COMMENT tokens only: a module that merely
+    DOCUMENTS the syntax in a docstring (as analysis/lint.py itself does)
+    must not silently disable rules on itself."""
+    src = (
+        '"""Docs: suppress with `# graft: disable-file=DLT006`."""\n'
+        "def f(p):\n"
+        "    try:\n"
+        "        p.unlink()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert [f.rule for f in lint.lint_source(src)] == ["DLT006"]
+    quoted = 'x = "# graft: disable=DLT008"\ndef f(a=[]):\n    return a\n'
+    assert [f.rule for f in lint.lint_source(quoted)] == ["DLT008"]
+
+
+def test_suppression_is_rule_specific():
+    src = (
+        "import json\n"
+        "def f(r, acc=[]):  # graft: disable=DLT007\n"
+        "    return json.dumps(r)\n"
+    )
+    # the DLT008 on line 2 is NOT covered by the DLT007 suppression; the
+    # DLT007 itself is on line 3, not the suppressed line
+    rules = [f.rule for f in lint.lint_source(src)]
+    assert "DLT008" in rules and "DLT007" in rules
+
+
+# ------------------------------------------------------- traced-scope bounds
+def test_partial_shard_map_decorator_is_traced():
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.shard_map, mesh=None, in_specs=None, out_specs=None)\n"
+        "def step(x):\n"
+        "    return float(x)\n"
+    )
+    assert [f.rule for f in lint.lint_source(src)] == ["DLT001"]
+
+
+def test_nested_function_inherits_traced_scope():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(xs):\n"
+        "    def micro(x):\n"
+        "        return x.item()\n"
+        "    return micro(xs)\n"
+    )
+    assert [f.rule for f in lint.lint_source(src)] == ["DLT001"]
+
+
+def test_host_code_is_not_traced_scope():
+    src = (
+        "def log(metrics):\n"
+        "    print('loss', float(metrics['loss']))\n"
+    )
+    assert lint.lint_source(src) == []
+
+
+def test_lint_paths_under_hidden_ancestor(tmp_path):
+    """The hidden-component skip applies BELOW the lint root only: a repo
+    checked out under a hidden ancestor (~/.cache, a .worktrees dir) must
+    still lint — an empty file list reading 'clean' is a false-green CI
+    gate."""
+    root = tmp_path / ".hidden" / "pkg"
+    root.mkdir(parents=True)
+    (root / "bad.py").write_text("def f(x, acc=[]):\n    return acc\n")
+    assert [f.rule for f in lint.lint_paths([root])] == ["DLT008"]
+    # hidden children below the root are still skipped
+    sub = root / ".venv"
+    sub.mkdir()
+    (sub / "x.py").write_text("def g(a=[]):\n    return a\n")
+    assert [f.rule for f in lint.lint_paths([root])] == ["DLT008"]
+
+
+# --------------------------------------------------------------- the CI pins
+def test_package_lints_clean():
+    """THE tier-1 pin: zero graft-check findings over the whole package.
+    A new violation of any codified pitfall fails here, with the rule and
+    line in the assertion message."""
+    findings = lint.lint_paths([PKG])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    """python -m distributed_lion_tpu.analysis: exit 0 on a clean tree,
+    1 with findings — the contract scripts/ci_static.sh and the runbook's
+    static stage rely on."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "distributed_lion_tpu.analysis", PKG],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x, acc=[]):\n    return acc\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "distributed_lion_tpu.analysis", str(bad)],
+        cwd=REPO, env=env, capture_output=True, text=True)
+    assert r.returncode == 1 and "DLT008" in r.stdout
+
+
+def test_lint_runs_standalone_without_package():
+    """lint.py is pure stdlib AND directly runnable by path — the no-jax
+    contract (scripts/ci_static.sh uses exactly this invocation)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(PKG, "analysis", "lint.py"), PKG],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout

@@ -13,6 +13,7 @@ import pytest
 
 from distributed_lion_tpu.parallel.mesh import make_mesh
 from distributed_lion_tpu.train.loop import (
+    AUTO_BUCKET_MIN_COORDS,
     AUTO_LAZY_MIN_PARAMS,
     TrainConfig,
     Trainer,
@@ -64,6 +65,54 @@ def test_explicit_choice_is_never_overridden(mesh8):
     part = TrainConfig(wire="sign_psum", vote_every=1)
     r = resolve_auto_comm(part, mesh8, 124_000_000, True)
     assert (r.wire, r.vote_every, r.vote_buckets) == ("sign_psum", 1, 4)
+
+
+def test_vote_buckets_threshold_boundary(mesh8):
+    """The bucketed-wire auto threshold is judged on the PER-STEP ballot
+    slice, exactly at AUTO_BUCKET_MIN_COORDS: at the boundary the pipeline
+    arms (4 buckets), one coordinate below it stays monolithic."""
+    base = dict(wire="packed_a2a", vote_every=1)
+    at = resolve_auto_comm(TrainConfig(**base), mesh8,
+                           AUTO_BUCKET_MIN_COORDS, params_replicated=True)
+    assert at.vote_buckets == 4
+    below = resolve_auto_comm(TrainConfig(**base), mesh8,
+                              AUTO_BUCKET_MIN_COORDS - 1,
+                              params_replicated=True)
+    assert below.vote_buckets == 1
+
+
+def test_vote_buckets_threshold_counts_lazy_slice(mesh8):
+    """Under vote_every=K only 1/K of the ballot rides the wire per step —
+    the bucket decision follows the slice (codec.vote_chunk_elems), not
+    the full ballot: a 4x-threshold ballot at K=4 sits exactly at the
+    boundary; 32 coordinates fewer drops the slice below it."""
+    base = dict(wire="packed_a2a", vote_every=4)
+    at = resolve_auto_comm(TrainConfig(**base), mesh8,
+                           4 * AUTO_BUCKET_MIN_COORDS,
+                           params_replicated=True)
+    assert at.vote_buckets == 4
+    below = resolve_auto_comm(TrainConfig(**base), mesh8,
+                              4 * AUTO_BUCKET_MIN_COORDS - 32,
+                              params_replicated=True)
+    assert below.vote_buckets == 1
+
+
+def test_vote_buckets_world_one_stays_monolithic():
+    """W=1 has no wire to pipeline: even an enormous ballot keeps the
+    single-collective graph."""
+    mesh1 = make_mesh(data=1, devices=jax.devices()[:1])
+    r = resolve_auto_comm(
+        TrainConfig(wire="sign_psum", vote_every=1), mesh1,
+        10 * AUTO_BUCKET_MIN_COORDS, params_replicated=True)
+    assert r.vote_buckets == 1
+
+
+def test_explicit_vote_buckets_one_is_preserved(mesh8):
+    """--vote_buckets 1 is an operator decision, not a sentinel: auto must
+    never re-bucket it however large the ballot."""
+    cfg = TrainConfig(wire="packed_a2a", vote_every=1, vote_buckets=1)
+    assert resolve_auto_comm(cfg, mesh8, 10 * AUTO_BUCKET_MIN_COORDS,
+                             params_replicated=True) is cfg
 
 
 def test_trainer_resolves_and_steps_with_auto_recipe(mesh8):

@@ -1,0 +1,87 @@
+"""scripts/validate_metrics.py single-document artifact validation: the
+REAL writers (telemetry.write_crash_bundle, checkpoint.write_manifest)
+produce artifacts the validator accepts, and hand-broken variants — the
+bare NaN token, missing required keys, a bogus digest — are rejected. One
+validator for every JSON artifact the repo writes."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax.numpy as jnp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "validate_metrics.py")
+
+
+def _run(*paths):
+    return subprocess.run([sys.executable, SCRIPT, *map(str, paths)],
+                          capture_output=True, text=True)
+
+
+def test_real_crash_bundle_validates(tmp_path):
+    from distributed_lion_tpu.train.telemetry import write_crash_bundle
+
+    params = {"w": jnp.array([1.0, float("nan")])}
+    crash_dir = write_crash_bundle(
+        str(tmp_path), 7, "non-finite loss=nan at step 7",
+        {"lion": True, "learning_rate": 1e-4}, params, {"m": params["w"]},
+        [{"step": 6, "loss": 2.5}])
+    bundle = pathlib.Path(crash_dir) / "bundle.json"
+    r = _run(bundle)
+    assert r.returncode == 0, r.stdout
+
+
+def test_real_manifest_validates(tmp_path):
+    from distributed_lion_tpu.train.checkpoint import write_manifest
+
+    sdir = tmp_path / "42"
+    sdir.mkdir()
+    (sdir / "leaf.bin").write_bytes(b"\x00" * 64)
+    write_manifest(sdir, 42, meta={"world": 8, "tag": "periodic"})
+    r = _run(sdir / "manifest.json")
+    assert r.returncode == 0, r.stdout
+
+
+def test_nan_token_rejected_in_doc(tmp_path):
+    p = tmp_path / "bundle.json"
+    p.write_text('{"step": 1, "reason": "x", "config": {}, "loss": NaN}\n')
+    r = _run(p)
+    assert r.returncode == 1 and "NaN" in r.stdout
+
+
+def test_missing_required_keys_rejected(tmp_path):
+    p = tmp_path / "bundle.json"
+    p.write_text('{"step": 1}\n')
+    r = _run(p)
+    assert r.returncode == 1 and "reason" in r.stdout
+
+
+def test_bad_manifest_digest_rejected(tmp_path):
+    p = tmp_path / "manifest.json"
+    p.write_text(json.dumps({
+        "format": 1, "step": 3,
+        "files": {"leaf.bin": {"sha256": "nothex", "bytes": 64}}}) + "\n")
+    r = _run(p)
+    assert r.returncode == 1 and "sha256" in r.stdout
+
+
+def test_unknown_json_doc_still_strict(tmp_path):
+    """Any other *.json gets the strict parse + object check, nothing
+    more (no schema guessing)."""
+    ok = tmp_path / "meta.json"
+    ok.write_text('{"tokens": 123}\n')
+    assert _run(ok).returncode == 0
+    bad = tmp_path / "meta2.json"
+    bad.write_text('{"v": Infinity}\n')
+    assert _run(bad).returncode == 1
+
+
+def test_mixed_jsonl_and_doc_arguments(tmp_path):
+    jl = tmp_path / "metrics.jsonl"
+    jl.write_text('{"step": 1, "loss": 2.0}\n')
+    doc = tmp_path / "bundle.json"
+    doc.write_text('{"step": 1, "reason": "r", "config": {}}\n')
+    assert _run(jl, doc).returncode == 0
