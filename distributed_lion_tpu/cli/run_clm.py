@@ -452,12 +452,56 @@ def main(argv=None):
               + f"{trainer.cfg.wire}); drained every "
               f"{train_cfg.logging_steps} steps"
               + (", NaN sentinel armed" if train_cfg.nan_sentinel else ""))
+    if train_cfg.vote_guard != "off":
+        world = trainer.world
+        # the guard's OWN resolved quorum — never re-derive the auto rule
+        quorum = trainer._guard.min_quorum
+        print(f"[run_clm] vote guard {train_cfg.vote_guard.upper()}: "
+              f"per-worker ballot health inside the step (nonfinite / "
+              f"frozen / outlier), quarantine after {train_cfg.guard_strikes}"
+              f" strikes, readmission probe after {train_cfg.guard_cooldown} "
+              f"steps, refusing below quorum {quorum}/{world}"
+              + ("" if train_cfg.vote_guard == "enforce"
+                 else " (observe: elections untouched)"))
     native = make_native_pipeline(
         data_args, train_cfg.block_size, model_cfg.vocab_size,
         trainer.global_train_batch(), train_cfg.seed,
     )
     if native is not None:
         it, eval_blocks, _loader = native
+        # stamp the SERVED shard fleet into every checkpoint's manifest
+        # meta: block indexing is a pure function of this list, so a
+        # resumed run must see the identical fleet or its deterministic
+        # replay (the batches_consumed fast-forward) silently streams
+        # different data than the original run consumed
+        trainer.data_meta["data_shards"] = _loader.shards
+        if trainer.step_count > 0:
+            meta = (trainer.checkpointer.manifest_meta(trainer.step_count)
+                    if trainer.checkpointer and train_cfg.ckpt_integrity
+                    else None) or {}
+            old = meta.get("data_shards")
+            if old is not None and list(old) != list(_loader.shards):
+                raise RuntimeError(
+                    f"resuming from step {trainer.step_count} but the "
+                    f"served shard fleet changed: checkpoint recorded "
+                    f"{old}, this run would serve {_loader.shards} "
+                    f"(skipped: {_loader.skipped_shards}); the "
+                    "deterministic data replay would diverge from the "
+                    "original run. Restore the original shards (or start "
+                    "fresh with --resume_from_checkpoint false / a new "
+                    "--output_dir)")
+            if old is None and _loader.skipped_shards:
+                # pre-stamp checkpoint (or integrity off): the original
+                # fleet is unknown and THIS run's fleet just shrank —
+                # refuse conservatively rather than risk a divergent replay
+                raise RuntimeError(
+                    f"resuming from step {trainer.step_count} but "
+                    f"{len(_loader.skipped_shards)} shard(s) failed to "
+                    f"load ({_loader.skipped_shards}) and the checkpoint "
+                    "predates shard-fleet stamping — cannot prove the "
+                    "deterministic replay matches. Restore the shard(s) "
+                    "(or start fresh with --resume_from_checkpoint false "
+                    "/ a new --output_dir)")
     else:
         train_blocks, eval_blocks = load_blocks(
             data_args, train_cfg.block_size, model_cfg.vocab_size

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import ctypes
 import pathlib
+import time
 from typing import Iterator, Sequence
 
 import numpy as np
@@ -19,11 +20,47 @@ from distributed_lion_tpu import native
 
 _DTYPES = {np.dtype(np.uint16): 2, np.dtype(np.uint32): 4}
 
+# shard-open retry schedule: transient I/O (flaky NFS/FUSE mounts, a shard
+# mid-upload) gets RETRIES attempts with exponential backoff before the
+# shard is declared corrupt and SKIPPED (loudly, with a metrics counter) —
+# a dead shard must cost its blocks, not the epoch.
+SHARD_RETRIES = 3
+SHARD_BACKOFF_S = 0.05
+
+
+class CorruptShardError(OSError):
+    """A shard failed validation/open after the retry budget."""
+
+
+def _validate_shard(path: pathlib.Path, dtype_bytes: int) -> None:
+    """Cheap structural checks BEFORE the C++ mmap: readable, token-width
+    aligned ("at least one full block across the fleet" stays dl_open's
+    check). Raises OSError/CorruptShardError on failure."""
+    size = path.stat().st_size
+    if size == 0:
+        raise CorruptShardError(f"{path}: empty shard")
+    if size % dtype_bytes:
+        raise CorruptShardError(
+            f"{path}: {size} bytes is not a multiple of the {dtype_bytes}"
+            "-byte token width (torn write or wrong --bin_dtype)")
+    with open(path, "rb") as f:  # readability probe (mmap comes later)
+        f.read(dtype_bytes)
+
 
 class NativeTokenLoader:
     """Mmap'd `.bin` token shards cut into fixed blocks, served by a C++
     prefetch thread. The per-shard tail below one block is dropped (each
-    shard is packed independently, the usual sharded-pretraining layout)."""
+    shard is packed independently, the usual sharded-pretraining layout).
+
+    Robustness: every shard is validated (with retry + exponential backoff
+    for transient I/O) before the native open; a shard that stays unreadable
+    or misaligned is SKIPPED with a loud warning instead of killing the run,
+    and the count rides the trainer's strict-JSON metrics stream as
+    ``skipped_shards`` (``health_metrics``). Only when EVERY shard is bad
+    does construction raise. Caveat: skipping a shard shifts every global
+    block index, so a CHECKPOINT-RESUMED run must not proceed over a
+    shrunken fleet (the deterministic replay would stream different data)
+    — cli/run_clm refuses that combination loudly."""
 
     def __init__(
         self,
@@ -36,7 +73,31 @@ class NativeTokenLoader:
         dtype_bytes = _DTYPES.get(np.dtype(dtype))
         if dtype_bytes is None:
             raise ValueError(f"dtype must be uint16 or uint32, got {dtype}")
-        enc = [str(p).encode() for p in paths]
+        self.skipped_shards: list[str] = []
+        self.read_retries = 0
+        good: list[str] = []
+        last_err: Exception | None = None
+        for p in paths:
+            path = pathlib.Path(p)
+            try:
+                _with_retries(lambda: _validate_shard(path, dtype_bytes),
+                              on_retry=self._count_retry)
+                good.append(str(path))
+            except Exception as e:
+                last_err = e
+                self.skipped_shards.append(str(path))
+                print(f"[native_loader] WARNING: skipping corrupt/unreadable"
+                      f" shard {path} after {SHARD_RETRIES + 1} attempts: "
+                      f"{e}")
+        if not good:
+            raise CorruptShardError(
+                f"all {len(self.skipped_shards)} shard(s) failed validation;"
+                f" last error: {last_err}")
+        # the fleet actually served, in order — block indexing is a pure
+        # function of this list, so resume-consistency checks compare it
+        # against the list recorded at checkpoint time (cli/run_clm)
+        self.shards = good
+        enc = [s.encode() for s in good]
         arr = (ctypes.c_char_p * len(enc))(*enc)
         self._h = self._lib.dl_open(arr, len(enc), dtype_bytes, self.block_size)
         if not self._h:
@@ -44,6 +105,16 @@ class NativeTokenLoader:
 
     def __len__(self) -> int:
         return int(self._lib.dl_num_blocks(self._h))
+
+    def health_metrics(self) -> dict:
+        """Loader-health counters for the trainer's metrics stream (strict
+        JSON scalars — scripts/validate_metrics.py validates the log).
+        ``shard_read_retries`` counts transient-I/O retries during shard
+        validation/open (post-open reads are mmap'd — the page cache, not
+        the I/O stack, serves them, so open time is where flakiness
+        shows)."""
+        return {"skipped_shards": len(self.skipped_shards),
+                "shard_read_retries": self.read_retries}
 
     def read_block(self, idx: int) -> np.ndarray:
         out = np.empty(self.block_size, np.int32)
@@ -53,6 +124,9 @@ class NativeTokenLoader:
         if not ok:
             raise IndexError(self._lib.dl_last_error().decode())
         return out
+
+    def _count_retry(self) -> None:
+        self.read_retries += 1
 
     def read_blocks(self, start: int, stop: int) -> np.ndarray:
         return np.stack([self.read_block(i) for i in range(start, stop)])
@@ -121,6 +195,28 @@ class NativeTokenLoader:
             pass
 
 
+def _with_retries(fn, on_retry=None):
+    """Run ``fn`` with the shard retry schedule: SHARD_RETRIES retries with
+    exponential backoff starting at SHARD_BACKOFF_S. Structural corruption
+    (CorruptShardError) is re-raised immediately — a misaligned file will
+    not heal by waiting; only transient I/O earns the backoff."""
+    delay = SHARD_BACKOFF_S
+    for attempt in range(SHARD_RETRIES + 1):
+        try:
+            return fn()
+        except (CorruptShardError, IndexError):
+            # structural corruption / out-of-range: deterministic, no point
+            # sleeping on it (and no phantom 'transient retry' counters)
+            raise
+        except Exception:
+            if attempt == SHARD_RETRIES:
+                raise
+            if on_retry is not None:
+                on_retry()
+            time.sleep(delay)
+            delay *= 2
+
+
 class _NativeBatches:
     """Deferred-start iterator over a :class:`NativeTokenLoader`: records
     ``skip(n)`` calls until the first ``next()``, then starts the C++
@@ -137,6 +233,11 @@ class _NativeBatches:
         if self._gen is not None:
             raise RuntimeError("cannot skip after iteration started")
         self._skip += int(n)
+
+    def health_metrics(self) -> dict:
+        """Forwarded loader-health counters — the trainer merges them into
+        its metrics stream when the train iterator exposes this hook."""
+        return self._loader.health_metrics()
 
     def __iter__(self) -> "_NativeBatches":
         return self

@@ -1,6 +1,7 @@
 from distributed_lion_tpu.optim.lion import lion, LionState
 from distributed_lion_tpu.optim.distributed_lion import (
     distributed_lion,
+    heal_worker_momentum,
     init_global_state,
     remap_worker_momentum,
     squeeze_worker_state,

@@ -44,7 +44,12 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_lion_tpu.ops import lion_math
-from distributed_lion_tpu.ops.codec import bucket_bounds, vote_chunk_elems
+from distributed_lion_tpu.ops.codec import (
+    bucket_bounds,
+    pack_signs,
+    packed_size,
+    vote_chunk_elems,
+)
 from distributed_lion_tpu.optim.lion import (
     FunctionalOptimizer,
     LionState,
@@ -96,6 +101,35 @@ def _bucket_windows(bounds, sizes):
     return out
 
 
+def _guard_ballot_len(n: int, vote_every: int) -> int:
+    """uint8 bytes of the guard's previous-ballot state: the elected-cache
+    per-slot layout under lazy refresh (so the refreshed slot's bytes line
+    up across steps), plain bit-packing otherwise. Single source of truth
+    for init, init_global_state and the trainer's restore templates."""
+    if vote_every > 1:
+        return vote_every * vote_chunk_elems(n, vote_every) // 8
+    return packed_size(n)
+
+
+def _ballot_flips(packed_now: jnp.ndarray,
+                  packed_prev: jnp.ndarray) -> jnp.ndarray:
+    """Bit flips between two packed ballots: popcount of the XOR, summed.
+    ≈ 0 across consecutive (re)votes is the frozen-voter signature."""
+    xor = jnp.bitwise_xor(packed_now, packed_prev)
+    return jnp.sum(lax.population_count(xor).astype(jnp.int32))
+
+
+def _nonfinite_count(grads, exp_avg) -> jnp.ndarray:
+    """i32 count of nonfinite elements in this worker's LOCAL grads and
+    momentum — the ballot inputs, checked BEFORE sign-encoding (a NaN
+    u-term silently votes −1: ``NaN > 0`` is False)."""
+    tot = jnp.zeros((), jnp.int32)
+    for leaf in jax.tree.leaves(grads) + jax.tree.leaves(exp_avg):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            tot = tot + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.int32)
+    return tot
+
+
 def distributed_lion(
     learning_rate: Schedule = 1e-4,
     b1: float = 0.9,
@@ -110,6 +144,7 @@ def distributed_lion(
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
     telemetry: bool = False,
+    guard: str = "off",
 ) -> FunctionalOptimizer:
     """Build the majority-vote Lion optimizer.
 
@@ -162,6 +197,27 @@ def distributed_lion(
             ``VoteHealth`` accumulator. Telemetry only OBSERVES the vote:
             elections, params and momentum are bit-identical to
             ``telemetry=False`` (pinned by tests/test_telemetry.py).
+        guard: the vote guard (Byzantine-tolerant elections). ``'off'`` —
+            no guard state, no extra outputs. ``'observe'`` / ``'enforce'``
+            → ``LionState`` carries a ``[W]`` health mask + the packed
+            previous LOCAL ballot, and ``step`` returns an extra *guard
+            frame* (after the telemetry frame when both are on): per-worker
+            nonfinite-input counts, ballot-flip counts vs the previous vote
+            (popcount XOR — a ≈0 count is a frozen voter), and local-ballot
+            disagreement fractions, each a replicated ``[W]`` vector built
+            from two one-hot scalar psums. Under ``'enforce'`` the election
+            additionally EXCLUDES workers whose ``state.health`` bit is
+            False (collectives masked vote — the majority threshold shrinks
+            to the healthy quorum) and nonfinite local gradients are zeroed
+            out of the momentum update so a transient NaN batch cannot
+            poison ``exp_avg`` forever. With an all-healthy mask and finite
+            inputs, 'enforce' is bit-identical to 'off' in elections,
+            params and momentum (tests/test_vote_guard.py pins this across
+            all four wires × vote_buckets × det/stoch × XLA/Pallas).
+            ``'observe'`` computes the same signals but never touches the
+            election. The quarantine decisions themselves (strikes,
+            cooldown, readmission healing) live in the trainer's host-side
+            state machine (train/vote_guard.py).
 
     Returns:
         A :class:`FunctionalOptimizer` whose ``step`` MUST be traced inside
@@ -187,6 +243,12 @@ def distributed_lion(
                 "telemetry instruments the vote; with axis_name=None there "
                 "is no election to observe — use lion() for local training"
             )
+        if guard != "off":
+            raise ValueError(
+                "the vote guard protects the election; with axis_name=None "
+                "there is no election to guard — use lion() for local "
+                "training"
+            )
         return lion(learning_rate, b1, b2, weight_decay, mom_dtype)
 
     _validate(learning_rate if not callable(learning_rate) else None, b1, b2)
@@ -194,6 +256,11 @@ def distributed_lion(
         raise ValueError(f"vote_every must be >= 1, got {vote_every}")
     if vote_buckets < 1:
         raise ValueError(f"vote_buckets must be >= 1, got {vote_buckets}")
+    if guard not in ("off", "observe", "enforce"):
+        raise ValueError(
+            f"guard must be 'off', 'observe' or 'enforce', got {guard!r}")
+    guard_on = guard != "off"
+    enforce = guard == "enforce"
     stochastic = max_grad_norm is not None
     from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
 
@@ -211,17 +278,49 @@ def distributed_lion(
         exp_avg = jax.tree.map(
             lambda p: jnp.zeros_like(p, dtype=mom_dtype or p.dtype), params
         )
+        n = sum(p.size for p in jax.tree.leaves(params))
         elected = None
         if vote_every > 1:
-            n = sum(p.size for p in jax.tree.leaves(params))
             chunk = vote_chunk_elems(n, vote_every)
             elected = jnp.zeros((vote_every * chunk // 8,), jnp.uint8)
+        prev_ballot = None
+        if guard_on:
+            # the frozen-ballot detector's XOR base: the packed previous
+            # LOCAL ballot, laid out like the elected cache under lazy
+            # refresh (per-slot byte-aligned chunks) so the refreshed slot's
+            # bytes line up across steps
+            prev_ballot = jnp.zeros((_guard_ballot_len(n, vote_every),),
+                                    jnp.uint8)
+        # health is created by init_global_state (its [world] length is
+        # unknown at worker level); None means "mask everything in"
         return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg,
-                         rng=rng, elected=elected)
+                         rng=rng, elected=elected, prev_ballot=prev_ballot)
 
-    def _step_pallas(params, grads, state: LionState):
+    def _guard_frame(w, nf, flips, flip_valid, dis_frac, voted):
+        """Assemble the per-step guard frame: the three per-worker scalars
+        become replicated ``[W]`` vectors via one one-hot psum each — the
+        only collectives the guard adds to the step (all O(W) scalars; no
+        host traffic, the trainer reads them one dispatch behind)."""
+        widx = lax.axis_index(axis_name)
+        onehot = jnp.arange(w, dtype=jnp.int32) == widx
+
+        def vec(x):
+            return lax.psum(jnp.where(onehot, x, jnp.zeros_like(x)),
+                            axis_name)
+
+        return {
+            "nonfinite": vec(nf),        # i32[W] local nonfinite counts
+            "flips": vec(flips),         # i32[W] ballot bit flips vs prev
+            "flip_valid": flip_valid,    # bool: prev ballot was a real vote
+            "disagree": vec(dis_frac),   # f32[W] local-vs-elected fraction
+            "voted": jnp.asarray(voted, jnp.int32),  # coords voted
+        }
+
+    def _step_pallas(params, grads, state: LionState, guard_nf=None):
         """Fused-kernel fast path: per-window VMEM kernels + the bucketed,
-        software-pipelined vote wire.
+        software-pipelined vote wire. ``guard_nf`` is the pre-sanitize
+        nonfinite count ``step`` measured (the guard's NaN signal must see
+        the raw gradients; enforce mode zeroes them before this path).
 
         The pytree is addressed through a persistent flat-offset layout —
         leaf offsets are Python ints fixed at trace time — and the kernels
@@ -251,10 +350,18 @@ def distributed_lion(
         bounds = bucket_bounds(n, vote_buckets, w, wire)
         if not bounds:  # zero-coordinate pytree: nothing to vote or apply
             out_state = LionState(state.count + 1, state.exp_avg,
-                                  state.rng, state.elected)
+                                  state.rng, state.elected,
+                                  state.health, state.prev_ballot)
+            out = (params, out_state)
             if telemetry:
-                return params, out_state, _vt.empty_frame(0)
-            return params, out_state
+                out = out + (_vt.empty_frame(0),)
+            if guard_on:
+                out = out + (_guard_frame(
+                    w, jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                    jnp.asarray(False, jnp.bool_),
+                    jnp.zeros((), jnp.float32), 0),)
+            return out
+        alive = state.health if enforce else None
         windows = _bucket_windows(bounds, sizes)
         pieces: list[list] = [[] for _ in sizes]  # per-leaf, in flat order
 
@@ -284,17 +391,27 @@ def distributed_lion(
         hist_acc = jnp.zeros((_vt.NBINS,), jnp.int32) if telemetry else None
         dis_acc = jnp.zeros((), jnp.int32) if telemetry else None
         packed_parts: list = []
-        if telemetry:
-            from distributed_lion_tpu.ops.codec import pack_signs
+        # guard accumulators: the packed LOCAL ballot (flip detection) and
+        # the local-vs-elected disagreement count, folded per bucket from
+        # arrays the pipeline already has in registers/VMEM. The mask is
+        # applied to the bucket ballot BEFORE the collective (inside
+        # vote_total — a quarantined worker's int8 ballots become zeros on
+        # the wire), never to the guard's own observation of them.
+        guard_packed: list = []
+        guard_dis = jnp.zeros((), jnp.int32) if guard_on else None
         for k in range(len(bounds)):
             ballots = _bucket_ballots(k)
             totals.append(collectives.vote_total(
-                ballots > 0, axis_name, wire))
+                ballots > 0, axis_name, wire, alive))
             if telemetry:
                 h, d = pallas_lion.bucket_vote_stats(
                     ballots, totals[k], w, _vt.NBINS, interpret=interpret)
                 hist_acc, dis_acc = hist_acc + h, dis_acc + d
                 packed_parts.append(pack_signs(totals[k] > 0))
+            if guard_on:
+                guard_packed.append(pack_signs(ballots > 0))
+                guard_dis = guard_dis + jnp.sum(
+                    ((ballots > 0) != (totals[k] > 0)).astype(jnp.int32))
             if k:  # apply k−1 while bucket k's collective is in flight
                 _bucket_apply(k - 1, totals[k - 1])
         _bucket_apply(len(bounds) - 1, totals[-1])
@@ -308,6 +425,19 @@ def distributed_lion(
 
         new_p = [_join(ws, p, 0) for ws, p in zip(pieces, p_leaves)]
         new_m = [_join(ws, m, 1) for ws, m in zip(pieces, m_leaves)]
+        new_prev = state.prev_ballot
+        gframe = None
+        if guard_on:
+            # bucket boundaries are byte-aligned for every wire, so the
+            # per-bucket packed ballots concatenate to the full vector
+            packed_now = (guard_packed[0] if len(guard_packed) == 1
+                          else jnp.concatenate(guard_packed))
+            gframe = _guard_frame(
+                w, guard_nf,
+                _ballot_flips(packed_now, state.prev_ballot),
+                state.count >= 1,
+                guard_dis.astype(jnp.float32) / n, n)
+            new_prev = packed_now
         out = (
             jax.tree.unflatten(treedef, new_p),
             # this path is gated to vote_every == 1, where the elected-sign
@@ -315,10 +445,10 @@ def distributed_lion(
             # not "elected may be dropped": a future un-gating must not
             # silently lose the cache
             LionState(state.count + 1, jax.tree.unflatten(treedef, new_m),
-                      state.rng, state.elected),
+                      state.rng, state.elected, state.health, new_prev),
         )
         if not telemetry:
-            return out
+            return out if gframe is None else out + (gframe,)
         frame = {
             "margin_hist": (hist_acc if wire_has_tally
                             else jnp.zeros((_vt.NBINS,), jnp.int32)),
@@ -332,15 +462,16 @@ def distributed_lion(
             # gated to vote_every == 1: every step is a full re-election
             "flip_valid": jnp.asarray(True, jnp.bool_),
         }
-        return out + (frame,)
+        return out + (frame,) if gframe is None else out + (frame, gframe)
 
-    def _elect_lazy(flat_votes, state: LionState):
+    def _elect_lazy(flat_votes, state: LionState, alive=None):
         """vote_every > 1: vote the rotating slice, refresh the packed sign
         cache, return (full elected bools, update-validity mask, new cache,
-        telemetry aux). The aux — (slice ballots, slice totals, slice
-        elections, real-coordinate mask over the padded slice) — feeds the
-        vote-health frame; it is dead code XLA prunes when telemetry is
-        off."""
+        telemetry aux, refreshed guard prev-ballot or None). The aux —
+        (slice ballots, slice totals, slice elections, real-coordinate mask
+        over the padded slice) — feeds the vote-health frame; it is dead
+        code XLA prunes when telemetry is off. ``alive`` masks quarantined
+        workers out of the slice election (the guard's enforce mode)."""
         from distributed_lion_tpu.ops.codec import pack_signs, unpack_signs
 
         n = flat_votes.shape[0]
@@ -353,11 +484,19 @@ def distributed_lion(
         # the rotating 1/K slice votes bucket-wise too: same elected bits,
         # but the slice's wire splits into vote_buckets pipelineable chunks
         totals_sl = collectives.vote_total_bucketed(
-            sl, axis_name, wire, vote_buckets)
+            sl, axis_name, wire, vote_buckets, alive)
         elected_sl = totals_sl > 0
         new_cache = lax.dynamic_update_slice(
             state.elected, pack_signs(elected_sl), (slot * chunk // 8,)
         )
+        new_prev = None
+        if guard_on:
+            # the guard's prev-ballot cache mirrors the elected cache's
+            # slot layout, so XOR-ing old vs new isolates this slot's
+            # flips (only its bytes change) against the SAME slot's ballot
+            # one full rotation (K steps) ago
+            new_prev = lax.dynamic_update_slice(
+                state.prev_ballot, pack_signs(sl), (slot * chunk // 8,))
         bits = unpack_signs(new_cache, (vote_every * chunk,))
         # cold start: slot j is first voted at count == j, so until then its
         # coordinates get no update (replicas agree — count is shared)
@@ -366,7 +505,7 @@ def distributed_lion(
         # only the LAST slot can run past n: alignment pads the slice there
         mask_sl = (slot * chunk + jnp.arange(chunk, dtype=jnp.int32)) < n
         return bits[:n], valid[:n], new_cache, (sl, totals_sl, elected_sl,
-                                                mask_sl)
+                                                mask_sl), new_prev
 
     def _make_frame(local, totals, elected, *, mask, voted, valid,
                     elected_packed, flip_valid):
@@ -398,11 +537,27 @@ def distributed_lion(
         # grad → momentum-dtype cast, hoisted ONCE for both kernel paths
         # (the Pallas path used to re-cast internally after this cast)
         grads = jax.tree.map(lambda g, m: g.astype(m.dtype), grads, state.exp_avg)
+        guard_nf = None
+        if guard_on:
+            # nonfinite ballot inputs, measured BEFORE enforce's sanitize
+            # (and before sign-encoding hides them: NaN u-terms vote −1)
+            guard_nf = _nonfinite_count(grads, state.exp_avg)
+        if enforce:
+            # degraded-mode training: a poisoned worker's nonfinite grad
+            # coordinates are zeroed so they can neither poison its local
+            # momentum forever nor steer its ballot; with finite grads
+            # where() is the identity, preserving the all-healthy
+            # bit-identity contract
+            grads = jax.tree.map(
+                lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)),
+                grads)
         if interpret is not None and not stochastic and vote_every == 1:
             p_dtypes = {p.dtype for p in jax.tree.leaves(params)}
             m_dtypes = {m.dtype for m in jax.tree.leaves(state.exp_avg)}
             if len(p_dtypes) == 1 and len(m_dtypes) == 1:
-                return _step_pallas(params, grads, state)
+                return _step_pallas(params, grads, state, guard_nf)
+        alive = state.health if enforce else None
+        w_guard = collectives.axis_size(axis_name) if guard_on else None
         lr = resolve_lr(learning_rate, state.count)
 
         # 1) weight decay, multiplicatively, before the update (ref :64).
@@ -432,10 +587,12 @@ def distributed_lion(
         #    same function majority_vote_bucketed computes.
         flat = _flatten_votes(votes)
         new_cache = state.elected
+        new_prev = state.prev_ballot
         frame = None
+        gframe = None
         if vote_every == 1:
             totals = collectives.vote_total_bucketed(
-                flat, axis_name, wire, vote_buckets)
+                flat, axis_name, wire, vote_buckets, alive)
             elected = totals > 0
             elected_tree = _split_votes(elected, votes)
             # 4) apply the elected ±1 update (ref :91-92). The psum output is
@@ -450,16 +607,26 @@ def distributed_lion(
                                     valid=jnp.asarray(flat.shape[0],
                                                       jnp.int32),
                                     elected_packed=None, flip_valid=True)
+            if guard_on:
+                packed_now = pack_signs(flat)
+                gframe = _guard_frame(
+                    w_guard, guard_nf,
+                    _ballot_flips(packed_now, state.prev_ballot),
+                    state.count >= 1,
+                    jnp.mean((flat != elected).astype(jnp.float32)),
+                    flat.shape[0])
+                new_prev = packed_now
         else:
-            elected, valid, new_cache, aux = _elect_lazy(flat, state)
+            elected, valid, new_cache, aux, lazy_prev = _elect_lazy(
+                flat, state, alive)
             signs = jnp.where(elected, 1.0, -1.0) * valid
             signs_tree = _split_votes(signs, votes)
             new_params = jax.tree.map(
                 lambda p, s: p - jnp.asarray(lr, p.dtype) * s.astype(p.dtype),
                 decayed, signs_tree,
             )
+            sl, totals_sl, elected_sl, mask_sl = aux
             if telemetry:
-                sl, totals_sl, elected_sl, mask_sl = aux
                 frame = _make_frame(
                     sl, totals_sl, elected_sl, mask=mask_sl,
                     voted=jnp.sum(mask_sl.astype(jnp.int32)),
@@ -469,6 +636,21 @@ def distributed_lion(
                     # full rotation its cache bytes are the zero init, not
                     # a previous election
                     flip_valid=state.count >= vote_every)
+            if guard_on:
+                voted_sl = jnp.sum(mask_sl.astype(jnp.int32))
+                dis_sl = jnp.sum(((sl != elected_sl) & mask_sl)
+                                 .astype(jnp.int32))
+                gframe = _guard_frame(
+                    w_guard, guard_nf,
+                    _ballot_flips(lazy_prev, state.prev_ballot),
+                    # the refreshed slot's previous ballot is real only
+                    # after a full rotation (same cold start as the flip
+                    # telemetry)
+                    state.count >= vote_every,
+                    dis_sl.astype(jnp.float32)
+                    / jnp.maximum(voted_sl, 1).astype(jnp.float32),
+                    voted_sl)
+                new_prev = lazy_prev
         if telemetry and stochastic:
             # quantizer noise: how often the stochastic ballot differs from
             # the deterministic sign it replaces (full-ballot local mean)
@@ -478,14 +660,20 @@ def distributed_lion(
             frame["stoch_flip_frac"] = jnp.mean(
                 (flat != det_flat).astype(jnp.float32))
 
-        # 5) momentum with the LOCAL gradient — divergent by design (ref :96).
+        # 5) momentum with the LOCAL gradient — divergent by design (ref :96;
+        #    under enforce the gradient was already nonfinite-sanitized, so
+        #    one NaN batch cannot poison exp_avg forever).
         new_m = jax.tree.map(
             lambda g, m: lion_math.momentum_update(g, m, b2), grads, state.exp_avg
         )
-        out_state = LionState(state.count + 1, new_m, state.rng, new_cache)
+        out_state = LionState(state.count + 1, new_m, state.rng, new_cache,
+                              state.health, new_prev)
+        out = (new_params, out_state)
         if telemetry:
-            return new_params, out_state, frame
-        return new_params, out_state
+            out = out + (frame,)
+        if guard_on:
+            out = out + (gframe,)
+        return out
 
     return FunctionalOptimizer(init=init, step=step)
 
@@ -509,21 +697,33 @@ def init_global_state(opt: FunctionalOptimizer, params, world: int,
     )
     elected = (None if st_shapes.elected is None
                else jnp.zeros(st_shapes.elected.shape, st_shapes.elected.dtype))
+    # guard state: the per-worker previous ballot stacks [world, bytes] like
+    # the momenta; the health mask is replicated [world], all-healthy at init
+    prev_ballot = (None if st_shapes.prev_ballot is None
+                   else jnp.zeros((world,) + st_shapes.prev_ballot.shape,
+                                  st_shapes.prev_ballot.dtype))
+    health = (None if st_shapes.prev_ballot is None
+              else jnp.ones((world,), jnp.bool_))
     return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng,
-                     elected=elected)
+                     elected=elected, health=health, prev_ballot=prev_ballot)
 
 
 def squeeze_worker_state(state: LionState) -> LionState:
-    """Inside shard_map: drop this worker's leading [1] momentum axis (the
-    elected-sign cache is replicated and passes through)."""
+    """Inside shard_map: drop this worker's leading [1] momentum (and guard
+    prev-ballot) axis; the elected-sign cache and health mask are replicated
+    and pass through."""
     return LionState(state.count, jax.tree.map(lambda m: m[0], state.exp_avg),
-                     state.rng, state.elected)
+                     state.rng, state.elected, state.health,
+                     None if state.prev_ballot is None
+                     else state.prev_ballot[0])
 
 
 def expand_worker_state(state: LionState) -> LionState:
     """Inside shard_map: restore the leading [1] axis before returning."""
     return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg),
-                     state.rng, state.elected)
+                     state.rng, state.elected, state.health,
+                     None if state.prev_ballot is None
+                     else state.prev_ballot[None])
 
 
 def remap_worker_momentum(exp_avg, old_world: int, new_world: int):
@@ -573,3 +773,37 @@ def remap_worker_momentum(exp_avg, old_world: int, new_world: int):
         return out.astype(m.dtype)
 
     return jax.tree.map(_remap, exp_avg)
+
+
+def heal_worker_momentum(exp_avg, healthy, workers):
+    """Reset quarantined/healed workers' momenta to the HEALTHY mean.
+
+    The vote guard's readmission (and elastic resume over a checkpoint with
+    quarantined workers) must not let a sick worker's stale or poisoned
+    momentum re-enter the election: each worker in ``workers`` gets the mean
+    of the momenta whose ``healthy`` bit is True — the center of the healthy
+    vote distribution, the same quantity :func:`remap_worker_momentum`
+    preserves. The healed clone re-diverges immediately through its own
+    gradients. Reductions run in f32 and cast back (same precision rule as
+    the remap).
+
+    Args:
+        exp_avg: stacked ``[W, ...]`` momentum pytree (outside shard_map).
+        healthy: ``[W]`` bool mask of momenta trusted as the mean's source.
+        workers: iterable of worker indices to overwrite.
+    """
+    healthy = jnp.asarray(healthy, jnp.bool_)
+    workers = [int(w) for w in workers]
+    denom = jnp.maximum(jnp.sum(healthy.astype(jnp.float32)), 1.0)
+
+    def _heal(m):
+        f32 = jnp.asarray(m, jnp.float32)
+        wmask = healthy.astype(jnp.float32).reshape(
+            (-1,) + (1,) * (f32.ndim - 1))
+        mean = jnp.sum(f32 * wmask, axis=0) / denom
+        out = f32
+        for w in workers:
+            out = out.at[w].set(mean)
+        return out.astype(m.dtype)
+
+    return jax.tree.map(_heal, exp_avg)

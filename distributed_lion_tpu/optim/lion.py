@@ -36,6 +36,17 @@ class LionState(NamedTuple):
     elected: Optional[jnp.ndarray] = None  # packed uint8 elected-sign cache
     # (replicated); present only under vote_every > 1 lazy refresh — holds the
     # last elected sign for every coordinate, 1 bit/param of state
+    health: Optional[jnp.ndarray] = None  # [world] bool worker-health mask
+    # (replicated); present only under the vote guard (guard != 'off') —
+    # True = this worker's ballots count in the election, False = it is
+    # quarantined and abstains (parallel.collectives masked vote_total).
+    # Updated by the trainer's host-side quarantine machine between
+    # dispatches; the step only consumes it.
+    prev_ballot: Optional[jnp.ndarray] = None  # packed uint8 LOCAL ballot of
+    # the previous (re)vote, per-worker divergent state like exp_avg (stored
+    # globally stacked [world, bytes], sharded over the data axis) — the
+    # frozen-ballot detector's XOR base. Shaped like the elected cache under
+    # vote_every > 1 (per-slot byte-aligned layout), packed_size(n) otherwise.
 
 
 def _validate(lr_init: float, b1: float, b2: float) -> None:

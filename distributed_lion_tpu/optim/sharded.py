@@ -22,17 +22,24 @@ from distributed_lion_tpu.optim.lion import FunctionalOptimizer, LionState
 from distributed_lion_tpu.parallel.mesh import DATA_AXIS
 
 
-def state_specs(has_elected: bool = False) -> LionState:
+def state_specs(has_elected: bool = False,
+                has_guard: bool = False) -> LionState:
     """PartitionSpec pytree-prefix for a stacked-momentum LionState. The
-    elected-sign cache (``vote_every > 1``) is replicated when present."""
+    elected-sign cache (``vote_every > 1``) and the guard's health mask are
+    replicated when present; the guard's per-worker previous ballot shards
+    like the momenta."""
     return LionState(count=P(), exp_avg=P(DATA_AXIS), rng=P(),
-                     elected=P() if has_elected else None)
+                     elected=P() if has_elected else None,
+                     health=P() if has_guard else None,
+                     prev_ballot=P(DATA_AXIS) if has_guard else None)
 
 
-def make_sharded_step(opt: FunctionalOptimizer, mesh, has_elected: bool = False):
+def make_sharded_step(opt: FunctionalOptimizer, mesh,
+                      has_elected: bool = False, has_guard: bool = False):
     """Build a jitted step over ``mesh``:
 
     ``(params, stacked_grads, state) -> (new_params, new_state)``
+    — plus a trailing guard frame when ``has_guard``.
 
     - ``params``: replicated pytree.
     - ``stacked_grads``: pytree with leading ``[world]`` axis, sharded over
@@ -43,33 +50,48 @@ def make_sharded_step(opt: FunctionalOptimizer, mesh, has_elected: bool = False)
     - ``state``: from ``init_global_state``, exp_avg sharded over data.
     - ``has_elected``: True when the optimizer was built with
       ``vote_every > 1`` (the state then carries the packed sign cache).
+    - ``has_guard``: True when the optimizer was built with
+      ``guard != 'off'`` — the state carries the health mask + previous
+      ballot and the step returns ``(params, state, guard_frame)``, the
+      frame's replicated [world] health vectors included. (Optimizers
+      built with ``telemetry=True`` need the Trainer: the raw telemetry
+      frame carries per-worker leaves this wrapper cannot declare
+      replicated.)
     """
+    extra = (P(),) if has_guard else ()
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), state_specs(has_elected)),
-        out_specs=(P(), state_specs(has_elected)),
+        in_specs=(P(), P(DATA_AXIS), state_specs(has_elected, has_guard)),
+        out_specs=(P(), state_specs(has_elected, has_guard)) + extra,
         check_vma=False,
     )
     def _step(params, stacked_grads, state):
         grads = jax.tree.map(lambda g: g[0], stacked_grads)
         st = squeeze_worker_state(state)
-        new_params, new_st = opt.step(params, grads, st)
-        return new_params, expand_worker_state(new_st)
+        outs = opt.step(params, grads, st)
+        return (outs[0], expand_worker_state(outs[1])) + tuple(outs[2:])
 
     return jax.jit(_step)
 
 
 def shard_state(state: LionState, mesh) -> LionState:
-    """device_put a stacked state with exp_avg over the data axis."""
+    """device_put a stacked state with exp_avg (and the guard's stacked
+    prev-ballot) over the data axis."""
+    repl = NamedSharding(mesh, P())
     return LionState(
-        count=jax.device_put(state.count, NamedSharding(mesh, P())),
+        count=jax.device_put(state.count, repl),
         exp_avg=jax.tree.map(
             lambda m: jax.device_put(m, NamedSharding(mesh, P(DATA_AXIS))),
             state.exp_avg,
         ),
-        rng=None if state.rng is None else jax.device_put(state.rng, NamedSharding(mesh, P())),
+        rng=None if state.rng is None else jax.device_put(state.rng, repl),
         elected=None if state.elected is None
-        else jax.device_put(state.elected, NamedSharding(mesh, P())),
+        else jax.device_put(state.elected, repl),
+        health=None if state.health is None
+        else jax.device_put(state.health, repl),
+        prev_ballot=None if state.prev_ballot is None
+        else jax.device_put(state.prev_ballot,
+                            NamedSharding(mesh, P(DATA_AXIS))),
     )

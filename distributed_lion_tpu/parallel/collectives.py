@@ -111,7 +111,8 @@ def axis_size(axis_name: str) -> int:
     return lax.psum(1, axis_name)
 
 
-def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
+def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str,
+               alive=None) -> jnp.ndarray:
     """The vote reduction over workers. Every wire satisfies the contract
     callers rely on — ``total > 0`` ⇔ majority True, ``total ≤ 0`` ⇔ elect −1
     (ties → −1, the torch.mode smaller-value rule) — but only ``sign_psum``
@@ -122,6 +123,15 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
     vote-margin metrics without excluding the a2a wire. Single source of
     truth for the XLA and Pallas optimizer paths and both ``majority_vote_*``
     views.
+
+    ``alive`` (optional ``[W]`` bool, replicated — the vote guard's health
+    mask) turns every wire into a **masked election**: workers with
+    ``alive == False`` abstain — their ballots are zeroed out of the tally
+    and the majority threshold shrinks to the healthy quorum (Σ alive), so
+    ``total > 0`` still means "strict majority of the HEALTHY voters" with
+    ties electing −1. With ``alive`` all-True the masked election is
+    bit-identical to ``alive=None`` for every wire (pinned by
+    tests/test_vote_guard.py) — the guard's all-healthy contract.
     """
     w = axis_size(axis_name)
     kind, group = parse_wire(wire)  # raises on unknown formats
@@ -130,6 +140,11 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
         # exactly for |sum| ≤ 127, so promote only for large worlds.
         acc = jnp.int8 if w <= 127 else jnp.int32
         ballots = jnp.where(vote_pos, 1, -1).astype(acc)
+        if alive is not None:
+            # an abstainer ships 0-ballots: it drops out of the on-fabric
+            # sum AND out of the implicit threshold (Σ±1 of the healthy)
+            own = alive[lax.axis_index(axis_name)]
+            ballots = jnp.where(own, ballots, jnp.zeros_like(ballots))
         if w > 1:  # ring all-reduce: received ≈ the tensor once, on-fabric
             WIRE_TALLY.record("ici", ballots.size * ballots.dtype.itemsize)
         return lax.psum(ballots, axis_name)
@@ -142,6 +157,13 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
             WIRE_TALLY.record("ici", w * packed.size)
         gathered = lax.all_gather(packed, axis_name)   # [W, ceil(n/8)] uint8
         bits = unpack_signs(gathered.reshape(-1), (w, gathered.shape[1] * 8))
+        if alive is not None:
+            # every worker holds the full ballot matrix here, so masking is
+            # a row weighting: count over healthy rows, threshold = quorum
+            weights = alive.astype(jnp.int32)
+            count = (bits.astype(jnp.int32)
+                     * weights[:, None]).sum(0)[: vote_pos.shape[0]]
+            return count * 2 - weights.sum()
         count = bits.astype(jnp.int32).sum(0)[: vote_pos.shape[0]]
         return count * 2 - w
     if kind == "packed_a2a":
@@ -149,14 +171,16 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
         # phase 2, so the returned "total" is the ±1 proxy of the elected
         # sign — every caller only tests ``total > 0``, and the tie rule
         # (tie → −1) is applied at the tallying worker in phase 1.
-        return jnp.where(_packed_a2a_elect(vote_pos, axis_name, w), 1, -1)
+        return jnp.where(_packed_a2a_elect(vote_pos, axis_name, w, alive),
+                         1, -1)
     # kind == "hier": per-worker tallies never leave the ICI subgroup, so
     # (like packed_a2a) only a ±1 proxy of the elected sign is available.
-    return jnp.where(_hier_elect(vote_pos, axis_name, w, group), 1, -1)
+    return jnp.where(_hier_elect(vote_pos, axis_name, w, group, alive), 1, -1)
 
 
 def vote_total_buckets(
-    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int,
+    alive=None,
 ) -> list[jnp.ndarray]:
     """The bucketed wire: one *independent* collective per contiguous ballot
     chunk (codec.bucket_bounds — the same boundaries the byte accounting
@@ -171,32 +195,37 @@ def vote_total_buckets(
     bounds = bucket_bounds(vote_pos.shape[0], vote_buckets, w, wire)
     return [
         vote_total(lax.slice(vote_pos, (start,), (start + size,)),
-                   axis_name, wire)
+                   axis_name, wire, alive)
         for start, size in bounds
     ]
 
 
 def vote_total_bucketed(
-    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int,
+    alive=None,
 ) -> jnp.ndarray:
     """Concatenated bucketed vote — same contract (and bit pattern) as
     :func:`vote_total`, but issued as ``vote_buckets`` independent
     collectives XLA's async scheduler can overlap with unrelated compute."""
     if vote_buckets <= 1:
-        return vote_total(vote_pos, axis_name, wire)
-    totals = vote_total_buckets(vote_pos, axis_name, wire, vote_buckets)
+        return vote_total(vote_pos, axis_name, wire, alive)
+    totals = vote_total_buckets(vote_pos, axis_name, wire, vote_buckets,
+                                alive)
     return totals[0] if len(totals) == 1 else jnp.concatenate(totals)
 
 
 def majority_vote_bucketed(
-    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int
+    vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int,
+    alive=None,
 ) -> jnp.ndarray:
     """Elected bool votes via the bucketed wire; bit-identical to
     :func:`majority_vote` for every wire format."""
-    return vote_total_bucketed(vote_pos, axis_name, wire, vote_buckets) > 0
+    return vote_total_bucketed(vote_pos, axis_name, wire, vote_buckets,
+                               alive) > 0
 
 
-def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndarray:
+def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int,
+                      alive=None) -> jnp.ndarray:
     """Elected bool votes via all_to_all of 1-bit ballots + all_gather of
     1-bit verdicts (~2 bits/param received per worker, W-independent)."""
     n = vote_pos.shape[0]
@@ -209,8 +238,15 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndar
     # phase 1: worker j receives every worker's row j → [W, chunk]
     arrived = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0, tiled=True)
     bits = unpack_signs(arrived.reshape(-1), (w, chunk * 8))
-    count = bits.astype(jnp.int32).sum(0)              # per-bit True tally
-    verdict = count * 2 > w                            # tie → False (−1)
+    if alive is not None:
+        # the chunk owner sees every worker's row, so the masked tally is a
+        # row weighting; the threshold shrinks to the healthy quorum
+        weights = alive.astype(jnp.int32)
+        count = (bits.astype(jnp.int32) * weights[:, None]).sum(0)
+        verdict = count * 2 > weights.sum()            # tie → False (−1)
+    else:
+        count = bits.astype(jnp.int32).sum(0)          # per-bit True tally
+        verdict = count * 2 > w                        # tie → False (−1)
     if w > 1:  # phase 2: (W−1) peers each send me their chunk's verdict
         WIRE_TALLY.record("ici", (w - 1) * chunk)
     # phase 2: broadcast my chunk's packed verdict to everyone
@@ -219,7 +255,8 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int) -> jnp.ndar
 
 
 def _hier_elect(
-    vote_pos: jnp.ndarray, axis_name: str, w: int, group_size: int
+    vote_pos: jnp.ndarray, axis_name: str, w: int, group_size: int,
+    alive=None,
 ) -> jnp.ndarray:
     """Hierarchical majority-of-majorities vote over a two-level fabric.
 
@@ -239,6 +276,15 @@ def _hier_elect(
     verdicts [tie→−, +] → group-level tie → −1, where the flat 6−2 vote
     elects +1); it degenerates to the flat vote at g=1 and g=W. Every worker
     applies the same elected bits, so replicas stay bit-identical.
+
+    Masked election (``alive``): a quarantined member abstains at level 1
+    (its ±1 ballots are zeroed out of the subgroup tally, so the subgroup
+    verdict is the majority of its HEALTHY members), and a subgroup with
+    zero healthy members abstains at level 2 (its verdict chunk is dropped
+    from the cross-group count and the group-level threshold shrinks to the
+    number of groups that still hold a healthy member). A quarantined worker
+    still computes/forwards ring traffic — elections stay replicated; only
+    its ballot's weight is gone.
     """
     if w % group_size:
         raise ValueError(
@@ -267,6 +313,15 @@ def _hier_elect(
     flat = (jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)])
             if pad else vote_pos)
     buf = jnp.where(flat, 1, -1).astype(acc).reshape(g, chunk)
+    group_alive = None
+    if alive is not None:
+        # level 1: my ballots abstain from the reduce-scatter when I am
+        # quarantined (I still relay partial sums — the ring needs me)
+        own_alive = alive[lax.axis_index(axis_name)]
+        buf = jnp.where(own_alive, buf, jnp.zeros_like(buf))
+        # level 2: groups are consecutive g-worker spans of the data axis,
+        # so the per-group health is a reshape-any over the mask
+        group_alive = alive.reshape(w // g, g).any(axis=1)
     idx = lax.axis_index(axis_name) % g  # my position within the group
     intra_perm = [(s, (s // g) * g + ((s % g) + 1) % g) for s in range(w)]
 
@@ -300,20 +355,34 @@ def _hier_elect(
     cross_perm = [
         (s, ((s // g + 1) % n_groups) * g + s % g) for s in range(w)
     ]
+    my_group = lax.axis_index(axis_name) // g
 
-    def _cross_hop(carry, _):
+    def _cross_hop(carry, t):
         count, rot = carry
         rot = lax.ppermute(rot, axis_name, cross_perm)
-        return (count + unpack_signs(rot, (chunk,)).astype(jnp.int32), rot), None
+        contrib = unpack_signs(rot, (chunk,)).astype(jnp.int32)
+        if group_alive is not None:
+            # the hop-t packet originated at group (my_group − t − 1): a
+            # fully-quarantined group's verdict chunk abstains at level 2
+            src = (my_group - t - 1) % n_groups
+            contrib = jnp.where(group_alive[src], contrib, 0)
+        return (count + contrib, rot), None
 
     count = verdict_own.astype(jnp.int32)
+    if group_alive is not None:
+        count = jnp.where(group_alive[my_group], count,
+                          jnp.zeros_like(count))
     if n_groups > 1 and w > 1:  # leg 2: the ONLY cross-group (DCN) traffic
         WIRE_TALLY.record("dcn", (n_groups - 1) * (chunk // 8))
     if n_groups > 1:
         (count, _), _ = lax.scan(
-            _cross_hop, (count, pack_signs(verdict_own)), None,
-            length=n_groups - 1)
-    elected_own = count * 2 > n_groups  # group-level tie → −1
+            _cross_hop, (count, pack_signs(verdict_own)),
+            jnp.arange(n_groups - 1))
+    if group_alive is None:
+        elected_own = count * 2 > n_groups  # group-level tie → −1
+    else:
+        # threshold shrinks to the healthy-group quorum (tie still → −1)
+        elected_own = count * 2 > group_alive.astype(jnp.int32).sum()
 
     # phase 3 — intra-group all-gather of the packed elected chunks.
     def _ag_hop(carry, t):
@@ -358,11 +427,13 @@ def majority_vote_packed_a2a(vote_pos: jnp.ndarray, axis_name: str) -> jnp.ndarr
     return _packed_a2a_elect(vote_pos, axis_name, axis_size(axis_name))
 
 
-def majority_vote(vote_pos: jnp.ndarray, axis_name: str, wire: str) -> jnp.ndarray:
+def majority_vote(vote_pos: jnp.ndarray, axis_name: str, wire: str,
+                  alive=None) -> jnp.ndarray:
     """Elected bool votes for any wire format (``total > 0`` ⇔ majority True;
     the ±1-proxy wires compute the election directly — XLA folds the
-    round-trip)."""
-    return vote_total(vote_pos, axis_name, wire) > 0
+    round-trip). ``alive`` masks quarantined workers out of the tally (the
+    vote guard's masked election — see :func:`vote_total`)."""
+    return vote_total(vote_pos, axis_name, wire, alive) > 0
 
 
 def masked_majority_vote_psum(

@@ -39,6 +39,7 @@ from distributed_lion_tpu.ops.codec import vote_chunk_elems, wire_bytes_per_para
 from distributed_lion_tpu.optim import (
     distributed_lion,
     expand_worker_state,
+    heal_worker_momentum,
     init_global_state,
     remap_worker_momentum,
     squeeze_worker_state,
@@ -59,7 +60,7 @@ from distributed_lion_tpu.parallel.mesh import (
     TENSOR_AXIS,
     data_axis_size,
 )
-from distributed_lion_tpu.train import resilience, telemetry
+from distributed_lion_tpu.train import resilience, telemetry, vote_guard
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import (
@@ -224,6 +225,35 @@ class TrainConfig:
     # immediately, arm a StepProfiler window at the tripping step (trace
     # written into the crash bundle), run profile_num_steps more steps to
     # capture the poisoned dataflow, then raise.
+    vote_guard: str = "off"  # off | observe | enforce. The vote guard
+    # (train/vote_guard.py + optim.distributed_lion guard mode): the jitted
+    # step emits per-worker ballot-health signals (nonfinite local
+    # grad/momentum before sign-encoding, frozen ballots via popcount(XOR
+    # prev), outlier disagreement vs the healthy peers) and a host-side
+    # quarantine machine — checked one dispatch behind, like the NaN
+    # sentinel — strikes, quarantines and (after --guard_cooldown steps)
+    # readmits workers. 'enforce' additionally masks quarantined ballots
+    # out of the election (the majority threshold shrinks to the healthy
+    # quorum), zeroes nonfinite gradients out of the momentum update, and
+    # re-averages a readmitted worker's momentum from the healthy mean;
+    # with an all-healthy mask it is bit-identical to 'off'
+    # (tests/test_vote_guard.py). 'observe' reports what enforce would do
+    # without touching the election. Lion-only: AdamW has no election.
+    min_quorum: int = 0  # vote_guard enforce: refuse to continue (loud
+    # RuntimeError) when the healthy quorum drops below this. 0 = auto:
+    # a strict majority (W//2 + 1) — a vote with a sick majority is noise.
+    guard_strikes: int = 3  # consecutive-ish bad observed steps before a
+    # worker is quarantined (a clean dispatch resets its strikes, so
+    # transient faults — one bad batch — never escalate)
+    guard_cooldown: int = 50  # optimizer steps a quarantined worker sits
+    # out before a readmission probe (healed momentum, mask cleared; a
+    # still-sick worker re-strikes within guard_strikes steps)
+    inject_poison: str = ""  # fault injection for the guard's evidence and
+    # tests: '<kind>:<worker>[:<start_step>]' with kind in
+    # nan_grads | frozen_ballot | flipped_ballot
+    # (train/resilience.parse_poison; baked into the step at trace time
+    # through the resilience fault registry). Works with --vote_guard off
+    # too — that is the degradation baseline the guard is measured against.
 
     def schedule(self) -> Callable:
         if self.lr_scheduler_type == "cosine":
@@ -377,6 +407,11 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             "--telemetry instruments the majority-vote election; the AdamW "
             "path has no vote to observe — drop one of the two flags"
         )
+    if cfg.vote_guard != "off" and not cfg.lion:
+        raise ValueError(
+            "--vote_guard protects the majority-vote election; the AdamW "
+            "path has no vote to guard — drop one of the two flags"
+        )
     if cfg.lion:
         mom_dtype = jnp.dtype(cfg.mom_dtype) if cfg.mom_dtype else None
         return distributed_lion(
@@ -396,6 +431,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             kernel=cfg.kernel,
             mom_dtype=mom_dtype,
             telemetry=cfg.telemetry,
+            guard=cfg.vote_guard,
         )
     if cfg.async_grad:
         raise ValueError(
@@ -416,9 +452,13 @@ def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
     if cfg.lion:
         # stacked per-worker momentum: [world, ...] over 'data' (+ any
         # tensor-parallel dims the param itself carries); the elected-sign
-        # cache (vote_every > 1) is replicated
+        # cache (vote_every > 1) and the guard's health mask are replicated;
+        # the guard's per-worker previous ballot shards like the momenta
+        guard_on = cfg.vote_guard != "off"
         return LionState(count=P(), exp_avg=exp_avg_specs, rng=P(),
-                         elected=P() if cfg.vote_every > 1 else None)
+                         elected=P() if cfg.vote_every > 1 else None,
+                         health=P() if guard_on else None,
+                         prev_ballot=P(DATA_AXIS) if guard_on else None)
     if cfg.zero1:
         # [world, chunk] m/v sharded over 'data': ZeRO-1 state partitioning
         return Zero1State(count=P(), m=P(DATA_AXIS), v=P(DATA_AXIS))
@@ -553,6 +593,28 @@ class Trainer:
                 "while its P() spec declares it replicated. Use vote-health "
                 "telemetry with replicated params (dp / dp x sp)."
             )
+        vote_guard.parse_guard_mode(cfg.vote_guard)
+        if cfg.vote_guard != "off" and _spec_sharded_axes(param_specs):
+            raise ValueError(
+                f"--vote_guard is incompatible with params sharded over "
+                f"{sorted(_spec_sharded_axes(param_specs))}: the guard's "
+                "per-worker ballot state covers each rank's LOCAL shards, "
+                "so health decisions would mix different coordinate sets. "
+                "Use the vote guard with replicated params (dp / dp x sp)."
+            )
+        self._guard = (vote_guard.make_guard(
+            self.world, cfg.vote_guard, cfg.guard_strikes,
+            cfg.guard_cooldown, cfg.min_quorum)
+            if cfg.lion and cfg.vote_guard != "off" else None)
+        self._guard_pending = None  # (step, obs-device-arrays, advanced)
+        if cfg.inject_poison:
+            # route the spec through the resilience fault registry — the
+            # same transport tests use directly; the step bakes it in at
+            # trace time
+            resilience.inject_fault(
+                "ballot_poison", resilience.parse_poison(cfg.inject_poison))
+            print(f"[trainer] FAULT INJECTION armed: ballot poison "
+                  f"{cfg.inject_poison!r}")
 
         self.params = jax.tree.map(
             lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, param_specs
@@ -595,6 +657,10 @@ class Trainer:
                     ),
                     rng=None if state.rng is None else NamedSharding(mesh, P()),
                     elected=None if state.elected is None else NamedSharding(mesh, P()),
+                    health=None if state.health is None
+                    else NamedSharding(mesh, P()),
+                    prev_ballot=None if state.prev_ballot is None
+                    else NamedSharding(mesh, P(DATA_AXIS)),
                 ),
             )
         elif cfg.zero1:
@@ -636,6 +702,11 @@ class Trainer:
 
         self.step_count = 0
         self._resume_skip_batches = 0
+        # caller-provided data-provenance stamps (e.g. the native loader's
+        # served shard list) merged into every checkpoint's manifest meta,
+        # so resume can verify the deterministic replay will see the SAME
+        # data the original run consumed (cli/run_clm's shard-fleet check)
+        self.data_meta: dict = {}
         self._schedule = cfg.schedule()
         if loss_fn is None:
             def loss_fn(params, batch, dropout_key):
@@ -774,6 +845,53 @@ class Trainer:
         seen.add(sig)
         print(f"[trainer] {msg}")
 
+    def _apply_guard(self, step: int, obs: dict, advanced: int) -> None:
+        """Drive the host quarantine machine with one dispatch's guard
+        observations (device arrays fetched HERE, one dispatch behind — the
+        values finished computing long ago, so the get is a cheap copy),
+        then act on its transitions: push the refreshed health mask to the
+        device state, heal readmitted momenta from the healthy mean, and
+        enforce the quorum floor."""
+        if not obs:
+            return
+        host = {k: np.asarray(jax.device_get(v)) for k, v in obs.items()}
+        events = self._guard.update(step, host, advanced)
+        for line in events.logs:
+            print(f"[trainer] vote guard: {line}")
+        if self.cfg.vote_guard != "enforce":
+            return  # observe mode: bookkeeping + logs only
+        if events.readmitted:
+            # readmission healing: the healed worker's momentum restarts at
+            # the HEALTHY mean (the vote distribution's center — the same
+            # quantity the elastic-resume remap preserves) instead of
+            # whatever it drifted or was poisoned to while quarantined
+            source = np.array(self._guard.healthy, dtype=bool)
+            for w in events.readmitted:
+                source[w] = False  # a healed worker is not its own source
+            exp_avg = heal_worker_momentum(self.state.exp_avg, source,
+                                           events.readmitted)
+            exp_avg = jax.device_put(
+                exp_avg, jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                      self._exp_avg_specs))
+            self.state = self.state._replace(exp_avg=exp_avg)
+        if events.mask_changed:
+            # same shape/dtype as before — no retrace; the next dispatch's
+            # elections exclude (or re-include) the flipped workers
+            self.state = self.state._replace(health=jax.device_put(
+                jnp.asarray(self._guard.healthy),
+                NamedSharding(self.mesh, P())))
+        if not self._guard.quorum_ok():
+            if self.checkpointer:
+                # the last good checkpoint must be durable before we refuse
+                self.checkpointer.finalize()
+            raise RuntimeError(
+                f"vote guard: healthy quorum {self._guard.healthy_count()}/"
+                f"{self.world} fell below --min_quorum "
+                f"{self._guard.min_quorum} at step {step} — a majority "
+                "election with a sick majority is noise, refusing to "
+                f"continue. Sick workers: {self._guard.sick_workers()} "
+                f"(counters: {self._guard.sick_report()['sick_workers']})")
+
     def _check_sentinel(self, step: int, metrics,
                         force_raise: bool = False) -> None:
         """The NaN sentinel's host half: isfinite over the step's loss (and
@@ -792,6 +910,13 @@ class Trainer:
         reason = ("non-finite " + ", ".join(f"{k}={v!r}"
                                             for k, v in bad.items())
                   + f" at step {step}")
+        if self._guard is not None and self._guard.sick_workers():
+            # the guard's per-worker health counters feed the sentinel: the
+            # trip names the sick WORKER(s), not just the poisoned leaves —
+            # a single worker's nonfinite local grad that loses every vote
+            # never shows in the global loss, but it shows here
+            reason += (" (vote guard sick workers: "
+                       f"{self._guard.sick_workers()})")
         print(f"[trainer] ANOMALY: {reason}")
         crash_dir = None
         if self.cfg.output_dir:
@@ -802,7 +927,9 @@ class Trainer:
             crash_dir = telemetry.write_crash_bundle(
                 self.cfg.output_dir, step, reason,
                 dataclasses.asdict(self.cfg), self.params, self.state,
-                window)
+                window,
+                guard=(self._guard.sick_report()
+                       if self._guard is not None else None))
             print(f"[trainer] crash bundle written to {crash_dir}")
         if self.cfg.trace_on_anomaly and not force_raise:
             trace_base = crash_dir or self.cfg.profile_dir
@@ -847,6 +974,8 @@ class Trainer:
         n_ballot = self._n_ballot
         world = self.world
         nan_sentinel = cfg.nan_sentinel
+        guard_on = self._guard is not None
+        guard_enforce = guard_on and cfg.vote_guard == "enforce"
         vh_specs = jax.tree.map(lambda _: P(), self.vote_health)
 
         @partial(
@@ -914,6 +1043,27 @@ class Trainer:
             # else: no gradient sync — the AsyncTrainer contract
             # (async_trainer.py:15). The ONLY collective is the vote in
             # opt.step.
+            poison = resilience.fault("ballot_poison")
+            if poison is not None:
+                # ballot-poisoning fault injection, baked in at trace time
+                # (train/resilience registry): worker `pw` becomes a sick
+                # voter from optimizer step `ps` on — NaN grads (poisons
+                # momentum + votes −1 everywhere), zero grads (its ballot
+                # freezes at sign(m)), or negated grads (its momentum and
+                # ballot become the exact inverse — an adversarial voter)
+                kind, pw, ps = poison
+                hit = (widx == pw) & (_count_of(state) >= ps)
+                if kind == "nan_grads":
+                    grads = jax.tree.map(
+                        lambda g: jnp.where(
+                            hit, jnp.asarray(jnp.nan, g.dtype), g), grads)
+                elif kind == "frozen_ballot":
+                    grads = jax.tree.map(
+                        lambda g: jnp.where(hit, jnp.zeros_like(g), g),
+                        grads)
+                else:  # flipped_ballot
+                    grads = jax.tree.map(
+                        lambda g: jnp.where(hit, -g, g), grads)
             shard_axes = tuple(a for a, flag in
                                ((TENSOR_AXIS, tp_axis is not None),
                                 (PIPE_AXIS, pp > 1),
@@ -925,7 +1075,20 @@ class Trainer:
                 # the clipper uses, then meaned over workers for logging
                 gsq = global_grad_sq(grads, specs=param_specs,
                                      shard_axes=shard_axes)
-                gnorm = jnp.sqrt(lax.pmean(gsq, DATA_AXIS))
+                if guard_enforce:
+                    # degraded-mode training: one worker's nonfinite LOCAL
+                    # grad must not poison the pmean'd metric and trip the
+                    # sentinel on a run the guard is keeping healthy — the
+                    # norm averages the finite workers, and the sick one is
+                    # named through the guard's own counters instead
+                    finite = jnp.isfinite(gsq)
+                    gnorm = jnp.sqrt(
+                        lax.psum(jnp.where(finite, gsq, 0.0), DATA_AXIS)
+                        / jnp.maximum(
+                            lax.psum(finite.astype(jnp.float32), DATA_AXIS),
+                            1.0))
+                else:
+                    gnorm = jnp.sqrt(lax.pmean(gsq, DATA_AXIS))
             clip = (cfg.grad_clip_norm if cfg.grad_clip_norm is not None
                     else cfg.max_grad_norm)
             if clip:
@@ -942,15 +1105,17 @@ class Trainer:
                 st = squeeze_zero_state(state)
             else:
                 st = state
+            outs = opt.step(params, grads, st)
+            new_params, new_st = outs[0], outs[1]
+            extra = list(outs[2:])
             if telemetry_on:
                 # the optimizer emits the per-step vote-health frame; fold
                 # it into the replicated accumulator on device (the only
                 # additions are two scalar psums — no host traffic, and the
                 # election itself is untouched)
-                new_params, new_st, frame = opt.step(params, grads, st)
-                vh = telemetry.fold(vh, frame, DATA_AXIS, world, n_ballot)
-            else:
-                new_params, new_st = opt.step(params, grads, st)
+                vh = telemetry.fold(vh, extra.pop(0), DATA_AXIS, world,
+                                    n_ballot)
+            gframe = extra.pop(0) if guard_on else None
             if cfg.lion:
                 new_state = expand_worker_state(new_st)
             elif cfg.zero1:
@@ -961,6 +1126,21 @@ class Trainer:
             mean_metrics = {k: lax.pmean(v.mean(), DATA_AXIS) for k, v in metrics.items()}
             if gnorm is not None:
                 mean_metrics["grad_norm"] = gnorm
+            if gframe is not None:
+                # the guard's per-dispatch observations ride the metrics
+                # dict as replicated [W] vectors under the reserved
+                # 'guard_*' names; the trainer pops them before logging and
+                # feeds the host quarantine machine one dispatch behind
+                # (vote_guard.OBS_KEYS). Frozen = a (re)vote with ZERO
+                # ballot bit flips against a REAL previous election.
+                frozen = ((gframe["flips"] == 0) & gframe["flip_valid"]
+                          & (gframe["voted"] > 0))
+                mean_metrics["guard_nonfinite"] = (
+                    gframe["nonfinite"] > 0).astype(jnp.int32)
+                mean_metrics["guard_frozen"] = frozen.astype(jnp.int32)
+                mean_metrics["guard_disagree"] = gframe["disagree"]
+                mean_metrics["guard_voted_steps"] = (
+                    gframe["voted"] > 0).astype(jnp.int32)
             return new_params, new_state, vh, mean_metrics
 
         return step
@@ -980,8 +1160,12 @@ class Trainer:
             (params, state, vh), ms = lax.scan(body, (params, state, vh),
                                                batches)
             # per-chunk mean for logging (loss of the last step alone would
-            # alias a single microbatch draw)
-            return params, state, vh, jax.tree.map(lambda x: x.mean(0), ms)
+            # alias a single microbatch draw); the guard's 'guard_*'
+            # observations are bad-step COUNTS and summed fractions — they
+            # sum over the chunk so the host strike counter sees every step
+            return params, state, vh, {
+                k: (v.sum(0) if k.startswith("guard_") else v.mean(0))
+                for k, v in ms.items()}
 
         return chunk
 
@@ -1079,6 +1263,16 @@ class Trainer:
                 self.timer.tick()
                 advanced = 1
             self.profiler.maybe_stop(self.step_count, sync=metrics)
+            if self._guard is not None:
+                # pop the guard's [W]-vector observations before anything
+                # host-floats the metrics dict; the machine runs one
+                # dispatch behind (same pattern as the sentinel) so the
+                # device pipeline never stalls on the host read
+                obs = {k: metrics.pop(k) for k in vote_guard.OBS_KEYS
+                       if k in metrics}
+                if self._guard_pending is not None:
+                    self._apply_guard(*self._guard_pending)
+                self._guard_pending = (self.step_count, obs, advanced)
             if cfg.nan_sentinel:
                 # trailing isfinite watch: the PREVIOUS dispatch's metrics
                 # are checked after this one is in flight, so the device
@@ -1157,6 +1351,15 @@ class Trainer:
                     per_dev = peak_hbm_per_device()
                     if per_dev is not None and len(per_dev) > 1:
                         m["peak_hbm_per_device"] = per_dev
+                if self._guard is not None:
+                    # scalar guard health for the record stream (the [W]
+                    # observation vectors were popped above)
+                    m.update(self._guard.summary())
+                if hasattr(train_iter, "health_metrics"):
+                    # input-pipeline health (e.g. the native loader's
+                    # skipped_shards / shard_read_retries counters) rides
+                    # the same strict-JSON metrics stream
+                    m.update(train_iter.health_metrics())
                 t_last, s_last = now, self.step_count
                 self.logger.log(self.step_count, m, prefix="train")
                 self._metrics_window.append({"step": self.step_count, **m})
@@ -1187,6 +1390,13 @@ class Trainer:
                           "begins from step 0")
                 self.preempted = True
                 break
+        if self._guard is not None and self._guard_pending is not None:
+            # the final dispatch's guard observations are still pending;
+            # fold them so the machine's counters (and any quorum refusal)
+            # cover the whole run — and so a sentinel bundle written just
+            # below names the sick workers from complete evidence
+            pending, self._guard_pending = self._guard_pending, None
+            self._apply_guard(*pending)
         if cfg.nan_sentinel and self._sentinel_pending is not None:
             # the final dispatch's metrics were still awaiting their check
             pending, self._sentinel_pending = self._sentinel_pending, None
@@ -1282,7 +1492,55 @@ class Trainer:
                   "step": self.step_count,
                   "batches_consumed": self.step_count,
                   "has_vote_health": self._telemetry_on,
-                  "wire": self.cfg.wire, "vote_every": self.cfg.vote_every})
+                  "has_guard": self._guard is not None,
+                  "wire": self.cfg.wire, "vote_every": self.cfg.vote_every,
+                  **self.data_meta})
+
+    def _with_guard_fields(self, tpl: dict, on: bool,
+                           world: Optional[int] = None) -> dict:
+        """Shape a restore template's opt_state for a checkpoint WITH or
+        WITHOUT the vote-guard state (Orbax rejects templates missing — or
+        mis-shaping — a saved key, so the manifest's has_guard stamp
+        decides, not this run's flags). ``world`` sizes the stacked
+        prev-ballot / mask for the elastic path."""
+        w = world or self.world
+        out = dict(tpl)
+        if not on:
+            out["opt_state"] = out["opt_state"]._replace(
+                health=None, prev_ballot=None)
+            return out
+        from distributed_lion_tpu.optim.distributed_lion import (
+            _guard_ballot_len,
+        )
+
+        blen = _guard_ballot_len(self.n_params, self.cfg.vote_every or 1)
+        out["opt_state"] = out["opt_state"]._replace(
+            health=jax.ShapeDtypeStruct(
+                (w,), jnp.bool_,
+                sharding=NamedSharding(self.mesh, P())),
+            prev_ballot=jax.ShapeDtypeStruct(
+                (w, blen), jnp.uint8,
+                sharding=NamedSharding(
+                    self.mesh,
+                    P(DATA_AXIS) if w % self.world == 0 else P())),
+        )
+        return out
+
+    def _fresh_guard_state(self):
+        """(health, prev_ballot) reinitialized for THIS run's world — used
+        when a checkpoint carries no guard state (or an incompatible one)
+        but the guard is on."""
+        from distributed_lion_tpu.optim.distributed_lion import (
+            _guard_ballot_len,
+        )
+
+        blen = _guard_ballot_len(self.n_params, self.cfg.vote_every or 1)
+        return (
+            jax.device_put(jnp.ones((self.world,), jnp.bool_),
+                           NamedSharding(self.mesh, P())),
+            jax.device_put(jnp.zeros((self.world, blen), jnp.uint8),
+                           NamedSharding(self.mesh, P(DATA_AXIS))),
+        )
 
     def _vote_health_template(self, ckpt_vote_every: int):
         """A restore template for the checkpoint's vote_health accumulator,
@@ -1331,7 +1589,33 @@ class Trainer:
                     sharding=mom_shard),
                 tpl["opt_state"].exp_avg),
         )
+        # guard fields sized by the CHECKPOINT's world (the meta stamp
+        # decides presence, like vote_health); the restored mask drives the
+        # healthy-only momentum heal below, then both reinit at W'
+        tpl = self._with_guard_fields(tpl, bool(meta.get("has_guard")),
+                                      world=ckpt_world)
         return tpl
+
+    def _adopt_guard_state(self, step: int) -> None:
+        """Reconcile the restored state's guard fields with THIS run's
+        guard flag: adopt a checkpointed health mask exactly (quarantined
+        workers resume quarantined, cooldown restarting at the resumed
+        step), attach fresh guard state when the checkpoint predates the
+        guard, strip it when the guard is off now."""
+        st = self.state
+        if self._guard is not None:
+            if st.health is None or st.prev_ballot is None:
+                health, prev = self._fresh_guard_state()
+                self.state = st._replace(health=health, prev_ballot=prev)
+            else:
+                mask = np.asarray(jax.device_get(st.health), dtype=bool)
+                self._guard.adopt_mask(mask, step)
+                if not mask.all():
+                    print("[trainer] vote guard: resumed with quarantined "
+                          f"workers {[int(w) for w in np.nonzero(~mask)[0]]}"
+                          f" (cooldown restarts at step {step})")
+        elif st.health is not None or st.prev_ballot is not None:
+            self.state = st._replace(health=None, prev_ballot=None)
 
     def _restore_step(self, step: int, meta: dict, ckpt_world: int) -> None:
         ckpt_ve = int(meta.get("vote_every", self.cfg.vote_every or 1)) or 1
@@ -1358,10 +1642,29 @@ class Trainer:
                 else:
                     alt["vote_health"] = self._vote_health_template(ckpt_ve)
                 tries.append(alt)
+            if self.cfg.lion:
+                # guard-state presence follows the same stamp logic: the
+                # manifest's has_guard decides the template's shape; with
+                # no meta, try this run's shape first, then the opposite
+                # (a --vote_guard toggle between save and resume)
+                has_guard = meta.get("has_guard")
+                cur_guard = self._guard is not None
+                if has_guard is None:
+                    tries = ([self._with_guard_fields(t, cur_guard)
+                              for t in tries]
+                             + [self._with_guard_fields(t, not cur_guard)
+                                for t in tries])
+                else:
+                    tries = [self._with_guard_fields(t, bool(has_guard))
+                             for t in tries]
             # pre-resilience checkpoints lack the world/batches_consumed/
             # vote_health keys entirely; the legacy payload shape last
+            legacy_state = self._pack_state_rng(self.state)
+            if self.cfg.lion:
+                legacy_state = legacy_state._replace(health=None,
+                                                     prev_ballot=None)
             tries.append({"params": self.params,
-                          "opt_state": self._pack_state_rng(self.state),
+                          "opt_state": legacy_state,
                           "step": np.asarray(self.step_count, np.int64)})
             restored = None
             for i, t in enumerate(tries):
@@ -1373,6 +1676,8 @@ class Trainer:
                         raise
             self.params = restored["params"]
             self.state = self._unpack_state_rng(restored["opt_state"])
+            if self.cfg.lion:
+                self._adopt_guard_state(step)
             if ("vote_health" in restored and self._telemetry_on
                     and ckpt_ve == (self.cfg.vote_every or 1)):
                 # adopt the accumulator only when its packing still matches
@@ -1386,9 +1691,31 @@ class Trainer:
                 lambda p, s: jax.device_put(p, NamedSharding(self.mesh, s)),
                 restored["params"], self.param_specs)
             st = self._unpack_state_rng(restored["opt_state"])
+            exp_avg = st.exp_avg
+            if st.health is not None:
+                # a checkpoint with quarantined workers: only HEALTHY
+                # momenta may enter the remap — heal the quarantined rows
+                # to the healthy mean first (mean-preserving, so the vote
+                # center the remap promises to keep is the healthy one)
+                mask = np.asarray(jax.device_get(st.health), dtype=bool)
+                sick = [int(w) for w in np.nonzero(~mask)[0]]
+                if sick:
+                    exp_avg = heal_worker_momentum(exp_avg, mask, sick)
+                    print(f"[trainer] elastic resume: healed quarantined "
+                          f"worker momenta {sick} from the healthy mean "
+                          "before the world remap")
             st = st._replace(
-                exp_avg=remap_worker_momentum(st.exp_avg, ckpt_world,
+                exp_avg=remap_worker_momentum(exp_avg, ckpt_world,
                                               self.world))
+            if self._guard is not None:
+                # worker identity does not survive a world change: the
+                # guard restarts all-healthy at W' with a zero ballot
+                # history (a still-sick HOST re-strikes within
+                # --guard_strikes steps)
+                health, prev = self._fresh_guard_state()
+                st = st._replace(health=health, prev_ballot=prev)
+            else:
+                st = st._replace(health=None, prev_ballot=None)
             self.state = jax.device_put(
                 st,
                 LionState(
@@ -1400,6 +1727,11 @@ class Trainer:
                          else NamedSharding(self.mesh, P())),
                     elected=(None if st.elected is None
                              else NamedSharding(self.mesh, P())),
+                    health=(None if st.health is None
+                            else NamedSharding(self.mesh, P())),
+                    prev_ballot=(None if st.prev_ballot is None
+                                 else NamedSharding(self.mesh,
+                                                    P(DATA_AXIS))),
                 ),
             )
             # the accumulator's normalizations reference the old world; a
@@ -1469,6 +1801,10 @@ class Trainer:
 
     def close(self) -> None:
         self.profiler.close()
+        if self.cfg.inject_poison:
+            # disarm the poison this trainer injected so a later Trainer in
+            # the same process does not inherit a sick worker
+            resilience.inject_fault("ballot_poison", None)
         if self._preempt_guard is not None:
             self._preempt_guard.close()
         try:

@@ -46,6 +46,15 @@ from typing import Any, Iterable, Optional
 #   ckpt_crash_before_marker   (bool: manifest lands, commit marker doesn't)
 #   ckpt_slow_commit     (float: seconds the commit thread stalls, i.e. a
 #                         slow serialize/write — what async saving must hide)
+#   ballot_poison        ((kind, worker, start_step) from parse_poison():
+#                         the trainer's step bakes a worker-k gradient
+#                         transform in at trace time — nan_grads → NaN,
+#                         frozen_ballot → 0 (its vote freezes at sign(m)),
+#                         flipped_ballot → −g (its ballot becomes the exact
+#                         inverse of the honest one, adversarial voter).
+#                         Inject BEFORE the first dispatch; start_step gates
+#                         the onset against the traced optimizer count, so
+#                         mid-run onset needs no retrace.)
 _FAULTS: dict[str, Any] = {}
 _FAULTS_LOCK = threading.Lock()
 
@@ -63,6 +72,32 @@ def clear_faults() -> None:
 def fault(name: str, default: Any = None) -> Any:
     with _FAULTS_LOCK:
         return _FAULTS.get(name, default)
+
+
+POISON_KINDS = ("nan_grads", "frozen_ballot", "flipped_ballot")
+
+
+def parse_poison(spec: str) -> tuple[str, int, int]:
+    """Parse a ballot-poisoning spec ``<kind>:<worker>[:<start_step>]``
+    (e.g. ``nan_grads:2`` or ``flipped_ballot:0:100``) into the
+    ``(kind, worker, start_step)`` tuple the ``ballot_poison`` fault
+    carries. Single source of truth for the --inject_poison CLI flag and
+    direct registry injection in tests/the runbook."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3) or parts[0] not in POISON_KINDS:
+        raise ValueError(
+            f"bad poison spec {spec!r}: expected '<kind>:<worker>"
+            f"[:<start_step>]' with kind in {POISON_KINDS}")
+    try:
+        worker = int(parts[1])
+        start = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        raise ValueError(f"bad poison spec {spec!r}: worker/start_step "
+                         "must be integers")
+    if worker < 0 or start < 0:
+        raise ValueError(f"bad poison spec {spec!r}: worker/start_step "
+                         "must be >= 0")
+    return parts[0], worker, start
 
 
 def consume_fault_count(name: str) -> bool:

@@ -325,12 +325,14 @@ def _json_safe(obj):
 
 def write_crash_bundle(output_dir: str, step: int, reason: str,
                        cfg_dict: dict, params: Any, opt_state: Any,
-                       metrics_window) -> str:
+                       metrics_window, guard: Optional[dict] = None) -> str:
     """Write ``<output_dir>/crash/step_<n>/bundle.json``: everything needed
     to explain a non-finite step without re-running under a profiler —
     step, trip reason, the full train config, per-leaf non-finite counts
-    for params AND optimizer state (naming the poisoned leaves), and the
-    recent metrics window. Returns the bundle directory."""
+    for params AND optimizer state (naming the poisoned leaves), the recent
+    metrics window, and (``guard``) the vote guard's per-WORKER health
+    report — mask, strikes, signal counters — so the bundle names the sick
+    worker, not just the poisoned leaves. Returns the bundle directory."""
     crash_dir = os.path.join(output_dir, "crash", f"step_{step:08d}")
     os.makedirs(crash_dir, exist_ok=True)
     bundle = {
@@ -342,6 +344,8 @@ def write_crash_bundle(output_dir: str, step: int, reason: str,
         "nonfinite_opt_state": nonfinite_leaf_report(opt_state),
         "metrics_window": list(metrics_window),
     }
+    if guard is not None:
+        bundle["guard"] = guard
     with open(os.path.join(crash_dir, "bundle.json"), "w") as f:
         json.dump(_json_safe(bundle), f, indent=1, allow_nan=False)
         f.write("\n")

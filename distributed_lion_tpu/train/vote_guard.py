@@ -1,0 +1,280 @@
+"""Vote guard: the host-side quarantine state machine.
+
+signSGD-with-majority-vote is provably fault tolerant to a MINORITY of
+adversarial voters (Bernstein et al., 2019) — but only if the run actually
+cashes that guarantee in. This module is the decision half of the vote-guard
+layer: the jitted step (optim.distributed_lion, ``guard != 'off'``) emits
+cheap per-worker health signals every step — nonfinite ballot-input counts,
+ballot-flip counts vs the previous vote (popcount XOR ≈ 0 ⇔ a frozen
+voter), local-vs-elected disagreement fractions — and the trainer hands
+them to :class:`VoteGuard` one dispatch behind (the NaN-sentinel pattern:
+the device pipeline never stalls on the host read).
+
+The machine is three per-worker registers and two thresholds:
+
+- **strikes** accumulate one per bad observed step (a nonfinite input, a
+  frozen ballot, an outlier disagreement) and decay one per clean dispatch —
+  transient faults (one bad batch) never escalate, while an intermittent
+  outlier still ratchets toward the threshold.
+- at ``strike_threshold`` strikes a healthy worker is **quarantined**: in
+  ``enforce`` mode the trainer flips its bit in the ``LionState.health``
+  mask, so the masked election (parallel.collectives) excludes its ballots
+  and the majority threshold shrinks to the healthy quorum. ``observe``
+  mode runs the same bookkeeping but never touches the mask — it reports
+  what enforce WOULD do.
+- after ``cooldown_steps`` in quarantine the worker is **readmitted** as a
+  probe: the trainer re-averages its momentum from the healthy mean
+  (optim.distributed_lion.heal_worker_momentum — the same mean-preserving
+  machinery as the elastic-resume remap) and clears its bit. A still-sick
+  worker strikes out again within ``strike_threshold`` steps and returns
+  to quarantine.
+
+If the healthy quorum ever drops below ``min_quorum`` the trainer refuses
+to continue (loud RuntimeError): a majority election with a sick majority
+is not degraded-mode training, it is noise.
+
+Layering: host-side only (numpy + stdlib — importable without jax, like
+train/resilience's manifest readers); it must NOT import ``optim`` or
+``train.loop``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+# Outlier rule, two arms that must BOTH fire: an absolute floor (honest
+# voters in a healthy election sit well under this disagreement fraction;
+# a noise-dominated one puts EVERYONE near 0.5, which the relative arm
+# absorbs) and a relative margin over the mean of the worker's healthy
+# peers — the test that separates "the election is noisy for everyone"
+# from "this one voter is inverted/divergent". Calibrated against measured
+# traces: honest workers cluster within ~±0.03 of each other while a
+# flipped (sign-inverted) voter sits ~0.15 above the cluster; the peer
+# mean INCLUDES the outlier when judging an honest worker, which widens
+# the honest worker's bar and narrows the outlier's — the asymmetry that
+# makes one adversary separable at these margins.
+DISAGREE_ABS = 0.35
+DISAGREE_MARGIN = 0.1
+
+# metrics keys the jitted step emits per dispatch (the trainer pops them
+# from the metrics dict before logging — they are [W] vectors / counters,
+# not loggable scalars). Chunked dispatches SUM these over the scanned
+# steps, so each is "count of steps" (or a summed fraction) per worker.
+OBS_KEYS = ("guard_nonfinite", "guard_frozen", "guard_disagree",
+            "guard_voted_steps")
+
+
+@dataclasses.dataclass
+class GuardEvents:
+    """What one observation window changed: worker indices quarantined /
+    readmitted (or, under observe, WOULD have been), whether the device
+    mask must be re-pushed, and human-readable log lines."""
+
+    quarantined: list
+    readmitted: list
+    mask_changed: bool
+    logs: list
+
+
+class VoteGuard:
+    """Per-worker strike/quarantine/cooldown bookkeeping (see module doc)."""
+
+    def __init__(self, world: int, mode: str, strike_threshold: int = 3,
+                 cooldown_steps: int = 50, min_quorum: int = 0,
+                 disagree_abs: float = DISAGREE_ABS,
+                 disagree_margin: float = DISAGREE_MARGIN):
+        if mode not in ("observe", "enforce"):
+            raise ValueError(f"guard mode must be 'observe' or 'enforce', "
+                             f"got {mode!r}")
+        if strike_threshold < 1:
+            raise ValueError(f"strike_threshold must be >= 1, got "
+                             f"{strike_threshold}")
+        if cooldown_steps < 1:
+            raise ValueError(f"cooldown_steps must be >= 1, got "
+                             f"{cooldown_steps}")
+        self.world = int(world)
+        self.mode = mode
+        self.strike_threshold = int(strike_threshold)
+        self.cooldown_steps = int(cooldown_steps)
+        # 0 = auto: a strict majority must stay healthy — below that the
+        # "election" no longer estimates anything
+        self.min_quorum = int(min_quorum) or (self.world // 2 + 1)
+        if not 1 <= self.min_quorum <= self.world:
+            raise ValueError(
+                f"min_quorum {self.min_quorum} outside [1, {self.world}]")
+        self.disagree_abs = float(disagree_abs)
+        self.disagree_margin = float(disagree_margin)
+        self.healthy = np.ones(self.world, dtype=bool)
+        self.strikes = np.zeros(self.world, dtype=np.int64)
+        self.quarantined_at = np.full(self.world, -1, dtype=np.int64)
+        # cumulative per-worker signal counters (bad steps observed), kept
+        # for the crash bundle / sentinel so a bundle can NAME the sick
+        # worker, not just the poisoned leaves
+        self.counters = {k: np.zeros(self.world, dtype=np.int64)
+                         for k in ("nonfinite", "frozen", "outlier")}
+        self.quarantine_events = 0
+        self.readmit_events = 0
+
+    # ---------------------------------------------------------------- state
+    def healthy_count(self) -> int:
+        return int(self.healthy.sum())
+
+    def quorum_ok(self) -> bool:
+        return self.healthy_count() >= self.min_quorum
+
+    def adopt_mask(self, healthy, step: int) -> None:
+        """Resume path: adopt a checkpointed health mask. Quarantined
+        workers restart their cooldown at ``step`` (the original
+        quarantine step is not persisted — a fresh probe window is the
+        conservative reading)."""
+        healthy = np.asarray(healthy, dtype=bool).reshape(-1)
+        if healthy.shape[0] != self.world:
+            raise ValueError(
+                f"health mask has {healthy.shape[0]} workers, guard expects "
+                f"{self.world}")
+        self.healthy = healthy.copy()
+        self.strikes[:] = 0
+        self.quarantined_at[:] = -1
+        self.quarantined_at[~self.healthy] = int(step)
+
+    def sick_report(self) -> dict:
+        """Per-worker health snapshot for crash bundles / operators: the
+        mask, strikes, and every worker with a nonzero signal counter."""
+        sick = {}
+        for w in range(self.world):
+            entry = {k: int(v[w]) for k, v in self.counters.items() if v[w]}
+            if entry or not self.healthy[w]:
+                entry["healthy"] = bool(self.healthy[w])
+                sick[str(w)] = entry
+        return {
+            "mode": self.mode,
+            "healthy_mask": [bool(h) for h in self.healthy],
+            "strikes": [int(s) for s in self.strikes],
+            "sick_workers": sick,
+        }
+
+    def sick_workers(self) -> list:
+        """Workers currently quarantined or carrying nonzero counters —
+        the names the NaN sentinel attaches to its trip reason."""
+        flagged = ~self.healthy
+        for v in self.counters.values():
+            flagged = flagged | (v > 0)
+        return [int(w) for w in np.nonzero(flagged)[0]]
+
+    def summary(self) -> dict:
+        """Scalar metrics for the logging cadence (strict-JSON friendly)."""
+        return {
+            "guard_healthy": self.healthy_count(),
+            "guard_quarantined": self.world - self.healthy_count(),
+            "guard_strikes_max": int(self.strikes.max(initial=0)),
+            "guard_quarantine_events": self.quarantine_events,
+            "guard_readmit_events": self.readmit_events,
+        }
+
+    # --------------------------------------------------------------- update
+    def _outliers(self, disagree: np.ndarray, voted_steps: int) -> np.ndarray:
+        """Per-worker outlier flags from the window's mean disagreement
+        fractions. Absolute + relative-to-healthy-peers test; workers with
+        no healthy peer to compare against are never flagged by the
+        relative arm alone."""
+        out = np.zeros(self.world, dtype=bool)
+        if voted_steps <= 0:
+            return out
+        dis = disagree / voted_steps
+        for w in range(self.world):
+            if dis[w] <= self.disagree_abs:
+                continue
+            peers = dis[[i for i in range(self.world)
+                         if i != w and self.healthy[i]]]
+            base = float(peers.mean()) if peers.size else 0.0
+            if dis[w] > base + self.disagree_margin:
+                out[w] = True
+        return out
+
+    def update(self, step: int, obs: dict, advanced: int) -> GuardEvents:
+        """Fold one dispatch's summed observations (``OBS_KEYS``, already
+        host numpy) covering ``advanced`` optimizer steps ending at
+        ``step``. Returns the transitions for the trainer to act on."""
+        nonfinite = np.asarray(obs["guard_nonfinite"]).reshape(-1)
+        frozen = np.asarray(obs["guard_frozen"]).reshape(-1)
+        disagree = np.asarray(obs["guard_disagree"], dtype=np.float64
+                              ).reshape(-1)
+        voted_steps = int(np.asarray(obs["guard_voted_steps"]).reshape(())
+                          ) if "guard_voted_steps" in obs else advanced
+        outlier = self._outliers(disagree, voted_steps)
+
+        # bad steps per worker this window: nonfinite and frozen arrive as
+        # counts of bad steps from the device; an outlier verdict covers
+        # the whole window
+        bad_steps = np.clip(nonfinite, 0, advanced).astype(np.int64)
+        bad_steps = np.maximum(bad_steps,
+                               np.clip(frozen, 0, advanced).astype(np.int64))
+        bad_steps = np.maximum(bad_steps,
+                               np.where(outlier, advanced, 0))
+        self.counters["nonfinite"] += np.clip(nonfinite, 0, advanced
+                                              ).astype(np.int64)
+        self.counters["frozen"] += np.clip(frozen, 0, advanced
+                                           ).astype(np.int64)
+        self.counters["outlier"] += np.where(outlier, advanced, 0
+                                             ).astype(np.int64)
+
+        events = GuardEvents([], [], False, [])
+        would = "" if self.mode == "enforce" else "[observe] would have "
+        for w in range(self.world):
+            if self.healthy[w]:
+                if bad_steps[w] > 0:
+                    self.strikes[w] += int(bad_steps[w])
+                else:
+                    # a clean window forgives gradually (decay, not reset):
+                    # transient faults still never escalate, but an
+                    # INTERMITTENT outlier that flags most windows keeps
+                    # ratcheting toward the threshold
+                    self.strikes[w] = max(0, int(self.strikes[w]) - 1)
+                if self.strikes[w] >= self.strike_threshold:
+                    self.healthy[w] = False
+                    self.quarantined_at[w] = step
+                    self.strikes[w] = 0
+                    self.quarantine_events += 1
+                    events.quarantined.append(w)
+                    events.mask_changed = True
+                    sig = [k for k, v in (("nonfinite", nonfinite[w]),
+                                          ("frozen", frozen[w]),
+                                          ("outlier", outlier[w])) if v]
+                    events.logs.append(
+                        f"{would}QUARANTINED worker {w} at step {step} "
+                        f"({'+'.join(sig) or 'strikes'}); healthy quorum "
+                        f"{self.healthy_count()}/{self.world}")
+            else:
+                if step - self.quarantined_at[w] >= self.cooldown_steps:
+                    self.healthy[w] = True
+                    self.quarantined_at[w] = -1
+                    self.strikes[w] = 0
+                    self.readmit_events += 1
+                    events.readmitted.append(w)
+                    events.mask_changed = True
+                    events.logs.append(
+                        f"{would}READMITTED worker {w} at step {step} "
+                        "(cooldown elapsed; momentum re-averaged from the "
+                        "healthy mean — a still-sick worker re-strikes)")
+        return events
+
+
+def parse_guard_mode(mode: str) -> str:
+    if mode not in ("off", "observe", "enforce"):
+        raise ValueError(
+            f"--vote_guard {mode!r}: expected 'off' (no guard), 'observe' "
+            "(detect + report, elections untouched) or 'enforce' (masked "
+            "elections + quarantine + readmission healing)")
+    return mode
+
+
+def make_guard(world: int, mode: str, strike_threshold: int,
+               cooldown_steps: int, min_quorum: int) -> Optional[VoteGuard]:
+    """The trainer's constructor: None when the guard is off."""
+    if parse_guard_mode(mode) == "off":
+        return None
+    return VoteGuard(world, mode, strike_threshold=strike_threshold,
+                     cooldown_steps=cooldown_steps, min_quorum=min_quorum)

@@ -10,6 +10,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py overlap        # buckets {1,4,16} rows
     python scripts/check_evidence.py telemetry      # vote-health JSONL
     python scripts/check_evidence.py static         # graft-check both tiers
+    python scripts/check_evidence.py vote_guard     # poisoned-run rescue
     python scripts/check_evidence.py all
 """
 
@@ -336,6 +337,64 @@ def resilience_ok(dirname: str = "resilience") -> bool:
     return a is not None and s is not None and s > 0 and a < s
 
 
+# vote-guard artifact (ISSUE 5): the runbook's vote_guard stage runs four
+# short same-seed trainings under runs/vote_guard/ —
+#   clean          (no poison, --vote_guard off)
+#   clean_enforce  (no poison, --vote_guard enforce)
+#   poison_enforce (one flipped-ballot worker, enforce)
+#   poison_off     (same poison, guard off)
+# Captured = (a) ALL-HEALTHY BIT-IDENTITY: clean and clean_enforce log
+# byte-identical loss curves (enforce with an all-True mask must not move
+# one election), and (b) the DEGRADED-MODE claim: poison_enforce's tail
+# loss stays within GUARD_ENFORCE_EPS of clean while poison_off sits at
+# least GUARD_MIN_GAP further out — the guard demonstrably rescues the run
+# the adversary demonstrably degrades. (The stricter clean-W−1 comparison
+# is pinned by tests/test_vote_guard.py, where the mesh can be carved.)
+GUARD_ENFORCE_EPS = 0.35
+GUARD_MIN_GAP = 0.1
+GUARD_TAIL_FRAC = 0.75
+GUARD_MIN_STEPS = 30
+
+
+def _loss_curve(dirname: str):
+    path = os.path.join(REPO, "runs", dirname, "metrics.jsonl")
+    curve = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(r.get("train/loss"), (int, float)) \
+                        and isinstance(r.get("step"), int):
+                    curve[r["step"]] = r["train/loss"]
+    except OSError:
+        return None
+    return curve or None
+
+
+def _tail_mean(curve: dict):
+    last = max(curve)
+    tail = [v for s, v in curve.items() if s >= GUARD_TAIL_FRAC * last]
+    return sum(tail) / len(tail)
+
+
+def vote_guard_ok(base: str = "vote_guard") -> bool:
+    legs = {leg: _loss_curve(os.path.join(base, leg))
+            for leg in ("clean", "clean_enforce", "poison_enforce",
+                        "poison_off")}
+    if any(c is None or max(c) < GUARD_MIN_STEPS for c in legs.values()):
+        return False
+    clean, clean_enf = legs["clean"], legs["clean_enforce"]
+    common = sorted(set(clean) & set(clean_enf))
+    if not common or any(clean[s] != clean_enf[s] for s in common):
+        return False  # all-healthy enforce moved an election
+    gap_enf = abs(_tail_mean(legs["poison_enforce"]) - _tail_mean(clean))
+    gap_off = abs(_tail_mean(legs["poison_off"]) - _tail_mean(clean))
+    return gap_enf <= GUARD_ENFORCE_EPS and gap_off >= gap_enf + GUARD_MIN_GAP
+
+
 # static-analysis gate (ISSUE 4): the stage is green when (a) the
 # ci_static.sh gate passes RIGHT NOW — ruff baseline + graft-check tier-1
 # AST lint + shellcheck, each skipped gracefully where not installed — and
@@ -382,6 +441,7 @@ STAGES = [
     ("telemetry", telemetry_ok),
     ("resilience", resilience_ok),
     ("static", static_ok),
+    ("vote_guard", vote_guard_ok),
 ]
 
 
@@ -427,6 +487,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return resilience_ok(arg or "resilience")
     if what == "static":
         return static_ok()
+    if what == "vote_guard":
+        return vote_guard_ok(arg or "vote_guard")
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -298,6 +298,42 @@ else
     || echo "$(stamp) resilience artifact FAILED validation" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5e. vote-guard artifact (ISSUE 5, ~3 min): four short same-seed legs
+# under runs/vote_guard/. check_evidence's 'vote_guard' stage asserts
+# (a) all-healthy bit-identity — clean vs clean_enforce log byte-identical
+# loss curves (guard enforce with an all-True mask moves no election) —
+# and (b) the degraded-mode claim: with one flipped-ballot (adversarial)
+# worker, enforce quarantines it and its tail loss stays within
+# GUARD_ENFORCE_EPS of the clean run while guard-off degrades by at least
+# GUARD_MIN_GAP more. Constant LR (decay-to-zero would flatten the gap),
+# sign_psum so the run also exercises the masked tally wire on chip.
+if python scripts/check_evidence.py vote_guard; then
+  echo "$(stamp) vote_guard artifact already captured — skip" | tee -a "$OUT/log.txt"
+else
+  for leg in clean clean_enforce poison_enforce poison_off; do
+    mkdir -p "runs/vote_guard/$leg"
+    guard=off; case "$leg" in *enforce) guard=enforce;; esac
+    poison=""; case "$leg" in poison_*) poison="--inject_poison flipped_ballot:1";; esac
+    timeout -k 60 900 python -m distributed_lion_tpu.cli.run_clm \
+        --model_name tiny --dataset synthetic --lion --async_grad \
+        --wire sign_psum --vote_every 1 --vote_buckets 1 \
+        --vote_guard "$guard" --guard_strikes 2 --guard_cooldown 1000 \
+        $poison \
+        --learning_rate 5e-3 --lr_scheduler_type constant --weight_decay 0 \
+        --per_device_train_batch_size 6 --gradient_accumulation_steps 1 \
+        --block_size 32 --max_steps 40 --warmup_steps 0 \
+        --logging_steps 1 --eval_steps 100000 --save_steps 100000 \
+        --output_dir "runs/vote_guard/$leg" \
+        >> "$OUT/vote_guard.log" 2>&1
+    rc=$?; echo "$(stamp) vote_guard leg $leg rc=$rc" | tee -a "$OUT/log.txt"
+  done
+  python scripts/validate_metrics.py runs/vote_guard/*/metrics.jsonl \
+      >> "$OUT/vote_guard.log" 2>&1 || true
+  python scripts/check_evidence.py vote_guard \
+    && echo "$(stamp) vote_guard artifact captured" | tee -a "$OUT/log.txt" \
+    || echo "$(stamp) vote_guard artifact FAILED validation" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

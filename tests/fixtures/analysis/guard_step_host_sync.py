@@ -1,0 +1,27 @@
+"""Fixture: the vote guard's one forbidden shortcut — host-syncing the
+health mask / guard observations INSIDE the jitted step (the quarantine
+decision belongs to the host machine, one dispatch behind; a step-side
+read stalls the device pipeline every step). Never imported; parsed by
+graft-check's tier-1 tests (tests/test_analysis_lint.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def guarded_step(params, grads, health):
+    widx = lax.axis_index("data")  # graft: disable=DLT005
+    onehot = jnp.arange(health.shape[0]) == widx
+    nonfinite = sum(jnp.sum(~jnp.isfinite(g)) for g in jax.tree.leaves(grads))
+    obs = lax.psum(jnp.where(onehot, nonfinite, 0), "data")  # graft: disable=DLT005
+    if float(obs.sum()) > 0:            # DLT001: host sync in the step
+        health = jnp.zeros_like(health)
+    mask = np.asarray(health)           # DLT001: device→host copy per step
+    return jax.tree.map(lambda p: p * mask.mean(), params)
+
+
+def host_quarantine(obs):
+    # NOT traced scope: the state machine reads the returned arrays one
+    # dispatch behind — this is where device_get belongs
+    return {k: np.asarray(jax.device_get(v)) for k, v in obs.items()}

@@ -188,3 +188,20 @@ def test_lint_runs_standalone_without_package():
         cwd=REPO, capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "clean" in r.stdout
+
+
+def test_guard_fixture_and_guard_modules_clean():
+    """ISSUE 5 satellite: the vote guard's step-side code must stay free
+    of host syncs — the quarantine decision runs on the host one dispatch
+    behind, never inside the compiled step. The fixture shows the
+    forbidden shape (DLT001 fires on a step that host-reads the health
+    mask / guard observations); the guard's real modules lint clean by
+    file path."""
+    findings = lint.lint_file(
+        os.path.join(FIXTURES, "guard_step_host_sync.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for rel in ("train/vote_guard.py", "optim/distributed_lion.py",
+                "parallel/collectives.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel

@@ -407,3 +407,41 @@ def test_static_stage(tmp_path, monkeypatch):
     assert ce.static_ok()
     gate_rc["rc"] = 1
     assert not ce.static_ok()  # a red gate must not read captured either
+
+
+def test_vote_guard_stage(tmp_path, monkeypatch):
+    """The 'vote_guard' stage (ISSUE 5): captured only when (a) the clean
+    and clean_enforce legs log BYTE-identical loss curves (all-healthy
+    bit-identity) and (b) the poisoned enforce leg's tail tracks clean
+    within GUARD_ENFORCE_EPS while guard-off sits GUARD_MIN_GAP further
+    out. A missing leg, a bit-identity breach, a non-degrading adversary,
+    or a non-rescuing guard must all read MISSING."""
+    import json as _json
+
+    monkeypatch.setattr(ce, "REPO", str(tmp_path))
+
+    def write(leg, losses):
+        d = tmp_path / "runs" / "vote_guard" / leg
+        d.mkdir(parents=True, exist_ok=True)
+        rows = [_json.dumps({"step": s + 1, "train/loss": v})
+                for s, v in enumerate(losses)]
+        (d / "metrics.jsonl").write_text("\n".join(rows) + "\n")
+
+    clean = [5.0 - 0.05 * i for i in range(40)]
+    assert not ce.vote_guard_ok()           # nothing captured
+    write("clean", clean)
+    write("clean_enforce", clean)
+    write("poison_enforce", [v + 0.2 for v in clean])
+    assert not ce.vote_guard_ok()           # poison_off leg missing
+    write("poison_off", [v + 0.5 for v in clean])
+    assert ce.vote_guard_ok()               # the full claim holds
+    write("clean_enforce", [v + 1e-6 for v in clean])
+    assert not ce.vote_guard_ok()           # bit-identity breach fails
+    write("clean_enforce", clean)
+    write("poison_enforce", [v + 0.6 for v in clean])
+    assert not ce.vote_guard_ok()           # guard failed to rescue
+    write("poison_enforce", [v + 0.2 for v in clean])
+    write("poison_off", [v + 0.22 for v in clean])
+    assert not ce.vote_guard_ok()           # adversary didn't degrade
+    write("poison_off", [v + 0.5 for v in clean[:20]])
+    assert not ce.vote_guard_ok()           # short leg (< GUARD_MIN_STEPS)

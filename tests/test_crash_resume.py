@@ -81,6 +81,19 @@ def test_crash_resume_bit_identical(tmp_path, buckets, stoch):
     _assert_resumed_matches(tmp_path, mesh, model, blocks, **kw)
 
 
+def test_crash_resume_guard_bit_identical(tmp_path):
+    """Vote guard (ISSUE 5 satellite): with --vote_guard enforce the health
+    mask and the per-worker prev-ballot cache are live state across the
+    interruption — crash-resume equivalence must stay bit-identical with
+    the guard on (all-healthy run; the masked-election path is compiled
+    in)."""
+    mesh = make_mesh(data=8)
+    model = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
+    _assert_resumed_matches(tmp_path, mesh, model, blocks,
+                            vote_guard="enforce", vote_buckets=4)
+
+
 def test_crash_resume_lazy_elected_cache_bit_identical(tmp_path):
     """vote_every=4: the packed elected-sign cache is live state across the
     interruption — stale signs applied on non-vote steps must come from the

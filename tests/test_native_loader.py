@@ -5,6 +5,8 @@ input pipeline (run_clm.py:316-381): same [global_batch, block] int32
 contract as the Python batch_iterator, deterministic shuffle, drop-last.
 """
 
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -116,4 +118,82 @@ def test_errors(tmp_path):
     dl = NativeTokenLoader([p], 2)
     with pytest.raises(RuntimeError):  # batch > num blocks
         dl.batches(99)
+    dl.close()
+
+
+# --------------------------------------------------- shard robustness (ISSUE 5)
+def test_corrupt_shard_skipped_loudly(tmp_path, capsys):
+    """A misaligned (torn-write) shard is SKIPPED with a warning and a
+    counter instead of killing the run; the survivors still serve blocks."""
+    good = _write_bin(tmp_path, "good.bin", np.arange(16))
+    bad = tmp_path / "torn.bin"
+    bad.write_bytes(b"\x01\x02\x03")  # 3 bytes: not a uint16 multiple
+    dl = NativeTokenLoader([bad, good], block_size=8)
+    assert len(dl) == 2
+    np.testing.assert_array_equal(dl.read_block(0), np.arange(8))
+    assert dl.health_metrics() == {"skipped_shards": 1,
+                                   "shard_read_retries": 0}
+    assert "skipping corrupt" in capsys.readouterr().out
+    dl.close()
+
+
+def test_all_shards_corrupt_raises(tmp_path):
+    from distributed_lion_tpu.data.native_loader import CorruptShardError
+
+    bad = tmp_path / "torn.bin"
+    bad.write_bytes(b"\x01")
+    with pytest.raises(CorruptShardError):
+        NativeTokenLoader([bad], block_size=8)
+
+
+def test_missing_shard_retried_then_skipped(tmp_path, monkeypatch):
+    """Transient I/O earns the backoff schedule: a shard that appears
+    between attempts is admitted (retry actually re-probes)."""
+    import distributed_lion_tpu.data.native_loader as nl
+
+    good = _write_bin(tmp_path, "good.bin", np.arange(16))
+    flaky = tmp_path / "flaky.bin"
+    calls = {"n": 0}
+    real_validate = nl._validate_shard
+
+    def heal_on_second_try(path, dtype_bytes):
+        if pathlib.Path(path).name == "flaky.bin":
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise OSError("transient")
+            np.arange(16, dtype=np.uint16).tofile(flaky)
+        return real_validate(path, dtype_bytes)
+
+    monkeypatch.setattr(nl, "_validate_shard", heal_on_second_try)
+    monkeypatch.setattr(nl, "SHARD_BACKOFF_S", 0.001)
+    dl = NativeTokenLoader([flaky, good], block_size=8)
+    assert dl.health_metrics() == {"skipped_shards": 0,
+                                   "shard_read_retries": 1}
+    assert len(dl) == 4  # both shards admitted
+    assert dl.shards == [str(flaky), str(good)]  # served fleet, in order
+    dl.close()
+
+
+def test_health_metrics_ride_the_batch_iterator(tmp_path):
+    p = _write_bin(tmp_path, "h.bin", np.arange(64))
+    dl = NativeTokenLoader([p], block_size=8)
+    it = dl.batches(2, seed=0)
+    assert it.health_metrics() == {"skipped_shards": 0,
+                                   "shard_read_retries": 0}
+    next(it)
+    dl.close()
+
+
+def test_read_block_out_of_range_fails_fast(tmp_path):
+    """Deterministic failures (index out of range) must NOT burn the
+    transient-I/O backoff schedule or inflate the retry counter."""
+    import time as _time
+
+    p = _write_bin(tmp_path, "r.bin", np.arange(32))
+    dl = NativeTokenLoader([p], block_size=8)
+    t0 = _time.monotonic()
+    with pytest.raises(IndexError):
+        dl.read_block(99)
+    assert _time.monotonic() - t0 < 0.05
+    assert dl.health_metrics()["shard_read_retries"] == 0
     dl.close()
