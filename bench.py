@@ -401,6 +401,20 @@ def run_inner() -> None:
             model_cfg, attn_impl=attn_impl,
             flash_block_q=bq, flash_block_kv=bkv,
             flash_block_q_bwd=bqb, flash_block_kv_bwd=bkvb)
+    # provenance of what 'auto' MEANS on this device: the autotune cache
+    # resolver (ops/autotune — the same lookup ops.attention's auto
+    # dispatch applies at trace time) maps an auto spec to its tuned
+    # explicit form; "auto" back means cache miss → heuristic dispatch.
+    # Recorded in the row so a sweep/bench log is self-describing; null
+    # for explicit specs (nothing was resolved).
+    attn_resolved = None
+    if attn_impl == "auto":
+        from distributed_lion_tpu.ops.autotune import resolve_attn_spec
+
+        attn_resolved = resolve_attn_spec(
+            "auto", t=model_cfg.n_ctx,
+            head_dim=model_cfg.d_model // model_cfg.n_head,
+            dtype=jnp.dtype(model_cfg.compute_dtype).name)
     cfg = TrainConfig(
         lion=True,
         async_grad=True,
@@ -518,6 +532,7 @@ def run_inner() -> None:
                     "telemetry": int(bench_telemetry),
                 },
                 "vote_buckets": vote_buckets,
+                "attn_resolved": attn_resolved,
                 # election dynamics of the timed steps (train/telemetry):
                 # margin histogram (fractions per voted coordinate),
                 # elected-sign flip rate, worker disagreement — the

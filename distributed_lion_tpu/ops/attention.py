@@ -16,12 +16,17 @@
 - ``splash`` — the newer Pallas TPU splash kernel family (sparse-mask
   blocking); faster than ``flash`` at moderate T but still behind ``xla``
   at T=1024 on v5e (scripts/SWEEP_v5e.md).
-- ``auto``  — on TPU: caller-pinned tiles → flash with those tiles (any
-  shape); flash for T ≥ 2048 (its memory regime); tile-tuned flash
-  (512x1024) at the swept flagship shape (T=1024, head_dim=64 — GPT-2);
-  xla everywhere else (tuned tiles are per-shape measurements, not safe
-  generalizations). Off TPU: always xla (pinned forward tiles are unused
-  there — Pallas kernels are TPU-only).
+- ``auto``  — on TPU, in priority order: caller-pinned tiles → flash with
+  those tiles at any shape (an explicit ``auto@BQxBKV`` spec is an
+  operator decision — it must stay sweepable even when a cache entry
+  exists for the shape); otherwise an autotune-cache hit for this
+  device_kind × (T, head_dim) × dtype → flash with the MEASURED winning
+  tiles (ops/autotune, knob ``flash_tiles`` — produced by
+  ``cli/run_tune``); flash for T ≥ 2048 (its memory regime); tile-tuned
+  flash (512x1024) at the swept flagship shape (T=1024, head_dim=64 —
+  GPT-2); xla everywhere else (tuned tiles are per-shape measurements,
+  not safe generalizations). Off TPU: always xla (pinned forward tiles
+  are unused there — Pallas kernels are TPU-only).
 
 All take q, k, v as [B, H, T, head_dim] and return [B, H, T, head_dim] in
 q's dtype. Causal only (decoder framework).
@@ -110,6 +115,19 @@ def attention_splash(q, k, v, *, causal: bool = True,
     )
 
     B, H, T, hd = q.shape
+    # the installed splash kernel requires head_dim % 128 == 0 (lane width);
+    # GPT-2's hd=64 (and any other non-multiple) is padded up with zero
+    # columns and the output sliced back. Exact, not approximate: q·k over
+    # the zero columns adds nothing to any score, and the zero v columns
+    # only produce output columns that are sliced away. The pad costs real
+    # MXU FLOPs (hd 64 → 128 doubles the qk/pv inner dim), which is why
+    # `auto` never dispatches here — explicit splash requests and the
+    # autotune tuner (which times the kernel PADDED, so its numbers stay
+    # honest) accept the cost knowingly.
+    hd_pad = -(-hd // 128) * 128
+    if hd_pad != hd:
+        pad = [(0, 0)] * 3 + [(0, hd_pad - hd)]
+        q, k, v = (jnp.pad(x, pad) for x in (q, k, v))
     one = ml.CausalMask((T, T)) if causal else ml.FullMask((T, T))
     mask = ml.MultiHeadMask([one for _ in range(H)])
     bs = None
@@ -121,8 +139,11 @@ def attention_splash(q, k, v, *, causal: bool = True,
                            block_q_dq=bq, block_kv_dq=bkv)
     kernel = sk.make_splash_mha_single_device(mask=mask, block_sizes=bs,
                                               interpret=interpret)
+    # scale by the REAL head_dim — the zero pad must not change the softmax
     qs = (q * (1.0 / math.sqrt(hd))).astype(q.dtype)
     out = jax.vmap(kernel)(qs, k, v)
+    if hd_pad != hd:
+        out = out[..., :hd]
     return out.astype(q.dtype)
 
 
@@ -150,7 +171,30 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto",
     if impl == "auto":
         on_tpu = jax.default_backend() == "tpu"
         T = q.shape[2]
-        if on_tpu and (block_q or block_kv or block_q_bwd or block_kv_bwd):
+        tuned = None
+        if on_tpu and not (block_q or block_kv or block_q_bwd or block_kv_bwd):
+            # no caller pins → consult the autotune cache (ops/autotune,
+            # knob 'flash_tiles'): a measured winner for THIS device_kind
+            # × (T, head_dim) × dtype outranks every heuristic below —
+            # but never an explicit pin (the elif), which is how sweeps
+            # measure non-cached tiles. Device-keyed, so a cache produced
+            # elsewhere never leaks here; a corrupt cache is loud and
+            # reads as a miss. The lookup is host-side at trace time —
+            # one file read per process (module-level memo in autotune).
+            from distributed_lion_tpu.ops.autotune import (
+                attn_shape_key,
+                lookup,
+            )
+
+            tuned = lookup("flash_tiles", attn_shape_key(T, q.shape[3]),
+                           jnp.dtype(q.dtype).name)
+        if tuned:
+            impl = "flash"
+            block_q = int(tuned.get("block_q", 0))
+            block_kv = int(tuned.get("block_kv", 0))
+            block_q_bwd = int(tuned.get("block_q_bwd", 0))
+            block_kv_bwd = int(tuned.get("block_kv_bwd", 0))
+        elif on_tpu and (block_q or block_kv or block_q_bwd or block_kv_bwd):
             # caller-pinned tiles are a flash knob: honor them at ANY shape
             # rather than silently running untiled xla (a config like
             # auto@256x512 would otherwise report numbers and tune nothing

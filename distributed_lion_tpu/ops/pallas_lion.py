@@ -35,26 +35,43 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
-ROW_BLOCK = 512  # rows per grid step → (512, 128) f32 blocks = 256 KiB
+ROW_BLOCK = 512  # default rows per grid step → (512, 128) f32 blocks =
+# 256 KiB. Every kernel below takes a ``row_block`` override (0 = this
+# default) threaded from the autotune cache (ops/autotune, knob
+# 'lion_row_block') — tile geometry is a measured perf knob, never a
+# numerics knob: outputs are bit-identical at any row_block (pinned by
+# tests/test_autotune.py).
 MIN_ROWS = 32    # min row granularity: covers the (8,128) f32, (16,128)
 # bf16 and (32,128) int8 native tile shapes, so small bucket windows
 # compile on hardware without padding all the way to a full ROW_BLOCK
 
 
-def _grid_rows(n: int) -> tuple[int, int]:
+def _resolve_row_block(row_block: int) -> int:
+    if row_block == 0:
+        return ROW_BLOCK
+    if row_block < MIN_ROWS or row_block % MIN_ROWS:
+        raise ValueError(
+            f"row_block must be a positive multiple of {MIN_ROWS} "
+            f"(the int8 native-tile sublane count), got {row_block}")
+    return row_block
+
+
+def _grid_rows(n: int, row_block: int = 0) -> tuple[int, int]:
     """(padded rows, rows per grid step) for an [n] flat operand. Large
-    inputs tile at ROW_BLOCK as before; small ones (per-leaf bucket windows)
-    shrink the block to the input instead of zero-padding 64K elements."""
+    inputs tile at ``row_block`` (default ROW_BLOCK); small ones (per-leaf
+    bucket windows) shrink the block to the input instead of zero-padding
+    64K elements."""
+    rb = _resolve_row_block(row_block)
     rows = max(1, math.ceil(n / LANES))
     rows = math.ceil(rows / MIN_ROWS) * MIN_ROWS
-    block = min(ROW_BLOCK, rows)
+    block = min(rb, rows)
     return math.ceil(rows / block) * block, block
 
 
-def _pad_to_grid(flat: jnp.ndarray) -> tuple[jnp.ndarray, int]:
+def _pad_to_grid(flat: jnp.ndarray, row_block: int = 0) -> tuple[jnp.ndarray, int]:
     """[n] → [rows, 128] zero-padded to the _grid_rows geometry."""
     n = flat.shape[0]
-    rows, _ = _grid_rows(n)
+    rows, _ = _grid_rows(n, row_block)
     pad = rows * LANES - n
     return jnp.pad(flat, (0, pad)).reshape(rows, LANES), n
 
@@ -65,13 +82,14 @@ def _ballot_kernel(b1: float, g_ref, m_ref, out_ref):
 
 
 def fused_ballots(
-    g_flat: jnp.ndarray, m_flat: jnp.ndarray, b1: float, *, interpret: bool = False
+    g_flat: jnp.ndarray, m_flat: jnp.ndarray, b1: float, *,
+    interpret: bool = False, row_block: int = 0
 ) -> jnp.ndarray:
     """[n] grads + momentum → [n] int8 ±1 ballots (ref :68-71 semantics:
     zero update votes −1, the ``> 0`` encoding)."""
-    g2, n = _pad_to_grid(g_flat)
-    m2, _ = _pad_to_grid(m_flat)
-    rows, block = g2.shape[0], _grid_rows(n)[1]
+    g2, n = _pad_to_grid(g_flat, row_block)
+    m2, _ = _pad_to_grid(m_flat, row_block)
+    rows, block = g2.shape[0], _grid_rows(n, row_block)[1]
     spec = pl.BlockSpec((block, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     out = pl.pallas_call(
         functools.partial(_ballot_kernel, b1),
@@ -107,13 +125,14 @@ def fused_apply(
     b2: float,
     *,
     interpret: bool = False,
+    row_block: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One fused pass: decay + elected update + momentum (ref :64, :91-96)."""
-    p2, n = _pad_to_grid(p_flat)
-    g2, _ = _pad_to_grid(g_flat)
-    m2, _ = _pad_to_grid(m_flat)
-    t2, _ = _pad_to_grid(vote_total.astype(jnp.int32))
-    rows, blk = p2.shape[0], _grid_rows(n)[1]
+    p2, n = _pad_to_grid(p_flat, row_block)
+    g2, _ = _pad_to_grid(g_flat, row_block)
+    m2, _ = _pad_to_grid(m_flat, row_block)
+    t2, _ = _pad_to_grid(vote_total.astype(jnp.int32), row_block)
+    rows, blk = p2.shape[0], _grid_rows(n, row_block)[1]
     lr_arr = jnp.asarray(lr, jnp.float32).reshape(1)
     block = lambda: pl.BlockSpec((blk, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
     p_new, m_new = pl.pallas_call(
@@ -141,6 +160,7 @@ def fused_ballots_window(
     start: int,
     length: int,
     interpret: bool = False,
+    row_block: int = 0,
 ) -> jnp.ndarray:
     """Ballots for the ``[start, start + length)`` window of shared flat
     (g, m) buffers — the per-bucket entry point of the pipelined optimizer
@@ -149,7 +169,8 @@ def fused_ballots_window(
     path's full-pytree ``jnp.concatenate`` materialization."""
     g_w = jax.lax.slice(g_flat, (start,), (start + length,))
     m_w = jax.lax.slice(m_flat, (start,), (start + length,))
-    return fused_ballots(g_w, m_w, b1, interpret=interpret)
+    return fused_ballots(g_w, m_w, b1, interpret=interpret,
+                         row_block=row_block)
 
 
 def fused_apply_window(
@@ -165,6 +186,7 @@ def fused_apply_window(
     length: int,
     total_offset: int = 0,
     interpret: bool = False,
+    row_block: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused decay + elected update + momentum for one window of shared flat
     (p, g, m) buffers against ``bucket_total[total_offset :
@@ -177,7 +199,8 @@ def fused_apply_window(
     m_w = jax.lax.slice(m_flat, (start,), (start + length,))
     t_w = jax.lax.slice(bucket_total, (total_offset,),
                         (total_offset + length,))
-    return fused_apply(p_w, g_w, m_w, t_w, lr, wd, b2, interpret=interpret)
+    return fused_apply(p_w, g_w, m_w, t_w, lr, wd, b2, interpret=interpret,
+                       row_block=row_block)
 
 
 def _stats_kernel(w: int, nbins: int, ballot_ref, tot_ref, mask_ref, out_ref):
@@ -214,6 +237,7 @@ def bucket_vote_stats(
     nbins: int,
     *,
     interpret: bool = False,
+    row_block: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One bucket's vote-health tallies from its int8 ballots and the
     bucket's collective result: ``(margin bincount i32[nbins], local
@@ -222,10 +246,10 @@ def bucket_vote_stats(
     Reads arrays the bucket pipeline already has in VMEM; never touches
     what is elected. Margin bins are only meaningful when ``total`` is an
     exact tally (the caller zeroes the histogram for ±1-proxy wires)."""
-    b2, n = _pad_to_grid(ballot.astype(jnp.int8))
-    t2, _ = _pad_to_grid(total.astype(jnp.int32))
-    m2, _ = _pad_to_grid(jnp.ones((n,), jnp.int32))
-    rows, block = b2.shape[0], _grid_rows(n)[1]
+    b2, n = _pad_to_grid(ballot.astype(jnp.int8), row_block)
+    t2, _ = _pad_to_grid(total.astype(jnp.int32), row_block)
+    m2, _ = _pad_to_grid(jnp.ones((n,), jnp.int32), row_block)
+    rows, block = b2.shape[0], _grid_rows(n, row_block)[1]
     spec = lambda: pl.BlockSpec((block, LANES), lambda i: (i, 0),  # noqa: E731
                                 memory_space=pltpu.VMEM)
     out = pl.pallas_call(

@@ -143,6 +143,7 @@ def distributed_lion(
     vote_buckets: int = 1,
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
+    row_block: int = 0,
     telemetry: bool = False,
     guard: str = "off",
 ) -> FunctionalOptimizer:
@@ -189,6 +190,13 @@ def distributed_lion(
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
             The Pallas path covers the deterministic mode with
             dtype-uniform pytrees; other cases fall back to XLA.
+        row_block: Pallas kernel tile override (rows per grid step,
+            multiple of 32; 0 = pallas_lion.ROW_BLOCK). A pure tiling
+            knob resolved from the autotune cache by the Trainer
+            (ops/autotune, knob 'lion_row_block'): params/momentum/
+            elections are bit-identical at any value
+            (tests/test_autotune.py), only VMEM residency and grid
+            geometry change.
         telemetry: True → ``step`` returns a third value, the per-step
             vote-health *frame* (train.telemetry: margin bincount over the
             voted coordinates for tally wires, packed elected-sign state,
@@ -262,9 +270,13 @@ def distributed_lion(
     guard_on = guard != "off"
     enforce = guard == "enforce"
     stochastic = max_grad_norm is not None
-    from distributed_lion_tpu.ops.pallas_lion import resolve_kernel_mode
+    from distributed_lion_tpu.ops.pallas_lion import (
+        _resolve_row_block,
+        resolve_kernel_mode,
+    )
 
     interpret = resolve_kernel_mode(kernel)  # None → XLA path
+    _resolve_row_block(row_block)  # fail at build time, not mid-trace
     if telemetry:
         # train.telemetry is a leaf module (imports ops/parallel only), so
         # this upward import cannot cycle; it stays out of the default path.
@@ -369,7 +381,7 @@ def distributed_lion(
             parts = [
                 pallas_lion.fused_ballots_window(
                     g_f[li], m_f[li], b1, start=ls, length=ln,
-                    interpret=interpret)
+                    interpret=interpret, row_block=row_block)
                 for li, ls, ln, _ in windows[k]
             ]
             return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
@@ -379,7 +391,7 @@ def distributed_lion(
                 pieces[li].append(pallas_lion.fused_apply_window(
                     p_f[li], g_f[li], m_f[li], total, lr, weight_decay, b2,
                     start=ls, length=ln, total_offset=boff,
-                    interpret=interpret))
+                    interpret=interpret, row_block=row_block))
 
         totals = []
         # telemetry rides the bucket pipeline: each bucket's stats kernel
@@ -405,7 +417,8 @@ def distributed_lion(
                 ballots > 0, axis_name, wire, alive))
             if telemetry:
                 h, d = pallas_lion.bucket_vote_stats(
-                    ballots, totals[k], w, _vt.NBINS, interpret=interpret)
+                    ballots, totals[k], w, _vt.NBINS, interpret=interpret,
+                    row_block=row_block)
                 hist_acc, dis_acc = hist_acc + h, dis_acc + d
                 packed_parts.append(pack_signs(totals[k] > 0))
             if guard_on:

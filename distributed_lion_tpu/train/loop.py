@@ -100,10 +100,14 @@ class TrainConfig:
     # coordinate slice (wire volume ÷ K; packed_a2a at K=4 ≈ 0.375 bit/
     # param/step at W=4, the BASELINE.md ≤0.5-bit comm budget), stale
     # elected signs applied elsewhere (optim.distributed_lion). 0 = auto:
-    # 4 when W > 1, params replicated, and the ballot is ≥10M coordinates
-    # (where wire volume matters; trajectory-overlay at that scale is
-    # evidenced by runs/parity's lazy leg) — else 1, the reference's
-    # every-step vote. Pass --vote_every 1 to force strict voting.
+    # currently ALWAYS 1, the reference's strict every-step vote — lazy
+    # voting is opt-in (--vote_every 4) until a full-scale parity:lazy leg
+    # PASSES the pre-registered criterion (check_evidence parity:lazy;
+    # runs/parity holds no lazy curve yet, so auto must not default to a
+    # trajectory claim the evidence doesn't back — VERDICT weak #1).
+    # Mechanism correctness at test scale IS pinned (tests/test_vote_every
+    # convergence + replica consistency); the open question is trajectory
+    # parity at 100M+ scale, which only the parity leg can answer.
     vote_buckets: int = 0  # B > 1: bucketed, overlapped vote wire — the
     # ballot splits into B contiguous wire-aligned chunks (codec.
     # bucket_bounds) voted as B independent collectives, software-pipelined
@@ -114,6 +118,12 @@ class TrainConfig:
     # (resolve_auto_comm): 4 when W > 1 and the per-step ballot slice is
     # ≥ AUTO_BUCKET_MIN_COORDS, else 1 (the monolithic vote).
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
+    row_block: int = 0  # Pallas lion kernel tile rows (multiple of 32).
+    # 0 = auto: the Trainer consults the device-keyed autotune cache
+    # (ops/autotune, knob 'lion_row_block', cli/run_tune) when the Pallas
+    # path is live on TPU, else pallas_lion.ROW_BLOCK. Pure tiling — the
+    # elections/params are bit-identical at any value
+    # (tests/test_autotune.py); only VMEM residency changes.
     mom_dtype: str = ""  # Lion momentum dtype override ('bfloat16' halves
     # the per-worker optimizer state and its read/write traffic — at 7B
     # full-param scale that is ~14 GB of HBM; '' = the param dtype, the
@@ -280,10 +290,13 @@ def validate_seq_block(cfg: "TrainConfig", model_cfg, sp: int) -> None:
         )
 
 
-# lazy vote refresh auto-enables only when the ballot is at least this many
-# coordinates: below it the full vote is cheap anyway, and keeping tiny
-# (test/debug) models on the reference's every-step vote means 'auto'
-# changes bytes-on-wire, never the optimizer trajectory, at small scale
+# the ballot size at which lazy vote refresh WOULD be worth auto-enabling
+# (below it the full vote is cheap anyway). Auto currently resolves
+# vote_every to 1 regardless — lazy is opt-in until a full-scale
+# parity:lazy leg passes the pre-registered criterion (see
+# resolve_auto_comm) — but the threshold is kept: it still gates the
+# advisory trainer message, and it is the line the auto default re-arms at
+# once the evidence lands.
 AUTO_LAZY_MIN_PARAMS = 10_000_000
 
 # bucketed-vote auto threshold: pipeline the wire only when the PER-STEP
@@ -352,37 +365,88 @@ def resolve_auto_comm(cfg: TrainConfig, mesh, n_params: int,
             # when the host layout gives no intact ICI data subgroup
             wire = "packed_a2a"
     if ve == 0:
-        lazy_ok = (cfg.lion and world > 1 and params_replicated
-                   and n_params >= AUTO_LAZY_MIN_PARAMS)
-        ve = 4 if lazy_ok else 1
-        if ve > 1:
-            # state the MEASURED bits for the resolved wire, not a fixed
-            # budget claim: an explicit --wire sign_psum with auto
-            # vote_every lands at 2 bits/param/step — lazy-sliced, but
-            # 4x over the 0.5-bit budget the packed_a2a default meets
+        # The lazy default is OFF until evidenced: auto resolves to the
+        # reference's strict every-step vote. Round 4 shipped ve=4 here for
+        # big replicated ballots with a message claiming the trajectory
+        # "overlays every-step voting at this scale (runs/parity)" — but
+        # runs/parity holds NO lazy leg, so the default was asserting
+        # evidence that does not exist (VERDICT weak #1). Until a
+        # full-scale lazy leg PASSES the pre-registered criterion
+        # (scripts/check_evidence.py parity:lazy + PARITY_EPS_NATS), lazy
+        # voting stays an explicit opt-in; the candidate threshold it
+        # would re-arm at is kept as AUTO_LAZY_MIN_PARAMS.
+        ve = 1
+        if (cfg.lion and world > 1 and params_replicated
+                and n_params >= AUTO_LAZY_MIN_PARAMS):
             bits = wire_bytes_per_param(
-                n_params, world, wire, vote_every=ve)["bits_per_param"]
+                n_params, world, wire, vote_every=4)["bits_per_param"]
             print(
-                f"[trainer] auto comm: wire={wire} vote_every=4 — lazy "
-                f"1/4-slice votes cut the {n_params/1e6:.0f}M-coordinate "
-                f"ballot to {bits:.2f} bits/param/step "
-                f"({'under' if bits <= 0.5 else 'ABOVE'} the 0.5-bit "
-                "budget); trajectory overlays every-step voting at this "
-                "scale (runs/parity). Pass --vote_every 1 for the "
-                "reference's strict every-step vote."
+                f"[trainer] auto comm: wire={wire} vote_every=1 (strict "
+                f"every-step voting). Lazy --vote_every 4 would cut the "
+                f"{n_params/1e6:.0f}M-coordinate ballot to {bits:.2f} "
+                "bits/param/step, but it stays opt-in until the "
+                "full-scale parity:lazy leg passes the pre-registered "
+                "criterion (scripts/loss_parity.py; check_evidence "
+                "parity:lazy)."
             )
     if vb == 0:
         # bucketed overlap: worth it only when there is a wire (W > 1) AND
         # the per-step ballot slice is big enough that each of 4 buckets
         # still amortizes collective launch latency. Elections are
         # bit-identical at any B, so auto never changes the trajectory —
-        # only whether the wire can hide behind the fused apply.
+        # only whether the wire can hide behind the fused apply. A
+        # device-keyed autotune measurement for THIS ballot size
+        # (ops/autotune knob 'vote_buckets', key dtype int8 — the wire
+        # payload) outranks the heuristic; the heuristic stays the miss
+        # path.
         n_voted = (n_params if ve <= 1
                    else min(n_params, vote_chunk_elems(n_params, ve)))
-        vb = (4 if (cfg.lion and world > 1
-                    and n_voted >= AUTO_BUCKET_MIN_COORDS) else 1)
+        tuned_vb = None
+        if cfg.lion and world > 1:
+            from distributed_lion_tpu.ops.autotune import lookup
+
+            v = lookup("vote_buckets", f"N{n_voted}", "int8") or {}
+            # .get, not [..]: the schema admits any {str:int} value, and a
+            # mistyped operator-written entry must degrade to the
+            # heuristic (the autotune failure philosophy), never crash
+            # trainer construction
+            if isinstance(v.get("vote_buckets"), int):
+                tuned_vb = v["vote_buckets"]
+        if tuned_vb:
+            vb = tuned_vb
+        else:
+            vb = (4 if (cfg.lion and world > 1
+                        and n_voted >= AUTO_BUCKET_MIN_COORDS) else 1)
     return dataclasses.replace(cfg, wire=wire, vote_every=ve,
                                vote_buckets=vb)
+
+
+def _resolve_row_block_auto(cfg: TrainConfig, n_params: int,
+                            params) -> TrainConfig:
+    """Resolve ``row_block=0`` (auto) from the device-keyed autotune cache
+    when the Pallas lion path is actually live — TPU backend and
+    ``kernel`` auto/pallas. Key: knob ``lion_row_block``, shape
+    ``N<ballot coords>``, dtype = the momentum dtype (mom_dtype override
+    or the param dtype, mirroring distributed_lion's state init). Off-TPU
+    and on cache miss the 0 passes through and pallas_lion.ROW_BLOCK
+    applies — interpret-mode tests stay independent of whatever cache the
+    repo happens to carry."""
+    if cfg.row_block != 0 or not cfg.lion or cfg.kernel == "xla":
+        return cfg
+    from distributed_lion_tpu.ops.autotune import lookup
+    from distributed_lion_tpu.ops.pallas_lion import pallas_available
+
+    if not pallas_available():
+        return cfg
+    leaves = jax.tree.leaves(params)
+    mom_dtype = (cfg.mom_dtype
+                 or (jnp.dtype(leaves[0].dtype).name if leaves else "float32"))
+    v = lookup("lion_row_block", f"N{n_params}", jnp.dtype(mom_dtype).name)
+    # .get, not [..]: a mistyped operator-written entry degrades to the
+    # built-in ROW_BLOCK (autotune failure philosophy), never crashes init
+    if not v or not isinstance(v.get("row_block"), int):
+        return cfg
+    return dataclasses.replace(cfg, row_block=v["row_block"])
 
 
 def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
@@ -429,6 +493,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             vote_every=cfg.vote_every or 1,
             vote_buckets=cfg.vote_buckets or 1,
             kernel=cfg.kernel,
+            row_block=cfg.row_block,
             mom_dtype=mom_dtype,
             telemetry=cfg.telemetry,
             guard=cfg.vote_guard,
@@ -499,10 +564,12 @@ class Trainer:
         replicated). When set, ``loss_fn`` takes
         ``(params, frozen, batch, dropout_key)`` and ``frozen_specs`` gives
         its PartitionSpecs (default replicated)."""
+        n_params = count_params(params)
         cfg = resolve_auto_comm(
-            cfg, mesh, count_params(params),
+            cfg, mesh, n_params,
             params_replicated=not _spec_sharded_axes(param_specs),
         )
+        cfg = _resolve_row_block_auto(cfg, n_params, params)
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)

@@ -120,6 +120,11 @@ def run(remat: str, batch_per_dev: int, attn_impl: str = "auto",
         "backend": rec.get("backend"),
         "device_kind": rec.get("device_kind"),
     })
+    if rec.get("attn_resolved") is not None:
+        # what the autotune-cache resolver made of an 'auto' attn spec on
+        # the measuring device (bench.py consults ops/autotune — the one
+        # resolver — and reports it); "auto" = cache miss, heuristics ran
+        row["attn_resolved"] = rec["attn_resolved"]
     print(json.dumps(row), flush=True)
     return float(rec.get("value") or 0.0)
 

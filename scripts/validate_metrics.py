@@ -20,11 +20,14 @@ append-mode logs legitimately end that way.
 Non-JSONL arguments (``*.json``) are validated as strict single-document
 JSON artifacts, so EVERY JSON artifact the repo writes passes one
 validator: crash bundles (``crash/step_*/bundle.json`` — must carry
-step/reason/config, telemetry.write_crash_bundle) and checkpoint
+step/reason/config, telemetry.write_crash_bundle), checkpoint
 manifests (``manifest.json`` — must carry format/step/files with
-sha256+bytes per file, checkpoint.write_manifest). The same NaN-token
-rejection applies: all three writers pass ``allow_nan=False`` and this
-script is the CI check that they keep doing so.
+sha256+bytes per file, checkpoint.write_manifest), and the autotune
+tuning cache (``tuning_cache.json`` — full check delegated to
+ops/autotune.validate_cache_doc, the cache's single schema authority).
+The same NaN-token rejection applies: all the writers pass
+``allow_nan=False`` and this script is the CI check that they keep
+doing so.
 
     python scripts/validate_metrics.py runs/telemetry/metrics.jsonl \
         runs/telemetry/crash/step_*/bundle.json \
@@ -91,12 +94,36 @@ def validate_file(path: str) -> list[str]:
     return errors
 
 
-# required top-level keys per known single-document artifact name
+# required top-level keys per known single-document artifact name.
+# (tuning_cache.json is NOT listed here: it dispatches below on its
+# embedded format stamp — any filename, e.g. a $DLT_TUNE_CACHE override —
+# and delegates wholesale to ops/autotune.validate_cache_doc.)
 _DOC_SCHEMAS = {
     "bundle.json": ("step", "reason", "config"),
     "manifest.json": ("format", "step", "files"),
 }
 _SHA256 = re.compile(r"^[0-9a-f]{64}$")
+_TUNE_CACHE_FORMAT = "dlt-tune-cache-v1"  # == ops/autotune.CACHE_FORMAT
+
+
+def _tuning_cache_errors(path: str, doc) -> list[str]:
+    """Full strict-schema check for the autotune cache artifact, delegated
+    to the single source of truth — ops/autotune.validate_cache_doc —
+    loaded by FILE PATH (autotune is stdlib-only at import, like
+    train/resilience) so this validator stays jax-free."""
+    import importlib.util
+
+    at_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "distributed_lion_tpu", "ops", "autotune.py")
+    try:
+        spec = importlib.util.spec_from_file_location("dlt_autotune_vm",
+                                                      at_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as e:
+        return [f"{path}: cannot load autotune validator ({e})"]
+    return [f"{path}: {e}" for e in mod.validate_cache_doc(doc)]
 
 
 def validate_json_doc(path: str) -> list[str]:
@@ -118,6 +145,11 @@ def validate_json_doc(path: str) -> list[str]:
     if not isinstance(doc, dict):
         return [f"{path}: document is {type(doc).__name__}, not an object"]
     name = os.path.basename(path)
+    if name == "tuning_cache.json" or doc.get("format") == _TUNE_CACHE_FORMAT:
+        # dispatch on the embedded format stamp as well as the canonical
+        # name: a cache at any $DLT_TUNE_CACHE path (the documented drive)
+        # must get the strict schema, not just the generic checks
+        return _tuning_cache_errors(path, doc)
     for key in _DOC_SCHEMAS.get(name, ()):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")

@@ -1,9 +1,10 @@
-"""Round-4 multi-chip default recipe: the comm sentinels (wire='auto',
-vote_every=0) must resolve to the measured budget configuration —
-packed_a2a + lazy 1/4-slice votes (BASELINE.md ≤0.5 bit/param/step) — on
-big replicated-param dp meshes, and degrade to the reference's strict
-every-step vote everywhere the lazy cache is unsound (sharded params) or
-pointless (tiny ballots, W=1). The recipe itself lives in ONE place,
+"""Multi-chip default recipe: the comm sentinels (wire='auto',
+vote_every=0) must resolve to the measured minimum-byte wire (packed_a2a
+on big dp meshes) with the reference's STRICT every-step vote — lazy
+vote_every is opt-in until the full-scale parity:lazy leg passes the
+pre-registered criterion (check_evidence parity:lazy; the round-4 lazy
+auto-default claimed runs/parity evidence that was never captured —
+VERDICT weak #1). The recipe itself lives in ONE place,
 train/loop.resolve_auto_comm; these tests pin its decision matrix and that
 the Trainer applies it end to end."""
 
@@ -29,8 +30,9 @@ def mesh8():
 def test_big_replicated_dp_gets_budget_recipe(mesh8):
     r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
                           params_replicated=True)
-    assert (r.wire, r.vote_every) == ("packed_a2a", 4)
-    # and the 31M-coordinate per-step slice is big enough for the
+    # strict every-step voting until parity:lazy PASSES (lazy is opt-in)
+    assert (r.wire, r.vote_every) == ("packed_a2a", 1)
+    # and the full 124M-coordinate per-step ballot is big enough for the
     # pipelined (bucketed) wire — tests/test_vote_buckets.py pins the rest
     assert r.vote_buckets == 4
 
@@ -44,7 +46,8 @@ def test_tiny_ballot_keeps_strict_vote(mesh8):
 def test_sharded_params_keep_strict_vote(mesh8):
     """tp/pp/ep-sharded params make the lazy elected-sign cache unsound
     (per-rank ballots over different local shards) — auto must not pick
-    vote_every > 1 there."""
+    vote_every > 1 there, whatever the lazy default becomes once
+    parity:lazy evidence lands."""
     r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
                           params_replicated=False)
     assert r.vote_every == 1
@@ -132,7 +135,7 @@ def test_trainer_resolves_and_steps_with_auto_recipe(mesh8):
     )
     tr = Trainer.for_gpt2(cfg, mesh8, model_cfg)
     assert tr.n_params >= AUTO_LAZY_MIN_PARAMS
-    assert (tr.cfg.wire, tr.cfg.vote_every) == ("packed_a2a", 4)
+    assert (tr.cfg.wire, tr.cfg.vote_every) == ("packed_a2a", 1)
     blocks = synthetic_lm_dataset(max(64, tr.global_train_batch()),
                                   cfg.block_size, model_cfg.vocab_size)
     hist = tr.train(batch_iterator(blocks, tr.global_train_batch(), seed=0),

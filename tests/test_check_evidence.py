@@ -167,6 +167,68 @@ def test_parity_numeric_criterion(tmp_path):
     assert ce.parity_mad(str(d), "vote") is None
 
 
+def test_parity_strict_requires_numeric_pass(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: the parity:vote / parity:lazy stages require the
+    pre-registered criterion to PASS — a present-but-diverged leg reads
+    MISSING. The watcher's automation check still judges presence (a
+    deterministic FAIL needs a human, not an infinite re-fire loop)."""
+    monkeypatch.setattr(ce, "REPO", str(tmp_path))
+    d = tmp_path / "runs" / "parity"
+    d.mkdir(parents=True)
+    (d / "local.jsonl").write_text("\n".join(_leg_lines("local")) + "\n")
+    # within EPS → strict stage captured
+    (d / "vote.jsonl").write_text(
+        "\n".join(_leg_lines("vote", loss=5.0 + ce.PARITY_EPS_NATS / 2))
+        + "\n")
+    assert ce.parity("vote") and ce.parity_strict("vote")
+    # present but diverged → presence yes, strict NO
+    (d / "vote.jsonl").write_text(
+        "\n".join(_leg_lines("vote", loss=5.0 + ce.PARITY_EPS_NATS * 3))
+        + "\n")
+    assert ce.parity("vote")
+    assert not ce.parity_strict("vote")
+    # local is the baseline leg: presence-only semantics
+    assert ce.parity_strict("local")
+    # absent lazy leg: both read missing
+    assert not ce.parity("lazy") and not ce.parity_strict("lazy")
+
+
+def test_autotune_stage(tmp_path, monkeypatch):
+    """The 'autotune' stage: captured only when the committed tuning cache
+    exists, passes the strict schema, AND carries TPU-keyed entries for
+    EVERY knob (a window that dropped after the first knob must re-fire,
+    not permanently skip the rest) — the CPU-produced pipeline-proof
+    artifact alone must read MISSING, as must a corrupt or
+    schema-violating cache."""
+    import json as _json
+
+    KNOBS = ("flash_tiles", "splash_tiles", "lion_row_block",
+             "vocab_chunks", "vote_buckets")
+    cache = tmp_path / "tuning_cache.json"
+    monkeypatch.setattr(ce, "TUNE_CACHE", str(cache))
+    assert not ce.autotune_ok()                       # absent
+    entry = {"value": {"x": 512}, "ms": 1.0}
+    cache.write_text(_json.dumps({
+        "format": "dlt-tune-cache-v1",
+        "entries": {f"cpu|{k}|N10|float32": entry for k in KNOBS}}))
+    assert not ce.autotune_ok()                       # cpu-keyed only
+    cache.write_text(_json.dumps({
+        "format": "dlt-tune-cache-v1",
+        "entries": {"TPU v5 lite|lion_row_block|N10|float32": entry}}))
+    assert not ce.autotune_ok()                       # one knob ≠ complete
+    cache.write_text(_json.dumps({
+        "format": "dlt-tune-cache-v1",
+        "entries": {f"TPU v5 lite|{k}|N10|float32": entry for k in KNOBS}}))
+    assert ce.autotune_ok()                           # all knobs: captured
+    cache.write_text(_json.dumps({
+        "format": "dlt-tune-cache-v1",
+        "entries": {"TPU v5 lite|lion_row_block|N10|float32":
+                    {"value": {}, "ms": 1.0}}}))
+    assert not ce.autotune_ok()                       # schema violation
+    cache.write_text("{torn")
+    assert not ce.autotune_ok()                       # corrupt
+
+
 def test_parity_short_leg_unqualified(tmp_path):
     d = tmp_path / "legs"
     d.mkdir()

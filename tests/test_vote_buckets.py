@@ -294,14 +294,14 @@ def test_resolve_auto_vote_buckets(mesh8):
         resolve_auto_comm,
     )
 
-    # big replicated dp ballot → pipelined wire (the ≥16M slice rule holds
-    # even after vote_every=4 divides the per-step ballot)
+    # big replicated dp ballot → pipelined wire
     r = resolve_auto_comm(TrainConfig(), mesh8, 124_000_000,
                           params_replicated=True)
     assert r.vote_buckets == 4
-    # the per-step slice (n/4 under the auto lazy vote) is what must clear
+    # the per-step slice (n/4 under an EXPLICIT lazy vote — auto resolves
+    # vote_every to strict 1 until parity:lazy passes) is what must clear
     # the threshold — just below it stays monolithic
-    r = resolve_auto_comm(TrainConfig(), mesh8,
+    r = resolve_auto_comm(TrainConfig(vote_every=4), mesh8,
                           AUTO_BUCKET_MIN_COORDS * 4 - 64,
                           params_replicated=True)
     assert r.vote_every == 4 and r.vote_buckets == 1
