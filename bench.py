@@ -474,14 +474,32 @@ def run_inner() -> None:
     # describe the TIMED steps only
     vote_health_summary = trainer.telemetry_summary(reset=True)
 
+    # ring-only run journal (train/journal.py — no file sink) around the
+    # timed window, attributed offline-style by the same analyzer the
+    # runbook's journal stage uses (cli/run_analyze.attribute), so every
+    # BENCH row says where its wall clock went: dispatch = host enqueue +
+    # device backpressure across the timed calls, device = the final
+    # drain. Host timestamps only — the timed loop is untouched beyond
+    # two monotonic reads per dispatch.
+    from distributed_lion_tpu.cli import run_analyze as _run_analyze
+    from distributed_lion_tpu.train.journal import Journal as _Journal
+
+    _jr = _Journal(None, ring=4096)
+    _jr.event("train_start", step=0)
     t0 = time.perf_counter()
-    for _ in range(timed_calls):
-        trainer.params, trainer.state, trainer.vote_health, m = (
-            trainer._train_chunk(trainer.params, trainer.state,
-                                 trainer.vote_health, trainer._frozen_arg(),
-                                 batches, base_key))
-    final_loss = float(np.asarray(jax.device_get(m["loss"])))
+    for _i in range(timed_calls):
+        with _jr.span("dispatch", step=_i * steps_per_call,
+                      steps=steps_per_call):
+            trainer.params, trainer.state, trainer.vote_health, m = (
+                trainer._train_chunk(trainer.params, trainer.state,
+                                     trainer.vote_health,
+                                     trainer._frozen_arg(),
+                                     batches, base_key))
+    with _jr.span("device_wait", step=timed_calls * steps_per_call):
+        final_loss = float(np.asarray(jax.device_get(m["loss"])))
     dt = time.perf_counter() - t0
+    _jr.event("train_end", step=timed_calls * steps_per_call)
+    journal_attribution = _run_analyze.attribute(_jr.records())
     vote_health_summary = trainer.telemetry_summary()
 
     steps = steps_per_call * timed_calls
@@ -533,6 +551,12 @@ def run_inner() -> None:
                 },
                 "vote_buckets": vote_buckets,
                 "attn_resolved": attn_resolved,
+                # step-wall attribution of the timed window (run journal,
+                # train/journal.py + cli/run_analyze): named buckets as
+                # fractions of measured wall, so a sweep/bench row explains
+                # its own ms_per_step — and run_analyze --baseline diffs a
+                # later run against this row to NAME the regressing bucket
+                "journal_attribution": journal_attribution,
                 # election dynamics of the timed steps (train/telemetry):
                 # margin histogram (fractions per voted coordinate),
                 # elected-sign flip rate, worker disagreement — the

@@ -52,6 +52,13 @@ DLT007      non-strict ``json.dump``/``dumps``: without ``allow_nan=False``
 DLT008      mutable default argument (``def f(x, acc=[])``): the default is
             created once and shared across calls — a classic aliasing bug,
             and in config dataclass helpers a cross-run state leak.
+DLT009      bare ``print()`` in a ``train/`` or ``data/`` module outside
+            the journal emitter (``train/journal.py``): console output
+            there must go through ``journal.emit`` — mirrored to stdout
+            exactly as before AND recorded in the run journal — so the
+            control plane gets one consumable event stream instead of 27
+            scattered prints (the ISSUE-7 migration this rule pins).
+            Traced-scope prints stay DLT003's finding.
 ==========  ================================================================
 
 Suppression syntax (both forms take a comma-separated rule list):
@@ -80,6 +87,13 @@ from typing import Iterable, Optional
 
 MESH_AXES = ("data", "tensor", "seq", "pipe", "expert")
 MESH_MODULE_SUFFIX = "parallel/mesh.py"
+# DLT009 scope: modules under these directory segments must route console
+# output through the run-journal emitter (train/journal.emit — mirrored to
+# stdout AND recorded as a journal event), so the control plane consumes
+# ONE event stream instead of scraping scattered prints. The emitter
+# module itself is the one place a real print belongs.
+JOURNAL_DIR_SEGMENTS = ("train", "data")
+JOURNAL_MODULE_SUFFIX = "train/journal.py"
 
 # function/decorator names that put their function argument under a jax
 # trace; terminal-name match so jax.jit / lax.scan / plain jit all hit
@@ -101,6 +115,7 @@ RULES = {
     "DLT006": "swallowed exception (broad except with an inert body)",
     "DLT007": "json.dump/dumps without allow_nan=False",
     "DLT008": "mutable default argument",
+    "DLT009": "bare print in train//data/ outside the journal emitter",
 }
 
 _DISABLE_LINE = re.compile(r"#\s*graft:\s*disable=([A-Z0-9,\s]+)")
@@ -214,8 +229,14 @@ class _Linter(ast.NodeVisitor):
         self.path = path
         self.findings: list[Finding] = []
         self.suppress = _Suppressions(src)
-        self.in_mesh_module = path.replace("\\", "/").endswith(
-            MESH_MODULE_SUFFIX)
+        norm = path.replace("\\", "/")
+        self.in_mesh_module = norm.endswith(MESH_MODULE_SUFFIX)
+        # DLT009 applies to modules living under a train/ or data/
+        # directory, except the emitter module itself
+        self.in_journal_scope = (
+            not norm.endswith(JOURNAL_MODULE_SUFFIX)
+            and any(f"/{seg}/" in norm or norm.startswith(f"{seg}/")
+                    for seg in JOURNAL_DIR_SEGMENTS))
         self._func_stack: list[ast.AST] = []
         self._traced_depth = 0
         # pre-pass: names passed as function args to tracing HOFs anywhere in
@@ -298,6 +319,14 @@ class _Linter(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         if self._traced_depth:
             self._check_traced_call(node)
+        elif (self.in_journal_scope and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            # host-side print in train//data/: DLT009 (a print inside
+            # traced scope is DLT003's — stronger — finding instead)
+            self.emit("DLT009", node,
+                      "bare print() in a train//data/ module bypasses the "
+                      "run journal; route it through train/journal.emit "
+                      "(same stdout mirror, plus a journal event)")
         self._check_prng_serialization(node)
         self._check_json_dump(node)
         if not self.in_mesh_module:

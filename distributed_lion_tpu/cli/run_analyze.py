@@ -1,0 +1,448 @@
+"""Offline run-journal analyzer: where did the wall clock go?
+
+    python -m distributed_lion_tpu.cli.run_analyze runs/journal/journal
+    python -m distributed_lion_tpu.cli.run_analyze runs/journal \\
+        --baseline scripts/last_tpu_measurement.json --json-out report.json
+
+Consumes the JSONL journals ``train/journal.py`` records (one file per
+rank, plus rotations), merges multi-host journals onto one wall timeline
+(each file's meta record anchors its monotonic clock to ``time.time()`` —
+the skew correction), and attributes each interval's measured wall time to
+the named buckets:
+
+    device   — the log-cadence device drain (``device_wait`` spans): the
+               loop's direct view of device-bound time
+    dispatch — host time inside the jitted-call invocations (enqueue, and
+               device backpressure once the in-flight queue fills)
+    data     — batch fetch + host→device transfer (``data_wait``)
+    ckpt     — checkpoint serialize/drain on the step thread (``ckpt/*``;
+               committer-thread spans are excluded — they overlap compute)
+    logging  — metric assembly + telemetry drain + JSONL writes
+
+plus ``other`` (named spans outside the taxonomy, e.g. ``eval``) and
+``unattributed`` (loop bookkeeping no span covers). The identity
+``named + other + unattributed == wall`` must close within tolerance
+(``closes``); ``coverage`` = named/wall is the acceptance number
+(check_evidence's ``journal`` stage requires ≥ 0.95 on a real leg). The
+report also ranks the top stall sources by full span name, reports
+cross-host step-skew percentiles from the per-rank ``step_log`` events,
+and — given ``--baseline`` — diffs the bucket fractions against a
+``BENCH_*.json`` / ``last_tpu_measurement.json`` row's
+``journal_attribution`` summary to NAME the regressing bucket.
+
+Stdlib-only at import (no jax, no package imports), loadable by file path
+— the same dependency-light contract as ``train/resilience``'s manifest
+verifier, so ``scripts/check_evidence.py`` validates journal artifacts on
+boxes without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+# span-name head (before any '/') → attribution bucket. Mirrors the span
+# taxonomy documented in train/journal.py; tests/test_journal.py pins that
+# the trainer only emits heads this table (plus 'eval') knows.
+BUCKET_OF = {
+    "device_wait": "device",
+    "dispatch": "dispatch",
+    "data_wait": "data",
+    "ckpt": "ckpt",
+    "logging_drain": "logging",
+}
+NAMED_BUCKETS = ("device", "dispatch", "data", "ckpt", "logging")
+# |named + other + unattributed − wall| must stay within this fraction of
+# wall (floating accumulation over thousands of spans, nothing more)
+CLOSE_TOL_FRAC = 0.01
+_JOURNAL_RE = re.compile(r"^journal_rank\d+(\.\d+)?\.jsonl$")
+
+
+# ------------------------------------------------------------------- loading
+def _parse_file(path: str) -> tuple[list, int]:
+    """(records, parse_errors) from one journal file. A torn final line
+    (crash mid-write) is tolerated silently — that is the journal's
+    documented durability unit; any other unparseable line counts as a
+    schema error."""
+    records: list = []
+    errors = 0
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return [], 1
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines):
+                continue  # torn tail: never committed
+            errors += 1
+            continue
+        if not isinstance(rec, dict) or not isinstance(rec.get("t"),
+                                                       (int, float)):
+            errors += 1
+            continue
+        records.append(rec)
+    return records, errors
+
+
+def journal_files(directory: str) -> list:
+    """Every journal file under ``directory`` (the trainer's
+    ``<output_dir>/journal`` layout, or the directory itself when it holds
+    the files), rotations included, in (rank, sequence) order."""
+    out = []
+    for base in (directory, os.path.join(directory, "journal")):
+        try:
+            names = sorted(os.listdir(base))
+        except OSError:
+            continue
+        out.extend(os.path.join(base, n) for n in names
+                   if _JOURNAL_RE.match(n))
+        if out:
+            break
+    return out
+
+
+def load_journals(directory: str) -> Optional[dict]:
+    """Merge a run's journals onto one wall timeline.
+
+    Returns ``{"events": [...], "ranks": [...], "schema_errors": int}`` or
+    None when no journal files exist. Every record gains ``tw`` — its wall
+    timestamp, ``meta.wall + (t − meta.t)`` per file — which is what makes
+    records from hosts with different monotonic epochs comparable (each
+    host's monotonic zero is its boot, not an epoch; only the wall anchor
+    relates them)."""
+    files = journal_files(directory)
+    if not files:
+        return None
+    events: list = []
+    errors = 0
+    ranks = set()
+    for path in files:
+        records, errs = _parse_file(path)
+        errors += errs
+        anchor = next((r for r in records if r.get("kind") == "meta"
+                       and isinstance(r.get("wall"), (int, float))), None)
+        if anchor is None:
+            # a journal file with no clock anchor cannot join the merged
+            # timeline — count it against the schema, keep the rest
+            errors += 1
+            continue
+        offset = anchor["wall"] - anchor["t"]
+        for r in records:
+            r["tw"] = r["t"] + offset
+            ranks.add(int(r.get("rank", 0)))
+        events.extend(records)
+    events.sort(key=lambda r: r["tw"])
+    return {"events": events, "ranks": sorted(ranks),
+            "schema_errors": errors}
+
+
+# --------------------------------------------------------------- attribution
+def _bucket(name: str) -> Optional[str]:
+    return BUCKET_OF.get(name.split("/", 1)[0])
+
+
+def _step_spans(events: list, rank: int) -> list:
+    """This rank's step-thread spans (committer/background-thread spans are
+    excluded: they overlap the step wall by design and must not count
+    against it)."""
+    return [r for r in events
+            if r.get("kind") == "span" and int(r.get("rank", 0)) == rank
+            and isinstance(r.get("dur"), (int, float))
+            and r.get("thread") != "committer"]
+
+
+def _leg_window(mine: list, key: str) -> tuple:
+    """[start, end] of the MOST RECENT training leg in this rank's
+    records. Journals append across process restarts (the sink reopens in
+    append mode — a watcher re-fire into the same output_dir is normal
+    operation), so taking the first train_start with the last train_end
+    would fold the dead inter-run gap into the wall and sink coverage; the
+    analyzer reports the latest leg instead. Falls back to the full record
+    range when no train_start/train_end markers exist (ring-only bench
+    journals always carry them)."""
+    starts = [r[key] for r in mine if r.get("name") == "train_start"]
+    start = starts[-1] if starts else mine[0][key]
+    ends = [r[key] for r in mine
+            if r.get("name") == "train_end" and r[key] >= start]
+    end = ends[-1] if ends else mine[-1][key]
+    return start, end
+
+
+def attribute(events: list, rank: Optional[int] = None) -> Optional[dict]:
+    """Step-wall attribution for one rank (default: the lowest present).
+
+    The window is the MOST RECENT [``train_start``, ``train_end``] leg
+    (``_leg_window`` — appended journals from watcher re-fires analyze
+    their latest leg, not the union plus the dead gap); every step-thread
+    span ending inside it is summed into its bucket. ``unattributed`` is
+    the wall the spans do not tile — loop bookkeeping, guard/sentinel host
+    reads. ``closes`` is the overlap check: spans that double-count (two
+    buckets claiming the same wall) drive ``unattributed`` NEGATIVE, which
+    is the one direction the residual arithmetic can actually catch."""
+    if not events:
+        return None
+    ranks = sorted({int(r.get("rank", 0)) for r in events})
+    if rank is None:
+        rank = ranks[0]
+    mine = [r for r in events if int(r.get("rank", 0)) == rank]
+    if not mine:
+        return None
+    key = "tw" if all("tw" in r for r in mine) else "t"
+    start, end = _leg_window(mine, key)
+    wall = max(end - start, 0.0)
+    buckets = {b: 0.0 for b in NAMED_BUCKETS}
+    other = 0.0
+    for r in _step_spans(mine, rank):
+        if not (start <= r[key] <= end + 1e-9):
+            continue
+        b = _bucket(str(r.get("name", "")))
+        if b is None:
+            other += r["dur"]
+        else:
+            buckets[b] += r["dur"]
+    named = sum(buckets.values())
+    unattributed = wall - named - other
+    steps = [r.get("step") for r in mine
+             if r.get("name") in ("step_log", "train_start", "train_end")
+             and isinstance(r.get("step"), int)
+             and start <= r[key] <= end + 1e-9]
+    n_steps = (max(steps) - min(steps)) if len(steps) >= 2 else 0
+    out = {
+        "rank": rank,
+        "wall_s": round(wall, 6),
+        "steps": n_steps,
+        "ms_per_step": (round(wall / n_steps * 1e3, 3) if n_steps else None),
+        "buckets": {
+            b: {"s": round(s, 6),
+                "frac": round(s / wall, 6) if wall else 0.0}
+            for b, s in buckets.items()},
+        "other_s": round(other, 6),
+        "unattributed_s": round(unattributed, 6),
+        "coverage": round(named / wall, 6) if wall else 0.0,
+    }
+    # named + other + unattributed == wall holds by construction (the
+    # residual definition), so the IDENTITY cannot fail — what CAN fail is
+    # the tiling assumption: overlapping/double-counted spans push the sum
+    # of spans past the wall, i.e. unattributed goes negative. That is the
+    # direction 'closes' checks (a small negative within tolerance is
+    # clock-granularity noise).
+    out["closes"] = bool(wall == 0.0
+                         or unattributed >= -CLOSE_TOL_FRAC * wall)
+    return out
+
+
+def top_stalls(events: list, rank: Optional[int] = None, k: int = 8) -> list:
+    """The top stall sources by full span name (not bucket): total seconds,
+    call count, mean ms — the 'name the biggest tax first' list the next
+    MFU push starts from. Restricted to the SAME window the attribution
+    table covers (the latest training leg), so the two views of the report
+    can never disagree about which spans count. ``device_wait`` ranking
+    first just means the run is device-bound, which is the healthy case."""
+    if not events:
+        return []
+    ranks = sorted({int(r.get("rank", 0)) for r in events})
+    if rank is None:
+        rank = ranks[0]
+    mine = [r for r in events if int(r.get("rank", 0)) == rank]
+    if not mine:
+        return []
+    key = "tw" if all("tw" in r for r in mine) else "t"
+    start, end = _leg_window(mine, key)
+    agg: dict = {}
+    for r in _step_spans(mine, rank):
+        if not (start <= r[key] <= end + 1e-9):
+            continue
+        name = str(r.get("name", ""))
+        s, n = agg.get(name, (0.0, 0))
+        agg[name] = (s + r["dur"], n + 1)
+    rows = [{"name": name, "s": round(s, 6), "count": n,
+             "mean_ms": round(s / n * 1e3, 3)}
+            for name, (s, n) in agg.items()]
+    rows.sort(key=lambda r: -r["s"])
+    return rows[:k]
+
+
+def step_skew(events: list) -> Optional[dict]:
+    """Cross-host step-skew percentiles from the per-rank ``step_log``
+    events on the merged wall timeline: for every step logged by more than
+    one rank, the spread max(tw) − min(tw) is how far apart the hosts
+    reached the same step. None on single-rank journals (nothing to
+    compare)."""
+    by_step: dict = {}
+    for r in events:
+        if r.get("name") == "step_log" and isinstance(r.get("step"), int) \
+                and "tw" in r:
+            # latest occurrence per (step, rank) wins: appended journals
+            # from watcher re-fires re-log the same steps, and only the
+            # latest leg's arrival times describe one coherent run
+            by_step.setdefault(r["step"], {})[int(r.get("rank", 0))] = r["tw"]
+    spreads = sorted(max(ts.values()) - min(ts.values())
+                     for ts in by_step.values() if len(ts) > 1)
+    if not spreads:
+        return None
+
+    def pct(p: float) -> float:
+        return spreads[min(int(p * len(spreads)), len(spreads) - 1)]
+
+    return {"steps_compared": len(spreads),
+            "p50_s": round(pct(0.50), 6),
+            "p95_s": round(pct(0.95), 6),
+            "max_s": round(spreads[-1], 6)}
+
+
+# ------------------------------------------------------------- baseline diff
+def load_baseline_attribution(path: str) -> Optional[dict]:
+    """The ``journal_attribution`` summary from a bench artifact — a
+    ``BENCH_*.json`` capture (summary under ``parsed``) or a bare bench row
+    (``last_tpu_measurement.json``). None when the artifact predates the
+    journal (bench rows only carry the summary from ISSUE 7 on)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    for node in (doc, doc.get("parsed") or {}):
+        att = node.get("journal_attribution")
+        if isinstance(att, dict) and isinstance(att.get("buckets"), dict):
+            return att
+    return None
+
+
+def diff_vs_baseline(att: dict, baseline: dict) -> dict:
+    """Per-bucket fraction deltas vs a baseline attribution; the bucket
+    whose share GREW the most is named as the regressing one (a perf
+    regression shows up as some tax eating a larger share of the wall)."""
+    deltas = {}
+    for b in NAMED_BUCKETS:
+        cur = (att["buckets"].get(b) or {}).get("frac", 0.0)
+        base = (baseline.get("buckets", {}).get(b) or {}).get("frac", 0.0)
+        deltas[b] = round(cur - base, 6)
+    worst = max(deltas, key=lambda b: deltas[b])
+    return {"frac_delta": deltas,
+            "regressing_bucket": worst if deltas[worst] > 0 else None}
+
+
+# -------------------------------------------------------------------- driver
+def analyze_dir(directory: str, rank: Optional[int] = None,
+                baseline: Optional[str] = None) -> Optional[dict]:
+    """The full report dict for a run directory, or None when it holds no
+    journal (check_evidence's ``journal`` stage calls exactly this)."""
+    loaded = load_journals(directory)
+    if loaded is None:
+        return None
+    att = attribute(loaded["events"], rank)
+    report = {
+        "directory": directory,
+        "ranks": loaded["ranks"],
+        "schema_errors": loaded["schema_errors"],
+        "attribution": att,
+        "top_stalls": top_stalls(loaded["events"], rank),
+        "step_skew": step_skew(loaded["events"]),
+    }
+    if baseline:
+        base_att = load_baseline_attribution(baseline)
+        report["baseline"] = baseline
+        report["baseline_diff"] = (diff_vs_baseline(att, base_att)
+                                   if att and base_att else None)
+    return report
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v * 1e3:8.1f} ms" if v < 10 else f"{v:8.2f} s "
+
+
+def render(report: dict) -> str:
+    lines = [f"run journal: {report['directory']} "
+             f"(ranks {report['ranks']}, "
+             f"{report['schema_errors']} schema error(s))"]
+    att = report.get("attribution")
+    if att:
+        lines.append(
+            f"rank {att['rank']}: wall {att['wall_s']:.2f}s over "
+            f"{att['steps']} step(s)"
+            + (f" ({att['ms_per_step']:.1f} ms/step)"
+               if att.get("ms_per_step") else "")
+            + f" — coverage {att['coverage'] * 1e2:.1f}% "
+            f"({'closes' if att['closes'] else 'DOES NOT CLOSE'})")
+        for b in NAMED_BUCKETS:
+            v = att["buckets"][b]
+            lines.append(f"  {b:<10} {_fmt_s(v['s'])}  "
+                         f"{v['frac'] * 1e2:5.1f}%")
+        lines.append(f"  {'other':<10} {_fmt_s(att['other_s'])}  "
+                     f"{att['other_s'] / att['wall_s'] * 1e2:5.1f}%"
+                     if att["wall_s"] else "  other      0")
+        lines.append(
+            # negative unattributed = overlapping spans (the 'closes'
+            # failure); show it, never clamp the symptom away
+            f"  {'unattrib.':<10} {att['unattributed_s'] * 1e3:8.1f} ms")
+    if report.get("top_stalls"):
+        lines.append("top stall sources:")
+        for row in report["top_stalls"]:
+            lines.append(f"  {row['name']:<22} {_fmt_s(row['s'])}  "
+                         f"x{row['count']} (mean {row['mean_ms']:.2f} ms)")
+    skew = report.get("step_skew")
+    if skew:
+        lines.append(f"cross-host step skew over {skew['steps_compared']} "
+                     f"step(s): p50 {skew['p50_s'] * 1e3:.1f} ms, "
+                     f"p95 {skew['p95_s'] * 1e3:.1f} ms, "
+                     f"max {skew['max_s'] * 1e3:.1f} ms")
+    if "baseline" in report:
+        diff = report.get("baseline_diff")
+        if diff is None:
+            lines.append(f"baseline {report['baseline']}: no "
+                         "journal_attribution to diff against")
+        else:
+            worst = diff["regressing_bucket"]
+            lines.append(
+                f"vs baseline {report['baseline']}: "
+                + (f"regressing bucket = {worst} "
+                   f"(+{diff['frac_delta'][worst] * 1e2:.1f}% of wall)"
+                   if worst else "no bucket grew its share"))
+            lines.append("  frac deltas: " + ", ".join(
+                f"{b} {d:+.3f}" for b, d in diff["frac_delta"].items()))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="offline run-journal analyzer (stdlib-only)")
+    ap.add_argument("directory", help="run directory holding "
+                    "journal_rank*.jsonl (or its parent)")
+    ap.add_argument("--rank", type=int, default=None,
+                    help="attribute this rank (default: lowest present)")
+    ap.add_argument("--baseline", default=None,
+                    help="BENCH_*.json / last_tpu_measurement.json to diff "
+                         "bucket fractions against")
+    ap.add_argument("--json-out", default=None,
+                    help="also write the full report as strict JSON")
+    args = ap.parse_args(argv)
+    report = analyze_dir(args.directory, rank=args.rank,
+                         baseline=args.baseline)
+    if report is None:
+        print(f"no journal files under {args.directory}", file=sys.stderr)
+        return 1
+    print(render(report))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=1, allow_nan=False)
+            f.write("\n")
+    att = report.get("attribution")
+    if att is None or not att["closes"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,7 +93,7 @@ def _key_dtype(knob: str, info: dict) -> str:
 
 
 def _measure(knob: str, candidates: list, info: dict, args,
-             base: dict | None = None) -> list:
+             base: dict | None = None, journal=None) -> list:
     """Candidate-ordered result rows for one knob; every row is printed as
     it lands so a killed tuner still leaves a legible trail."""
     results = []
@@ -105,9 +105,14 @@ def _measure(knob: str, candidates: list, info: dict, args,
         if args.test_sleep_s:  # timeout-guard test hook (see autotune)
             payload["_test_sleep_s"] = args.test_sleep_s
         if args.in_process:
+            t0 = time.monotonic()
             r = autotune.execute_trial(payload)
+            # same span writer as the child path: one record shape, same
+            # per-trial flush, same never-break-the-search guard
+            autotune.journal_trial(journal, knob, cand, r, t0)
         else:
-            r = autotune.run_trial_child(payload, args.timeout_s)
+            r = autotune.run_trial_child(payload, args.timeout_s,
+                                         journal=journal)
         row = {"knob": knob, "candidate": cand,
                "ms": r.get("ms"), "error": r.get("error")}
         print(json.dumps({k: v for k, v in row.items() if v is not None},
@@ -138,6 +143,11 @@ def main(argv=None) -> int:
                          "this device/shape/dtype — the runbook's re-fire "
                          "resume: a dropped window re-tunes only the "
                          "missing knobs")
+    ap.add_argument("--journal_dir", default=None,
+                    help="record a run journal (train/journal.py) of the "
+                         "tuning session — one autotune/trial span per "
+                         "candidate with knob, candidate, ms/error and "
+                         "child wall time; analyze with cli/run_analyze")
     ap.add_argument("--test_sleep_s", type=float, default=0.0,
                     help=argparse.SUPPRESS)  # timeout-guard test hook
     ap.add_argument("--trial", default=None, help=argparse.SUPPRESS)
@@ -175,55 +185,75 @@ def main(argv=None) -> int:
     if unknown:
         ap.error(f"unknown knob(s) {unknown}; pick from {DEFAULT_KNOBS}")
 
+    jr = None
+    if args.journal_dir:
+        from distributed_lion_tpu.train.journal import Journal
+
+        jr = Journal(args.journal_dir)
+        jr.event("tune_start", preset=args.preset, backend=backend,
+                 device_kind=device_kind)
     entries = dict(autotune.load_cache(args.cache))
     tuned: dict = {}
     skipped: dict = {}
     failed: dict = {}
     cache_file = None
     cached: dict = {}
-    for knob in knobs:
-        info = _knob_info(knob, preset)
-        key = autotune.cache_key(device_kind, knob, _shape_key(knob, info),
-                                 _key_dtype(knob, info))
-        if args.skip_cached and key in entries:
-            cached[knob] = key
-            continue
-        results = _measure(knob, autotune.tile_candidates(knob, info),
-                           info, args)
-        if results and str(results[-1].get("error", "")).startswith(
-                "unsupported"):
-            skipped[knob] = results[-1]["error"]
-            continue
-        win = autotune.select_winner(results)
-        if win is None:
-            failed[knob] = [r.get("error") for r in results][:3]
-            continue
-        value = dict(win["candidate"])
-        if knob == "flash_tiles":
-            # phase 2: backward tiles, with the winning forward tiles
-            # pinned (the bwd passes are ~2× the fwd FLOPs with different
-            # operand shapes — VERDICT's named lever). Deterministic: the
-            # phase-2 grid and tie-break are as fixed as phase 1's.
-            bwd = _measure("flash_tiles_bwd",
-                           autotune.tile_candidates("flash_tiles_bwd", info),
-                           info, args, base=value)
-            bwin = autotune.select_winner(bwd)
-            if bwin is not None:
-                value.update(bwin["candidate"])
-                win["ms"] = bwin["ms"]
-        entries[key] = {
-            "value": value,
-            "ms": round(float(win["ms"]), 4),
-            "backend": backend,
-            "candidates": len(results),
-            "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        }
-        tuned[knob] = {"key": key, "value": value, "ms": entries[key]["ms"]}
-        # commit after EVERY knob (atomic tmp+rename): a dropped TPU
-        # window keeps the knobs it finished — the same at-most-one-
-        # interval loss discipline as the parity legs' checkpoints
-        cache_file = autotune.save_cache(entries, args.cache)
-
+    try:
+        for knob in knobs:
+            info = _knob_info(knob, preset)
+            key = autotune.cache_key(device_kind, knob,
+                                     _shape_key(knob, info),
+                                     _key_dtype(knob, info))
+            if args.skip_cached and key in entries:
+                cached[knob] = key
+                continue
+            results = _measure(knob, autotune.tile_candidates(knob, info),
+                               info, args, journal=jr)
+            if results and str(results[-1].get("error", "")).startswith(
+                    "unsupported"):
+                skipped[knob] = results[-1]["error"]
+                continue
+            win = autotune.select_winner(results)
+            if win is None:
+                failed[knob] = [r.get("error") for r in results][:3]
+                continue
+            value = dict(win["candidate"])
+            if knob == "flash_tiles":
+                # phase 2: backward tiles, with the winning forward tiles
+                # pinned (the bwd passes are ~2× the fwd FLOPs with
+                # different operand shapes — VERDICT's named lever).
+                # Deterministic: the phase-2 grid and tie-break are as
+                # fixed as phase 1's.
+                bwd = _measure(
+                    "flash_tiles_bwd",
+                    autotune.tile_candidates("flash_tiles_bwd", info),
+                    info, args, base=value, journal=jr)
+                bwin = autotune.select_winner(bwd)
+                if bwin is not None:
+                    value.update(bwin["candidate"])
+                    win["ms"] = bwin["ms"]
+            entries[key] = {
+                "value": value,
+                "ms": round(float(win["ms"]), 4),
+                "backend": backend,
+                "candidates": len(results),
+                "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime()),
+            }
+            tuned[knob] = {"key": key, "value": value,
+                           "ms": entries[key]["ms"]}
+            # commit after EVERY knob (atomic tmp+rename): a dropped TPU
+            # window keeps the knobs it finished — the same at-most-one-
+            # interval loss discipline as the parity legs' checkpoints
+            cache_file = autotune.save_cache(entries, args.cache)
+    finally:
+        # flush/close even when a knob raises: a crashed or killed tuner
+        # must still leave a legible journal (journal_trial flushed after
+        # every candidate; this seals the file)
+        if jr is not None:
+            jr.event("tune_end", tuned=len(tuned), skipped=len(skipped),
+                     failed=len(failed))
+            jr.close()
     print(json.dumps({
         "tuned": tuned, "cached": cached, "skipped": skipped,
         "failed": failed, "backend": backend, "device_kind": device_kind,

@@ -17,6 +17,7 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from distributed_lion_tpu import native
+from distributed_lion_tpu.train import journal
 
 _DTYPES = {np.dtype(np.uint16): 2, np.dtype(np.uint32): 4}
 
@@ -86,9 +87,12 @@ class NativeTokenLoader:
             except Exception as e:
                 last_err = e
                 self.skipped_shards.append(str(path))
-                print(f"[native_loader] WARNING: skipping corrupt/unreadable"
-                      f" shard {path} after {SHARD_RETRIES + 1} attempts: "
-                      f"{e}")
+                journal.emit(
+                    f"[native_loader] WARNING: skipping corrupt/unreadable"
+                    f" shard {path} after {SHARD_RETRIES + 1} attempts: "
+                    f"{e}")
+                journal.event("shard_skipped", shard=str(path),
+                              error=f"{type(e).__name__}: {e}")
         if not good:
             raise CorruptShardError(
                 f"all {len(self.skipped_shards)} shard(s) failed validation;"
@@ -127,6 +131,11 @@ class NativeTokenLoader:
 
     def _count_retry(self) -> None:
         self.read_retries += 1
+        # shard-retry event into the active run journal (no-op without
+        # one): transient input-layer I/O becomes part of the run's
+        # timeline instead of a bare counter that only surfaces at the
+        # next log cadence
+        journal.event("shard_retry", retries=self.read_retries)
 
     def read_blocks(self, start: int, stop: int) -> np.ndarray:
         return np.stack([self.read_block(i) for i in range(start, stop)])

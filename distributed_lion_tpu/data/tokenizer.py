@@ -118,14 +118,14 @@ def load_tokenizer(name_or_path: str | None):
             pass  # deliberate fallback chain: no `tokenizers` wheel / no
             # tokenizer.json here is an expected miss, and the loud WARNING
             # below names every path that was tried
-        import sys
+        from distributed_lion_tpu.train.journal import emit
 
-        print(
+        emit(
             f"[tokenizer] WARNING: could not resolve {name_or_path!r} to a "
             "real tokenizer (no vocab.json+merges.txt, tokenizer.model, "
             "tokenizer.json, or local HF cache) — falling back to the "
             "259-id ByteTokenizer. A Llama/GPT-2 run with this vocab is "
             "almost certainly not what you want.",
-            file=sys.stderr,
+            stderr=True,
         )
     return ByteTokenizer()

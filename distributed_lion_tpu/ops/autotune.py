@@ -498,14 +498,54 @@ def _kill_trial_child() -> None:
 
 
 def run_trial_child(payload: dict, timeout_s: float,
-                    python: Optional[str] = None) -> dict:
+                    python: Optional[str] = None, journal=None) -> dict:
     """Run one trial in a child process under a HARD wall-clock budget
     covering compile AND run — the guard that makes a pathological tile
     cost one ``timeout_s``, never a window. The child runs in its own
     session; on expiry the whole process group is SIGKILLed (a wedged XLA
     compile ignores SIGTERM). Returns the child's JSON result, an
     ``{"error": "timeout ..."}`` row, or an ``{"error": "rc=..."}`` row —
-    the search always continues."""
+    the search always continues.
+
+    ``journal`` (train/journal.py's recorder, duck-typed so this module
+    stays stdlib-only at import) gets one ``autotune/trial`` span per
+    candidate — knob, candidate, measured ms or error, and the child's
+    wall time including compile — so a tuning session's time budget is
+    attributable candidate by candidate."""
+    t_trial = time.monotonic()
+    result = _run_trial_child(payload, timeout_s, python)
+    journal_trial(journal, str(payload.get("knob")),
+                  payload.get("candidate", {}), result, t_trial)
+    return result
+
+
+def journal_trial(journal, knob: str, candidate: dict, result: dict,
+                  t0: float) -> None:
+    """THE one autotune/trial span writer (run_trial_child and run_tune's
+    in-process branch share it, so the record shape cannot drift). Flushes
+    after every trial: a killed tuner must still leave a legible journal,
+    the same discipline as the per-row stdout printing. Journaling errors
+    warn and never break the search."""
+    if journal is None:
+        return
+    try:
+        journal.record({
+            "kind": "span", "name": "autotune/trial",
+            "dur": round(time.monotonic() - t0, 6),
+            "knob": knob,
+            "candidate": json.dumps(candidate, sort_keys=True,
+                                    allow_nan=False),
+            "ms": result.get("ms"), "error": result.get("error"),
+        })
+        flush = getattr(journal, "flush", None)
+        if flush is not None:
+            flush()
+    except Exception as e:  # journaling must never break the search
+        print(f"[autotune] journal record failed: {e}", file=sys.stderr)
+
+
+def _run_trial_child(payload: dict, timeout_s: float,
+                     python: Optional[str] = None) -> dict:
     global _trial_child
     cmd = [python or sys.executable, "-m",
            "distributed_lion_tpu.cli.run_tune", "--trial",

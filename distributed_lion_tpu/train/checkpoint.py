@@ -46,7 +46,9 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from distributed_lion_tpu.train import journal as run_journal
 from distributed_lion_tpu.train import resilience
+from distributed_lion_tpu.train.journal import emit
 # the read side (verify, autodetect) lives in resilience.py so the
 # dependency-light evidence checker can import it without jax/orbax;
 # re-exported here because this module is the checkpoint API surface
@@ -94,7 +96,16 @@ class Checkpointer:
     def __init__(self, directory: str | pathlib.Path,
                  save_total_limit: Optional[int] = None, *,
                  async_save: bool = False, integrity: bool = True,
-                 max_retries: int = 3, retry_backoff_s: float = 0.1):
+                 max_retries: int = 3, retry_backoff_s: float = 0.1,
+                 journal=None):
+        # the run journal (train/journal.py; NULL no-op when the trainer
+        # runs without --journal): caller-thread spans (ckpt/serialize,
+        # ckpt/drain) are the step loop's checkpoint tax — the same wall
+        # time the ckpt_stall_s ledger counts, cross-checked by
+        # tests/test_journal.py — while the committer-thread spans
+        # (thread="committer") show where the BACKGROUND commit spends its
+        # time without counting against the step wall
+        self._journal = journal if journal is not None else run_journal.NULL
         self.directory = pathlib.Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
         self.integrity = integrity
@@ -157,35 +168,41 @@ class Checkpointer:
                 # the same wall time again on the way out
                 drained = time.monotonic() - t0
                 raise
-            delay = self.retry_backoff_s
-            for attempt in range(self.max_retries + 1):
-                try:
-                    if resilience.consume_fault_count("ckpt_save_raise"):
-                        raise OSError("injected save fault")
-                    self.manager.save(step, args=ocp.args.StandardSave(payload))
-                    break
-                except Exception as e:
-                    if attempt == self.max_retries:
-                        # out of retries: re-raise with step/path context
-                        # attached, same exception class so callers (and
-                        # tests) matching on the original type still do
-                        try:
-                            wrapped = type(e)(
-                                f"checkpoint save(step={step}) under "
-                                f"{self.directory} failed after "
-                                f"{attempt + 1} attempts: {e}")
-                        except Exception:
-                            raise e  # exotic ctor signature: original as-is
-                        raise wrapped from e
-                    print(f"[ckpt] save({step}) attempt {attempt + 1} failed "
-                          f"({e}); retrying in {delay:.2f}s")
-                    time.sleep(delay)
-                    delay *= 2
-            if self._executor is not None:
-                self._inflight = self._executor.submit(self._commit, step, meta)
-                self._inflight_step = step
-            else:
-                self._commit(step, meta)
+            # caller-thread serialize span: the D2H copy + Orbax enqueue
+            # (async) or the full serialize+write+commit (sync) — with the
+            # drain above, the whole of save()'s step-loop tax
+            with self._journal.span("ckpt/serialize", step=int(step)):
+                delay = self.retry_backoff_s
+                for attempt in range(self.max_retries + 1):
+                    try:
+                        if resilience.consume_fault_count("ckpt_save_raise"):
+                            raise OSError("injected save fault")
+                        self.manager.save(step,
+                                          args=ocp.args.StandardSave(payload))
+                        break
+                    except Exception as e:
+                        if attempt == self.max_retries:
+                            # out of retries: re-raise with step/path context
+                            # attached, same exception class so callers (and
+                            # tests) matching on the original type still do
+                            try:
+                                wrapped = type(e)(
+                                    f"checkpoint save(step={step}) under "
+                                    f"{self.directory} failed after "
+                                    f"{attempt + 1} attempts: {e}")
+                            except Exception:
+                                raise e  # exotic ctor signature: original as-is
+                            raise wrapped from e
+                        emit(f"[ckpt] save({step}) attempt {attempt + 1} "
+                             f"failed ({e}); retrying in {delay:.2f}s")
+                        time.sleep(delay)
+                        delay *= 2
+                if self._executor is not None:
+                    self._inflight = self._executor.submit(self._commit, step,
+                                                           meta)
+                    self._inflight_step = step
+                else:
+                    self._commit(step, meta)
         finally:
             self._add_stall(max(time.monotonic() - t0 - drained, 0.0))
 
@@ -193,23 +210,29 @@ class Checkpointer:
         """Wait for Orbax to finalize the step, then write manifest + commit
         marker (marker LAST — its presence is the atomic commit point).
         Runs on the committer thread under async_save, inline otherwise."""
-        self.manager.wait_until_finished()
-        slow = resilience.fault("ckpt_slow_commit")
-        if slow:
-            time.sleep(float(slow))
+        with self._journal.span("ckpt/orbax_finalize", step=int(step),
+                                thread="committer"):
+            self.manager.wait_until_finished()
+            slow = resilience.fault("ckpt_slow_commit")
+            if slow:
+                time.sleep(float(slow))
         if not self.integrity or jax.process_index() != 0:
             return step
         if resilience.fault("ckpt_crash_before_manifest"):
             return None  # simulated death after Orbax finalize, before commit
         sdir = self._step_dir(step)
-        digest = write_manifest(sdir, step, meta)
+        with self._journal.span("ckpt/digest", step=int(step),
+                                thread="committer"):
+            digest = write_manifest(sdir, step, meta)
         if resilience.fault("ckpt_crash_before_marker"):
             return None
-        _atomic_write(
-            sdir / MARKER,
-            json.dumps({"manifest_sha256": digest, "step": int(step),
-                        "committed_at_unix": time.time()},
-                       allow_nan=False).encode())
+        with self._journal.span("ckpt/commit_marker", step=int(step),
+                                thread="committer"):
+            _atomic_write(
+                sdir / MARKER,
+                json.dumps({"manifest_sha256": digest, "step": int(step),
+                            "committed_at_unix": time.time()},
+                           allow_nan=False).encode())
         return step
 
     def finalize(self) -> float:
@@ -226,7 +249,8 @@ class Checkpointer:
         fut, step = self._inflight, self._inflight_step
         self._inflight, self._inflight_step = None, None
         try:
-            fut.result()
+            with self._journal.span("ckpt/drain", step=int(step)):
+                fut.result()
         except Exception as e:
             raise RuntimeError(
                 f"checkpoint commit for step {step} under "

@@ -60,7 +60,8 @@ from distributed_lion_tpu.parallel.mesh import (
     TENSOR_AXIS,
     data_axis_size,
 )
-from distributed_lion_tpu.train import resilience, telemetry, vote_guard
+from distributed_lion_tpu.train import journal, resilience, telemetry, vote_guard
+from distributed_lion_tpu.train.journal import emit
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
 from distributed_lion_tpu.train.profiling import (
@@ -258,6 +259,19 @@ class TrainConfig:
     guard_cooldown: int = 50  # optimizer steps a quarantined worker sits
     # out before a readmission probe (healed momentum, mask cleared; a
     # still-sick worker re-strikes within guard_strikes steps)
+    journal: bool = False  # run journal (train/journal.py): a host-side
+    # span/event recorder around every loop region — trainer dispatch,
+    # device wait (the log-cadence drain), data wait, logging drain,
+    # checkpoint serialize/drain, preemption/quarantine transitions —
+    # written as rank-stamped strict-JSON JSONL under --journal_dir and
+    # analyzed offline by cli/run_analyze (step-time attribution, top
+    # stall sources, cross-host skew, BENCH baseline diff). Host wall
+    # clocks only: zero added device syncs per step, and elections are
+    # pinned bit-identical journal-on vs journal-off
+    # (tests/test_journal.py).
+    journal_dir: str = ""  # journal sink directory ('' = output_dir/journal;
+    # with neither set the journal runs ring-only: crash bundles still get
+    # their journal_tail.jsonl, nothing else is written)
     inject_poison: str = ""  # fault injection for the guard's evidence and
     # tests: '<kind>:<worker>[:<start_step>]' with kind in
     # nan_grads | frozen_ballot | flipped_ballot
@@ -380,7 +394,7 @@ def resolve_auto_comm(cfg: TrainConfig, mesh, n_params: int,
                 and n_params >= AUTO_LAZY_MIN_PARAMS):
             bits = wire_bytes_per_param(
                 n_params, world, wire, vote_every=4)["bits_per_param"]
-            print(
+            emit(
                 f"[trainer] auto comm: wire={wire} vote_every=1 (strict "
                 f"every-step voting). Lazy --vote_every 4 would cut the "
                 f"{n_params/1e6:.0f}M-coordinate ballot to {bits:.2f} "
@@ -573,6 +587,16 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
+        # the run journal comes up FIRST so every construction/resume
+        # message below already lands in the event stream; it is host-side
+        # only — nothing it does can reach the traced step
+        jdir = cfg.journal_dir or (os.path.join(cfg.output_dir, "journal")
+                                   if cfg.output_dir else "")
+        self.journal = (journal.Journal(jdir or None,
+                                        rank=jax.process_index())
+                        if cfg.journal else journal.NULL)
+        if cfg.journal:
+            journal.install(self.journal)
         if cfg.zero1:
             shape = dict(mesh.shape)
             for ax in (TENSOR_AXIS, SEQ_AXIS):
@@ -595,7 +619,7 @@ class Trainer:
             # training should keep f32 master params with bf16 COMPUTE
             # (the model configs' default split), like torch's f32 master
             # weights under autocast.
-            print(
+            emit(
                 "[trainer] WARNING: bf16 param storage with Lion lr "
                 f"{cfg.learning_rate:g} < 1e-3 — the fixed ±lr update is "
                 "below bf16 ULP for |p| > ~lr*256, so those coordinates "
@@ -671,7 +695,7 @@ class Trainer:
             )
         self._guard = (vote_guard.make_guard(
             self.world, cfg.vote_guard, cfg.guard_strikes,
-            cfg.guard_cooldown, cfg.min_quorum)
+            cfg.guard_cooldown, cfg.min_quorum, journal=self.journal)
             if cfg.lion and cfg.vote_guard != "off" else None)
         self._guard_pending = None  # (step, obs-device-arrays, advanced)
         if cfg.inject_poison:
@@ -680,7 +704,7 @@ class Trainer:
             # trace time
             resilience.inject_fault(
                 "ballot_poison", resilience.parse_poison(cfg.inject_poison))
-            print(f"[trainer] FAULT INJECTION armed: ballot poison "
+            emit(f"[trainer] FAULT INJECTION armed: ballot poison "
                   f"{cfg.inject_poison!r}")
 
         self.params = jax.tree.map(
@@ -794,7 +818,8 @@ class Trainer:
         self.checkpointer = (
             Checkpointer(f"{cfg.output_dir}/checkpoints", cfg.save_total_limit,
                          async_save=cfg.async_ckpt,
-                         integrity=cfg.ckpt_integrity)
+                         integrity=cfg.ckpt_integrity,
+                         journal=self.journal)
             if cfg.output_dir
             else None
         )
@@ -814,8 +839,9 @@ class Trainer:
         self._retrace_sigs: dict = {}
         self.retrace_count = 0
         self.preempted = False
-        self._preempt_guard = (resilience.PreemptionGuard()
-                               if cfg.on_preempt == "save_exit" else None)
+        self._preempt_guard = (
+            resilience.PreemptionGuard(journal=self.journal)
+            if cfg.on_preempt == "save_exit" else None)
         self.logger = MetricsLogger(cfg.output_dir, use_wandb=cfg.report_to_wandb)
         self.profiler = StepProfiler(cfg.profile_dir, cfg.profile_start_step,
                                      cfg.profile_num_steps)
@@ -868,7 +894,7 @@ class Trainer:
                 jax.random.key(0),
             )
         except Exception as e:  # measurement must never take down training
-            print(f"[telemetry] wire measurement unavailable: {e}")
+            emit(f"[telemetry] wire measurement unavailable: {e}")
             self._wire_measured = {}
 
     def _check_retrace(self, kind: str, *args) -> None:
@@ -910,7 +936,7 @@ class Trainer:
             # silently recompiled on the retry
             raise RuntimeError(msg)
         seen.add(sig)
-        print(f"[trainer] {msg}")
+        emit(f"[trainer] {msg}")
 
     def _apply_guard(self, step: int, obs: dict, advanced: int) -> None:
         """Drive the host quarantine machine with one dispatch's guard
@@ -924,7 +950,7 @@ class Trainer:
         host = {k: np.asarray(jax.device_get(v)) for k, v in obs.items()}
         events = self._guard.update(step, host, advanced)
         for line in events.logs:
-            print(f"[trainer] vote guard: {line}")
+            emit(f"[trainer] vote guard: {line}")
         if self.cfg.vote_guard != "enforce":
             return  # observe mode: bookkeeping + logs only
         if events.readmitted:
@@ -984,7 +1010,7 @@ class Trainer:
             # never shows in the global loss, but it shows here
             reason += (" (vote guard sick workers: "
                        f"{self._guard.sick_workers()})")
-        print(f"[trainer] ANOMALY: {reason}")
+        emit(f"[trainer] ANOMALY: {reason}")
         crash_dir = None
         if self.cfg.output_dir:
             window = list(self._metrics_window)
@@ -996,8 +1022,9 @@ class Trainer:
                 dataclasses.asdict(self.cfg), self.params, self.state,
                 window,
                 guard=(self._guard.sick_report()
-                       if self._guard is not None else None))
-            print(f"[trainer] crash bundle written to {crash_dir}")
+                       if self._guard is not None else None),
+                journal_tail=self.journal.tail())
+            emit(f"[trainer] crash bundle written to {crash_dir}")
         if self.cfg.trace_on_anomaly and not force_raise:
             trace_base = crash_dir or self.cfg.profile_dir
             if trace_base:
@@ -1012,7 +1039,7 @@ class Trainer:
                 self._anomaly_deadline = (self.step_count
                                           + self.cfg.profile_num_steps + 1)
                 self._anomaly_reason = reason
-                print("[trainer] armed anomaly trace window for steps "
+                emit("[trainer] armed anomaly trace window for steps "
                       f"[{self.step_count}, {self._anomaly_deadline - 1})")
                 return
         if self.checkpointer:
@@ -1289,6 +1316,9 @@ class Trainer:
             self._resume_skip_batches = 0
         t_last, s_last = time.time(), self.step_count
         chunk_spec = NamedSharding(self.mesh, P(None, *self.batch_spec))
+        jr = self.journal  # journal.NULL when --journal is off: every span
+        # below is a no-op, and the loop body is byte-identical in behavior
+        jr.event("train_start", step=self.step_count, total=int(total))
 
         while self.step_count < total:
             self.profiler.maybe_start(self.step_count)
@@ -1297,15 +1327,17 @@ class Trainer:
             if k == self.cfg.steps_per_call and k > 1:
                 # fused K-step dispatch; the tail below K runs step-by-step
                 # (avoids a second jit specialization for the remainder)
-                stack = [next(train_iter) for _ in range(k)]
-                self._measure_wire_once(stack[0])
-                batches = jax.device_put(
-                    jax.tree.map(lambda *xs: np.stack(xs), *stack), chunk_spec
-                )
+                with jr.span("data_wait", step=self.step_count, steps=k):
+                    stack = [next(train_iter) for _ in range(k)]
+                    self._measure_wire_once(stack[0])
+                    batches = jax.device_put(
+                        jax.tree.map(lambda *xs: np.stack(xs), *stack),
+                        chunk_spec)
                 self._check_retrace("chunk", self.params, self.state,
                                     self.vote_health, self._frozen_arg(),
                                     batches)
-                with self.profiler.annotate(self.step_count):
+                with self.profiler.annotate(self.step_count), \
+                        jr.span("dispatch", step=self.step_count, steps=k):
                     (self.params, self.state, self.vote_health,
                      metrics) = self._train_chunk(
                         self.params, self.state, self.vote_health,
@@ -1314,13 +1346,15 @@ class Trainer:
                 self.step_count += k
                 self.timer.tick(k)
             else:
-                raw_batch = next(train_iter)
-                self._measure_wire_once(raw_batch)
-                batch = jax.device_put(raw_batch, data_spec)
+                with jr.span("data_wait", step=self.step_count, steps=1):
+                    raw_batch = next(train_iter)
+                    self._measure_wire_once(raw_batch)
+                    batch = jax.device_put(raw_batch, data_spec)
                 self._check_retrace("step", self.params, self.state,
                                     self.vote_health, self._frozen_arg(),
                                     batch)
-                with self.profiler.annotate(self.step_count):
+                with self.profiler.annotate(self.step_count), \
+                        jr.span("dispatch", step=self.step_count, steps=1):
                     (self.params, self.state, self.vote_health,
                      metrics) = self._train_step(
                         self.params, self.state, self.vote_health,
@@ -1359,6 +1393,16 @@ class Trainer:
             # boundary tests are "crossed a multiple of N during this
             # dispatch" so chunked advances never skip a log/eval/save
             if self.step_count % cfg.logging_steps < advanced or self.step_count == total:
+                if self.cfg.journal:
+                    # the ONE device drain the loop already pays per log
+                    # interval (the host-float below blocks on it either
+                    # way) made explicit, so the journal sees device-bound
+                    # time as a span instead of smearing it into the
+                    # logging bucket — no sync is added that the float()
+                    # conversions were not about to perform
+                    with jr.span("device_wait", step=self.step_count):
+                        jax.block_until_ready(metrics)
+                _t_log = time.monotonic()
                 m = {k: float(v) for k, v in metrics.items()}
                 now = time.time()
                 steps_per_sec = (self.step_count - s_last) / max(now - t_last, 1e-9)
@@ -1431,9 +1475,29 @@ class Trainer:
                 self.logger.log(self.step_count, m, prefix="train")
                 self._metrics_window.append({"step": self.step_count, **m})
                 history.append({"step": self.step_count, **m})
+                if self.cfg.journal:
+                    # the multi-host step-skew heartbeat becomes a journal
+                    # event (PR 2 only PRINTED it, and only under
+                    # --telemetry): run_analyze derives cross-host skew
+                    # percentiles from these per-rank step_log records
+                    jskew = (m.get("host_step_skew") if self._telemetry_on
+                             else telemetry.host_step_skew(self.step_count))
+                    jr.event("step_log", step=self.step_count,
+                             steps_per_sec=round(steps_per_sec, 6),
+                             **({} if jskew is None
+                                else {"skew_steps": int(jskew)}))
+                    # everything since the device drain — metric assembly,
+                    # telemetry drain, the strict-JSON write — is the
+                    # logging tax
+                    jr.record({"kind": "span", "name": "logging_drain",
+                               "dur": round(time.monotonic() - _t_log, 9),
+                               "step": self.step_count})
+                    jr.flush()
 
             if eval_blocks is not None and self.step_count % cfg.eval_steps < advanced:
-                history.append({"step": self.step_count, **self.evaluate(eval_blocks)})
+                with jr.span("eval", step=self.step_count):
+                    history.append({"step": self.step_count,
+                                    **self.evaluate(eval_blocks)})
 
             if self.checkpointer and self.step_count % cfg.save_steps < advanced:
                 self.save()
@@ -1446,13 +1510,13 @@ class Trainer:
                 # emergency checkpoint durable, and return cleanly — the
                 # caller exits 0 and the watcher restarts into a resume.
                 if self.checkpointer:
-                    print(f"[trainer] preemption at step {self.step_count}:"
+                    emit(f"[trainer] preemption at step {self.step_count}:"
                           " draining in-flight save, writing emergency "
                           "checkpoint")
                     self.save(tag="preempt")
                     self.checkpointer.finalize()
                 else:
-                    print(f"[trainer] preemption at step {self.step_count}:"
+                    emit(f"[trainer] preemption at step {self.step_count}:"
                           " no output_dir — NOTHING SAVED; a restart "
                           "begins from step 0")
                 self.preempted = True
@@ -1468,6 +1532,9 @@ class Trainer:
             # the final dispatch's metrics were still awaiting their check
             pending, self._sentinel_pending = self._sentinel_pending, None
             self._check_sentinel(*pending, force_raise=True)
+        jr.event("train_end", step=self.step_count,
+                 preempted=bool(self.preempted))
+        jr.flush()
         return history
 
     def evaluate(self, eval_blocks: np.ndarray) -> dict:
@@ -1487,7 +1554,7 @@ class Trainer:
             per_dev = max(div, n_examples // self.batch_shards // div * div)
         bs = self.batch_shards * per_dev
         if n_examples < bs:
-            print(f"[trainer] eval skipped: {n_examples} examples < "
+            emit(f"[trainer] eval skipped: {n_examples} examples < "
                   f"{self.batch_shards} batch shards")
             return {"eval/loss": float("nan"), "eval/accuracy": float("nan"),
                     "eval/perplexity": float("nan")}
@@ -1678,7 +1745,7 @@ class Trainer:
                 mask = np.asarray(jax.device_get(st.health), dtype=bool)
                 self._guard.adopt_mask(mask, step)
                 if not mask.all():
-                    print("[trainer] vote guard: resumed with quarantined "
+                    emit("[trainer] vote guard: resumed with quarantined "
                           f"workers {[int(w) for w in np.nonzero(~mask)[0]]}"
                           f" (cooldown restarts at step {step})")
         elif st.health is not None or st.prev_ballot is not None:
@@ -1768,7 +1835,7 @@ class Trainer:
                 sick = [int(w) for w in np.nonzero(~mask)[0]]
                 if sick:
                     exp_avg = heal_worker_momentum(exp_avg, mask, sick)
-                    print(f"[trainer] elastic resume: healed quarantined "
+                    emit(f"[trainer] elastic resume: healed quarantined "
                           f"worker momenta {sick} from the healthy mean "
                           "before the world remap")
             st = st._replace(
@@ -1803,7 +1870,7 @@ class Trainer:
             )
             # the accumulator's normalizations reference the old world; a
             # fresh window is honest, stale continuity is not
-            print(f"[trainer] elastic resume: remapped [{ckpt_world}, ...] "
+            emit(f"[trainer] elastic resume: remapped [{ckpt_world}, ...] "
                   f"momenta to [{self.world}, ...] "
                   f"({'group mean' if ckpt_world > self.world else 'replicate'}"
                   f" policy, cross-worker mean preserved)")
@@ -1842,16 +1909,16 @@ class Trainer:
             try:
                 self._restore_step(step, meta, ckpt_world)
             except Exception as e:
-                print(f"[trainer] checkpoint step {step} failed to restore "
+                emit(f"[trainer] checkpoint step {step} failed to restore "
                       f"({e}); falling back to the previous good checkpoint")
                 continue
             purged = self.checkpointer.purge_steps_after(step)
             if purged:
-                print(f"[trainer] purged stale newer checkpoints {purged}: "
+                emit(f"[trainer] purged stale newer checkpoints {purged}: "
                       "left on disk they make Orbax silently drop every "
                       "post-resume save below them (the deterministic "
                       "replay re-creates them bit-identically)")
-            print(f"[trainer] resumed from checkpoint step {step}")
+            emit(f"[trainer] resumed from checkpoint step {step}")
             return
         if candidates:
             # every verified checkpoint failed to restore — that's a
@@ -1881,6 +1948,11 @@ class Trainer:
                 self.checkpointer.close()
         finally:
             self.logger.close()
+            # the journal closes LAST: the checkpointer drain above still
+            # records its ckpt spans, and a commit failure propagating out
+            # of this method leaves a flushed journal behind it
+            journal.uninstall(self.journal)
+            self.journal.close()
 
     # ------------------------------------------------------------- factories
     @staticmethod
@@ -1912,7 +1984,7 @@ class Trainer:
                                     accum_steps=cfg.gradient_accumulation_steps,
                                     vote_buckets=cfg.vote_buckets or 1)
         tp = mesh.shape[TENSOR_AXIS]
-        print(
+        emit(
             f"[trainer] GPT-2 {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
             f"tp={tp} | vote wire={cfg.wire}"
             + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")
@@ -2039,7 +2111,7 @@ class Trainer:
                 p.size for b in params["blocks"] if "moe" in b
                 for p in jax.tree.leaves(b["moe"])
             )
-            print(f"[trainer] GPT-2-MoE: {count_params(params)/1e6:.1f}M total "
+            emit(f"[trainer] GPT-2-MoE: {count_params(params)/1e6:.1f}M total "
                   f"({n_active/1e6:.1f}M dense) | {model_cfg.moe_experts} "
                   f"experts every {model_cfg.moe_every} blocks | ep={ep}")
             return Trainer(cfg, mesh, apply_fn=None, params=params,
@@ -2079,7 +2151,7 @@ class Trainer:
         if seq_axis:
             validate_seq_block(cfg, model_cfg, sp)
             if model_cfg.dropout > 0.0:
-                print(
+                emit(
                     "[trainer] WARNING: attention-probability dropout is "
                     "disabled under sequence parallelism (scores never exist "
                     "in one place on the ring path); residual/embedding "
@@ -2192,7 +2264,7 @@ class Trainer:
                                     vote_buckets=cfg.vote_buckets or 1)
         tp = mesh.shape[TENSOR_AXIS]
         pp = dict(mesh.shape).get(PIPE_AXIS, 1)
-        print(
+        emit(
             f"[trainer] Llama {n/1e6:.1f}M params | world={data_axis_size(mesh)} "
             f"tp={tp}" + (f" pp={pp}" if pp > 1 else "") + f" | vote wire={cfg.wire}"
             + (f" (vote_every={cfg.vote_every})" if cfg.vote_every > 1 else "")

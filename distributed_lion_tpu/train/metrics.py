@@ -13,9 +13,10 @@ import json
 import math
 import os
 import pathlib
-import sys
 import time
 from typing import Optional
+
+from distributed_lion_tpu.train.journal import emit
 
 
 class MetricsLogger:
@@ -35,7 +36,8 @@ class MetricsLogger:
                            name=run_name)
                 self.wandb = wandb
             except Exception as e:  # offline / not installed: degrade to local logs
-                print(f"[metrics] wandb unavailable ({e}); logging locally", file=sys.stderr)
+                emit(f"[metrics] wandb unavailable ({e}); logging locally",
+                     stderr=True)
         self._t0 = time.time()
 
     def log(self, step: int, metrics: dict, prefix: str = "train") -> None:
@@ -44,7 +46,10 @@ class MetricsLogger:
         record.update({f"{prefix}{sep}{k}": _scalar(v) for k, v in metrics.items()})
         line = " ".join(f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
                         for k, v in record.items())
-        print(line, flush=True)
+        # record=False: the metrics stream's durable form IS metrics.jsonl
+        # below — duplicating every row into the run journal would bloat it
+        # with data the analyzer reads from the metrics file anyway
+        emit(line, record=False)
         if self.jsonl:
             # allow_nan=False: json.dumps(nan) silently emits the bare token
             # `NaN`, which is NOT JSON — every strict consumer downstream

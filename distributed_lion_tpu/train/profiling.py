@@ -26,6 +26,7 @@ from typing import Optional
 import numpy as np
 
 from distributed_lion_tpu.ops.codec import wire_bytes_per_param
+from distributed_lion_tpu.train.journal import emit
 
 
 class StepProfiler:
@@ -79,8 +80,8 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
-            print(f"[profiler] trace for steps [{self.start_step}, "
-                  f"{self.stop_step}) written to {self.trace_dir}")
+            emit(f"[profiler] trace for steps [{self.start_step}, "
+                 f"{self.stop_step}) written to {self.trace_dir}")
 
     def close(self, sync=None) -> None:
         if self._active:

@@ -31,6 +31,7 @@ import os
 import pathlib
 import signal
 import threading
+import time
 from typing import Any, Iterable, Optional
 
 # --------------------------------------------------------------------------
@@ -272,9 +273,18 @@ class PreemptionGuard:
     to a manually-triggerable flag (:meth:`trigger`) instead of failing.
     """
 
-    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,),
+                 journal=None):
         self._flag = threading.Event()
         self._prev: dict[int, Any] = {}
+        # run-journal hook (train/journal.py, duck-typed so this module
+        # stays import-light): the drain event is recorded from
+        # should_stop() on the TRAIN LOOP's thread, never from the signal
+        # handler — a handler must stay async-signal-safe (flag + one
+        # clock read, nothing that allocates or takes locks)
+        self._journal = journal
+        self._tripped_mono: Optional[float] = None
+        self._drain_logged = False
         for sig in signals:
             try:
                 self._prev[sig] = signal.signal(sig, self._on_signal)
@@ -291,16 +301,33 @@ class PreemptionGuard:
             signal.signal(signum, prev if prev is not None else signal.SIG_DFL)
             signal.raise_signal(signum)
             return
-        # first delivery, async-signal-safe: set the flag, nothing else
+        # first delivery, async-signal-safe: stamp the clock + set the
+        # flag, nothing else (the stamp is what lets the journal report
+        # signal→drain-boundary latency — how long a preemption waits for
+        # a consistent dispatch boundary)
+        self._tripped_mono = time.monotonic()
         self._flag.set()
 
     def trigger(self) -> None:
         """Programmatic preemption (tests; cluster agents that learn of
         maintenance through an API rather than a signal)."""
+        if self._tripped_mono is None:
+            self._tripped_mono = time.monotonic()
         self._flag.set()
 
     def should_stop(self) -> bool:
-        return self._flag.is_set()
+        tripped = self._flag.is_set()
+        if tripped and not self._drain_logged:
+            # first observation at a dispatch boundary: THE preemption-
+            # drain event (the trainer is about to drain the in-flight
+            # save and write the emergency checkpoint)
+            self._drain_logged = True
+            if self._journal is not None:
+                latency = (time.monotonic() - self._tripped_mono
+                           if self._tripped_mono is not None else 0.0)
+                self._journal.event("preempt_drain",
+                                    signal_to_boundary_s=round(latency, 6))
+        return tripped
 
     def close(self) -> None:
         """Restore the previous handlers (Trainers are created and torn

@@ -57,6 +57,7 @@ import numpy as np
 from jax import lax
 
 from distributed_lion_tpu.ops.codec import packed_size, parse_wire
+from distributed_lion_tpu.train.journal import emit
 
 # fixed margin-histogram bins over the margin fraction |total|/W in [0, 1]:
 # bin k covers [k/NBINS, (k+1)/NBINS), with margin == 1 (unanimity) clipped
@@ -286,7 +287,7 @@ def host_step_skew(step: int) -> Optional[int]:
         steps = multihost_utils.process_allgather(np.asarray(step, np.int64))
         return int(np.max(steps) - np.min(steps))
     except Exception as e:  # heartbeat must never take down training
-        print(f"[telemetry] heartbeat unavailable: {e}")
+        emit(f"[telemetry] heartbeat unavailable: {e}")
         return None
 
 
@@ -325,16 +326,25 @@ def _json_safe(obj):
 
 def write_crash_bundle(output_dir: str, step: int, reason: str,
                        cfg_dict: dict, params: Any, opt_state: Any,
-                       metrics_window, guard: Optional[dict] = None) -> str:
+                       metrics_window, guard: Optional[dict] = None,
+                       journal_tail=None) -> str:
     """Write ``<output_dir>/crash/step_<n>/bundle.json``: everything needed
     to explain a non-finite step without re-running under a profiler —
     step, trip reason, the full train config, per-leaf non-finite counts
     for params AND optimizer state (naming the poisoned leaves), the recent
     metrics window, and (``guard``) the vote guard's per-WORKER health
     report — mask, strikes, signal counters — so the bundle names the sick
-    worker, not just the poisoned leaves. Returns the bundle directory."""
+    worker, not just the poisoned leaves. ``journal_tail`` (the run
+    journal's ring buffer, train/journal.py) lands beside the bundle as
+    ``journal_tail.jsonl`` — the anomaly carries its own timeline: the last
+    N spans/events before the trip, in the same strict-JSONL schema the
+    live journal writes. Returns the bundle directory."""
     crash_dir = os.path.join(output_dir, "crash", f"step_{step:08d}")
     os.makedirs(crash_dir, exist_ok=True)
+    if journal_tail:
+        with open(os.path.join(crash_dir, "journal_tail.jsonl"), "w") as f:
+            for rec in journal_tail:
+                f.write(json.dumps(_json_safe(rec), allow_nan=False) + "\n")
     bundle = {
         "step": step,
         "reason": reason,

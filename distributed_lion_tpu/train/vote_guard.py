@@ -85,7 +85,8 @@ class VoteGuard:
     def __init__(self, world: int, mode: str, strike_threshold: int = 3,
                  cooldown_steps: int = 50, min_quorum: int = 0,
                  disagree_abs: float = DISAGREE_ABS,
-                 disagree_margin: float = DISAGREE_MARGIN):
+                 disagree_margin: float = DISAGREE_MARGIN,
+                 journal=None):
         if mode not in ("observe", "enforce"):
             raise ValueError(f"guard mode must be 'observe' or 'enforce', "
                              f"got {mode!r}")
@@ -107,6 +108,12 @@ class VoteGuard:
                 f"min_quorum {self.min_quorum} outside [1, {self.world}]")
         self.disagree_abs = float(disagree_abs)
         self.disagree_margin = float(disagree_margin)
+        # run-journal hook (train/journal.py; duck-typed — this module
+        # stays importable without jax and without the journal): every
+        # quarantine/readmission transition is recorded as an event, so
+        # the control plane consumes the state machine as a stream instead
+        # of scraping log lines
+        self._journal = journal
         self.healthy = np.ones(self.world, dtype=bool)
         self.strikes = np.zeros(self.world, dtype=np.int64)
         self.quarantined_at = np.full(self.world, -1, dtype=np.int64)
@@ -247,6 +254,12 @@ class VoteGuard:
                         f"{would}QUARANTINED worker {w} at step {step} "
                         f"({'+'.join(sig) or 'strikes'}); healthy quorum "
                         f"{self.healthy_count()}/{self.world}")
+                    if self._journal is not None:
+                        self._journal.event(
+                            "guard_quarantine", worker=int(w),
+                            step=int(step), mode=self.mode,
+                            signals="+".join(sig) or "strikes",
+                            healthy=self.healthy_count())
             else:
                 if step - self.quarantined_at[w] >= self.cooldown_steps:
                     self.healthy[w] = True
@@ -259,6 +272,10 @@ class VoteGuard:
                         f"{would}READMITTED worker {w} at step {step} "
                         "(cooldown elapsed; momentum re-averaged from the "
                         "healthy mean — a still-sick worker re-strikes)")
+                    if self._journal is not None:
+                        self._journal.event(
+                            "guard_readmit", worker=int(w), step=int(step),
+                            mode=self.mode, healthy=self.healthy_count())
         return events
 
 
@@ -272,9 +289,11 @@ def parse_guard_mode(mode: str) -> str:
 
 
 def make_guard(world: int, mode: str, strike_threshold: int,
-               cooldown_steps: int, min_quorum: int) -> Optional[VoteGuard]:
+               cooldown_steps: int, min_quorum: int,
+               journal=None) -> Optional[VoteGuard]:
     """The trainer's constructor: None when the guard is off."""
     if parse_guard_mode(mode) == "off":
         return None
     return VoteGuard(world, mode, strike_threshold=strike_threshold,
-                     cooldown_steps=cooldown_steps, min_quorum=min_quorum)
+                     cooldown_steps=cooldown_steps, min_quorum=min_quorum,
+                     journal=journal)

@@ -12,6 +12,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py static         # graft-check both tiers
     python scripts/check_evidence.py vote_guard     # poisoned-run rescue
     python scripts/check_evidence.py autotune       # TPU-keyed tuning cache
+    python scripts/check_evidence.py journal        # run-journal attribution
     python scripts/check_evidence.py all
 
 parity:vote / parity:lazy are STRICT since ISSUE 6: a leg counts as
@@ -493,6 +494,45 @@ def autotune_ok() -> bool:
     return set(at.KNOBS) <= tpu_knobs
 
 
+# the run-journal stage (ISSUE 7): the runbook's journal leg records a
+# --journal training (runs/journal) whose journal must (a) exist and parse
+# under the strict schema (run_analyze counts schema errors), (b) close —
+# named buckets + other + unattributed == measured wall — and (c) attribute
+# at least JOURNAL_MIN_COVERAGE of the measured step wall to the NAMED
+# buckets (device / dispatch / data / ckpt / logging): the acceptance
+# criterion that makes the next MFU push start from a named stall budget
+# instead of a guess. The analyzer is cli/run_analyze — stdlib-only,
+# loaded by FILE PATH like the autotune validator, so this script stays
+# jax-free.
+JOURNAL_MIN_COVERAGE = 0.95
+
+
+def _run_analyze_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "dlt_run_analyze_standalone",
+        os.path.join(REPO, "distributed_lion_tpu", "cli", "run_analyze.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def journal_ok(dirname: str = "journal") -> bool:
+    base = (dirname if os.path.isabs(dirname)
+            else os.path.join(REPO, "runs", dirname))
+    try:
+        ra = _run_analyze_module()
+        report = ra.analyze_dir(base)
+    except Exception:
+        return False
+    if report is None or report.get("schema_errors"):
+        return False
+    att = report.get("attribution")
+    return bool(att and att["closes"] and att.get("steps", 0) > 0
+                and att["coverage"] >= JOURNAL_MIN_COVERAGE)
+
+
 # the ONE stage list both check("all") and the CLI printout derive from —
 # adding a stage here updates the watcher exit condition and the operator
 # status display together
@@ -513,6 +553,7 @@ STAGES = [
     ("static", static_ok),
     ("vote_guard", vote_guard_ok),
     ("autotune", autotune_ok),
+    ("journal", journal_ok),
 ]
 
 # automation (the watcher exit condition) judges the parity legs on
@@ -575,6 +616,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return vote_guard_ok(arg or "vote_guard")
     if what == "autotune":
         return autotune_ok()
+    if what == "journal":
+        return journal_ok(arg or "journal")
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -391,6 +391,42 @@ else
     || echo "$(stamp) vote_guard artifact FAILED validation" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5f. run-journal artifact (ISSUE 7, ~3 min): a short --journal
+# training at the stage-4 bench shape (gpt2_124m, the promoted-config
+# model/cadence, async checkpoints ON so the ckpt spans have something to
+# show) under runs/journal, then cli/run_analyze over it — step-time
+# attribution (device/dispatch/data/ckpt/logging), top stall sources, and
+# a diff against the promoted headline's journal_attribution so the next
+# MFU push starts from a NAMED stall budget. check_evidence's 'journal'
+# stage asserts the journal parses under the strict schema, the
+# attribution closes, and >=95% of measured step wall lands in named
+# buckets (the ISSUE-7 acceptance criterion, on a real leg).
+if python scripts/check_evidence.py journal; then
+  echo "$(stamp) journal artifact already captured — skip" | tee -a "$OUT/log.txt"
+else
+  mkdir -p runs/journal
+  timeout -k 60 900 python -m distributed_lion_tpu.cli.run_clm \
+      --model_name gpt2_124m --dataset synthetic --lion --async_grad \
+      --journal \
+      --per_device_train_batch_size 4 --gradient_accumulation_steps 1 \
+      --block_size 512 --max_steps 30 --warmup_steps 5 \
+      --logging_steps 5 --eval_steps 100000 --save_steps 10 \
+      --save_total_limit 2 \
+      --output_dir runs/journal \
+      >> "$OUT/journal.log" 2>&1
+  rc=$?
+  python scripts/validate_metrics.py runs/journal/journal/journal_rank*.jsonl \
+      >> "$OUT/journal.log" 2>&1 || rc=$?
+  python -m distributed_lion_tpu.cli.run_analyze runs/journal \
+      --baseline scripts/last_tpu_measurement.json \
+      --json-out "$OUT/journal_analyze.json" \
+      >> "$OUT/journal.log" 2>&1 || rc=$?
+  echo "$(stamp) journal rc=$rc" | tee -a "$OUT/log.txt"
+  python scripts/check_evidence.py journal \
+    && echo "$(stamp) journal artifact captured" | tee -a "$OUT/log.txt" \
+    || echo "$(stamp) journal artifact FAILED validation" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

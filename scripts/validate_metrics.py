@@ -17,6 +17,15 @@ script asserts a metrics file actually honors that contract:
 A torn final line (a run killed mid-write) is tolerated once, at EOF —
 append-mode logs legitimately end that way.
 
+JSONL arguments whose basename starts with ``journal`` (the run journal's
+``journal_rank<r>.jsonl`` files and their rotations, plus crash bundles'
+``journal_tail.jsonl`` — train/journal.py) get the journal record schema
+instead: every line a strict-JSON object carrying ``kind`` (meta | span |
+event | log), a string ``name``, a finite number ``t`` and an integer
+``rank``; span records additionally carry a finite non-negative ``dur``.
+The torn-final-line tolerance applies the same way (a crash mid-write
+tears at most the last record — the journal's documented durability unit).
+
 Non-JSONL arguments (``*.json``) are validated as strict single-document
 JSON artifacts, so EVERY JSON artifact the repo writes passes one
 validator: crash bundles (``crash/step_*/bundle.json`` — must carry
@@ -91,6 +100,69 @@ def validate_file(path: str) -> list[str]:
                           f"{type(v).__name__} (want scalar or flat list)")
     if n_records == 0:
         errors.append(f"{path}: no metrics records")
+    return errors
+
+
+_JOURNAL_KINDS = ("meta", "span", "event", "log")  # == train/journal.KINDS
+
+
+def _finite_number(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v == v and v not in (float("inf"), float("-inf")))
+
+
+def validate_journal_file(path: str) -> list[str]:
+    """Strict-schema check for run-journal JSONL (train/journal.py): the
+    per-line single-doc + allow_nan=False discipline of validate_file, plus
+    the journal record contract — kind/name/t/rank on every record, a
+    finite non-negative dur on spans, scalar-or-flat-list values
+    throughout. Returns violation strings (empty = valid)."""
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    n_records = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as e:
+            if i == len(lines) and "constant" not in str(e):
+                continue  # torn last line (crash mid-write): tolerated
+            errors.append(f"{path}:{i}: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: record is {type(rec).__name__}, "
+                          "not an object")
+            continue
+        n_records += 1
+        if rec.get("kind") not in _JOURNAL_KINDS:
+            errors.append(f"{path}:{i}: 'kind' must be one of "
+                          f"{_JOURNAL_KINDS}, got {rec.get('kind')!r}")
+        if not isinstance(rec.get("name"), str):
+            errors.append(f"{path}:{i}: missing string 'name'")
+        if not _finite_number(rec.get("t")):
+            errors.append(f"{path}:{i}: missing finite number 't'")
+        if not isinstance(rec.get("rank"), int) \
+                or isinstance(rec.get("rank"), bool):
+            errors.append(f"{path}:{i}: missing integer 'rank'")
+        if rec.get("kind") == "span" and not (
+                _finite_number(rec.get("dur")) and rec["dur"] >= 0):
+            errors.append(f"{path}:{i}: span without a finite non-negative "
+                          "'dur'")
+        for k, v in rec.items():
+            if _scalar_ok(v):
+                continue
+            if isinstance(v, list) and all(_scalar_ok(x) for x in v):
+                continue
+            errors.append(f"{path}:{i}: key {k!r} holds a "
+                          f"{type(v).__name__} (want scalar or flat list)")
+    if n_records == 0:
+        errors.append(f"{path}: no journal records")
     return errors
 
 
@@ -178,8 +250,15 @@ def main(argv: list[str]) -> int:
         return 2
     failed = False
     for path in argv:
-        errors = (validate_file(path) if path.endswith(".jsonl")
-                  else validate_json_doc(path))
+        if path.endswith(".jsonl"):
+            # run-journal files (journal_rank<r>.jsonl + rotations,
+            # journal_tail.jsonl in crash bundles) carry the journal
+            # record schema; every other .jsonl is a metrics log
+            journal = os.path.basename(path).startswith("journal")
+            errors = (validate_journal_file(path) if journal
+                      else validate_file(path))
+        else:
+            errors = validate_json_doc(path)
         if errors:
             failed = True
             for e in errors:

@@ -1,0 +1,18 @@
+"""DLT009 fixture: bare ``print()`` in a module under a ``train/``
+directory (this fixture lives under ``fixtures/analysis/train/`` so the
+path-scoped rule applies to it exactly as it does to the real
+``distributed_lion_tpu/train/`` modules). Console output here must route
+through ``train/journal.emit`` — mirrored to stdout, recorded in the run
+journal — so the control plane consumes one event stream."""
+
+
+def report_progress(step, loss):
+    print(f"step {step}: loss {loss:.3f}")  # ← DLT009: bypasses the journal
+    return loss
+
+
+def warn_operator(msg):
+    print(f"WARNING: {msg}")  # ← DLT009: an event the journal never sees
+    # justified escape hatch exercised below: the suppression syntax works
+    # for DLT009 exactly as for every other rule
+    print("low-level diagnostics")  # graft: disable=DLT009

@@ -34,6 +34,10 @@ RULE_FIXTURES = {
     "DLT006": ("dlt006_swallowed.py", 2),
     "DLT007": ("dlt007_json.py", 2),
     "DLT008": ("dlt008_mutable_default.py", 2),
+    # the DLT009 fixture sits under fixtures/analysis/train/ so the
+    # path-scoped rule (bare print under a train//data/ directory) applies
+    # to it the same way it applies to distributed_lion_tpu/train/
+    "DLT009": (os.path.join("train", "dlt009_bare_print.py"), 2),
 }
 
 

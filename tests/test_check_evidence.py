@@ -507,3 +507,39 @@ def test_vote_guard_stage(tmp_path, monkeypatch):
     assert not ce.vote_guard_ok()           # adversary didn't degrade
     write("poison_off", [v + 0.5 for v in clean[:20]])
     assert not ce.vote_guard_ok()           # short leg (< GUARD_MIN_STEPS)
+
+
+def test_journal_stage(tmp_path):
+    """The 'journal' stage (ISSUE 7): captured only when a journal exists,
+    parses under the strict schema, the attribution CLOSES, and >=95% of
+    measured step wall lands in named buckets. Absent journals, schema
+    errors, and poor coverage must all read MISSING."""
+    import json as _json
+
+    def rec(**kw):
+        return _json.dumps(kw)
+
+    def write(d, cover_frac):
+        d.mkdir(parents=True, exist_ok=True)
+        # a 10s window with `cover_frac` of it tiled by dispatch spans
+        rows = [rec(kind="meta", name="journal_start", t=0.0, rank=0,
+                    wall=100.0, version=1),
+                rec(kind="event", name="train_start", t=0.0, rank=0, step=0),
+                rec(kind="span", name="dispatch", t=10.0 * cover_frac,
+                    rank=0, dur=10.0 * cover_frac, step=0),
+                rec(kind="event", name="step_log", t=9.9, rank=0, step=9),
+                rec(kind="event", name="train_end", t=10.0, rank=0, step=10)]
+        (d / "journal_rank0.jsonl").write_text("\n".join(rows) + "\n")
+
+    assert not ce.journal_ok(str(tmp_path / "missing"))   # no journal at all
+    good = tmp_path / "good"
+    write(good, 0.98)
+    assert ce.journal_ok(str(good))
+    sparse = tmp_path / "sparse"
+    write(sparse, 0.5)                                    # coverage 50%
+    assert not ce.journal_ok(str(sparse))
+    bad = tmp_path / "bad"
+    write(bad, 0.98)
+    p = bad / "journal_rank0.jsonl"
+    p.write_text('{"kind": "span"}\n' + p.read_text())    # schema error
+    assert not ce.journal_ok(str(bad))

@@ -85,3 +85,45 @@ def test_mixed_jsonl_and_doc_arguments(tmp_path):
     doc = tmp_path / "bundle.json"
     doc.write_text('{"step": 1, "reason": "r", "config": {}}\n')
     assert _run(jl, doc).returncode == 0
+
+
+# ------------------------------------------------------- run-journal schema
+def test_real_journal_file_validates(tmp_path):
+    """The REAL writer (train/journal.Journal) produces files the journal
+    schema accepts — meta anchor, spans with dur, events, log records."""
+    from distributed_lion_tpu.train.journal import Journal
+
+    j = Journal(str(tmp_path))
+    with j.span("dispatch", step=1, steps=1):
+        pass
+    j.event("step_log", step=1)
+    j.log("[trainer] hello")
+    j.close()
+    r = _run(tmp_path / "journal_rank0.jsonl")
+    assert r.returncode == 0, r.stdout
+
+
+def test_journal_schema_rejects_bad_records(tmp_path):
+    """Journal JSONL gets the journal record schema, not the metrics one:
+    a span without dur, an unknown kind, a missing rank, and a bare NaN
+    token are each rejected."""
+    cases = {
+        "no_dur": '{"kind": "span", "name": "dispatch", "t": 1.0, "rank": 0}',
+        "bad_kind": '{"kind": "frame", "name": "x", "t": 1.0, "rank": 0}',
+        "no_rank": '{"kind": "event", "name": "x", "t": 1.0}',
+        "nan": '{"kind": "event", "name": "x", "t": NaN, "rank": 0}',
+    }
+    for name, line in cases.items():
+        p = tmp_path / f"journal_{name}.jsonl"
+        # two lines so the bad one is never the tolerated torn-tail line
+        p.write_text(line + "\n"
+                     + '{"kind": "event", "name": "ok", "t": 2.0, "rank": 0}'
+                     + "\n")
+        assert _run(p).returncode == 1, name
+
+
+def test_journal_torn_last_line_tolerated(tmp_path):
+    p = tmp_path / "journal_rank0.jsonl"
+    p.write_text('{"kind": "meta", "name": "journal_start", "t": 1.0, '
+                 '"rank": 0, "wall": 5.0}\n{"kind": "span", "na')
+    assert _run(p).returncode == 0
