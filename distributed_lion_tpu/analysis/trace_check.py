@@ -133,6 +133,7 @@ def collective_calls(fn, *args) -> tuple[list[CollectiveCall], list[str]]:
 # ----------------------------------------------------------- expected recipe
 def expected_wire_calls(n_params: int, world: int, wire: str, *,
                         vote_every: int = 1, vote_buckets: int = 1,
+                        dcn_pipeline_depth: int = 0,
                         axis_name: str = DATA_AXIS) -> list[tuple]:
     """The wire recipe's expected collective call sites, as a sorted list of
     ``(prim, axes, nelems)`` — derived from the SAME single sources of truth
@@ -150,7 +151,18 @@ def expected_wire_calls(n_params: int, world: int, wire: str, *,
       ballot reduce-scatter (``[chunk]`` at the accumulator width, g > 1),
       cross-group packed-verdict ring (``[chunk/8]``, W/g > 1), intra-group
       packed-elected all-gather (``[chunk/8]``, g > 1).
+
+    ``dcn_pipeline_depth`` is accepted to PIN the depth-invariance contract
+    of the hier wire's cross-step pipeline: at any depth, every step runs
+    exactly one launch (legs 1+2 for its own ballot) and one consume (leg 3
+    for the ballot launched d steps earlier), so the expected inventory is
+    IDENTICAL to the synchronous wire — no duplicate DCN collective (a
+    cold-start path that traced both a fresh and a stale consume would
+    double leg 3), no missing leg, the ICI legs untouched. The parameter
+    deliberately does not change the expectation; callers pass it so the
+    contract is explicit in every depth cell (tests/test_trace_check.py).
     """
+    del dcn_pipeline_depth  # depth-invariant by design — see docstring
     kind, group = parse_wire(wire)
     ballot = (n_params if vote_every <= 1
               else vote_chunk_elems(n_params, vote_every))
@@ -237,6 +249,7 @@ def donation_report(jitted, *args) -> dict:
 
 def check_step(fn, args: tuple, *, n_params: int, world: int, wire: str,
                vote_every: int = 1, vote_buckets: int = 1,
+               dcn_pipeline_depth: int = 0,
                axis_name: str = DATA_AXIS,
                scalar_max: int = SCALAR_MAX) -> dict:
     """Run the jaxpr contract over one step function + example args.
@@ -250,7 +263,8 @@ def check_step(fn, args: tuple, *, n_params: int, world: int, wire: str,
     scalar_calls = [c for c in calls if c.nelems <= scalar_max]
     expected = expected_wire_calls(
         n_params, world, wire, vote_every=vote_every,
-        vote_buckets=vote_buckets, axis_name=axis_name)
+        vote_buckets=vote_buckets, dcn_pipeline_depth=dcn_pipeline_depth,
+        axis_name=axis_name)
     inventory_ok = wire_calls == expected
     return {
         "ok": bool(inventory_ok and not callbacks),
@@ -263,6 +277,7 @@ def check_step(fn, args: tuple, *, n_params: int, world: int, wire: str,
         "world": world,
         "vote_every": vote_every,
         "vote_buckets": vote_buckets,
+        "dcn_pipeline_depth": dcn_pipeline_depth,
     }
 
 
@@ -279,7 +294,8 @@ def check_trainer(trainer, batch_example, *,
     report = check_step(
         trainer._train_step_core, args,
         n_params=trainer.n_params, world=trainer.world, wire=cfg.wire,
-        vote_every=cfg.vote_every or 1, vote_buckets=cfg.vote_buckets or 1)
+        vote_every=cfg.vote_every or 1, vote_buckets=cfg.vote_buckets or 1,
+        dcn_pipeline_depth=cfg.dcn_pipeline_depth)
     report["donation"] = donation_report(trainer._train_step, *args)
     report["donation_ok"] = (report["donation"]["aliased_outputs"] > 0
                              or report["donation"]["buffer_donors"] > 0)

@@ -152,13 +152,14 @@ def _bucket(name: str) -> Optional[str]:
 
 
 def _step_spans(events: list, rank: int) -> list:
-    """This rank's step-thread spans (committer/background-thread spans are
-    excluded: they overlap the step wall by design and must not count
-    against it)."""
+    """This rank's step-thread spans. Any span stamped with a ``thread``
+    field ran OFF the step thread (the checkpoint committer, the emulated
+    DCN link's ``dcn_wait``) and is excluded: such spans overlap the step
+    wall by design and must not count against it."""
     return [r for r in events
             if r.get("kind") == "span" and int(r.get("rank", 0)) == rank
             and isinstance(r.get("dur"), (int, float))
-            and r.get("thread") != "committer"]
+            and not r.get("thread")]
 
 
 def _leg_window(mine: list, key: str) -> tuple:

@@ -114,6 +114,42 @@ def a2a_chunk_bytes(n: int, world_size: int) -> int:
     return max(1, -(-n // (8 * world_size)))
 
 
+def hier_chunk_slot_bytes(nb: int, world_size: int, group: int) -> int:
+    """uint8 bytes of one BUCKET's in-flight DCN slot segment for an
+    ``nb``-coordinate ballot chunk on the ``hier:<g>`` wire: a [n_groups]
+    launch-time group-alive byte mask followed by the [n_groups, chunk/8]
+    packed per-group level-2 verdict stack for this worker's owned chunk
+    (collectives.hier_launch's exact output)."""
+    n_groups = world_size // group
+    return n_groups * (1 + a2a_chunk_bytes(nb, group))
+
+
+def hier_ring_slot_bytes(n: int, world_size: int, group: int,
+                         vote_buckets: int = 1, vote_every: int = 1) -> int:
+    """uint8 bytes of ONE in-flight slot of the hier wire's cross-step DCN
+    ring (``--dcn_pipeline_depth``): the concatenation of the per-bucket
+    segments (:func:`hier_chunk_slot_bytes`) over ``bucket_bounds`` of the
+    per-step ballot.
+
+    Single source of truth for the optimizer's ``dcn_ring`` state layout
+    (optim.distributed_lion), the collectives' launch/consume slicing
+    (collectives.hier_launch / hier_consume) and the trainer's restore
+    templates — the three MUST agree or a checkpointed in-flight tally
+    lands on the wrong coordinates.
+    """
+    if world_size % group:
+        raise ValueError(
+            f"hier wire: group size {group} does not divide world "
+            f"{world_size}")
+    # under lazy refresh the wire is handed the PADDED rotating slice
+    # (optim._elect_lazy slices exactly vote_chunk_elems coordinates), so
+    # the ring is laid out for the slice length, not min(n, slice)
+    ballot = n if vote_every <= 1 else vote_chunk_elems(n, vote_every)
+    return sum(hier_chunk_slot_bytes(size, world_size, group)
+               for _, size in bucket_bounds(ballot, max(vote_buckets, 1),
+                                            world_size, f"hier:{group}"))
+
+
 def pack_signs(positive: jnp.ndarray) -> jnp.ndarray:
     """Pack a boolean array (True = +1 vote) into uint8, 8 votes per byte.
 
@@ -188,7 +224,8 @@ def _recv_bytes(n: int, world_size: int, kind: str,
 
 def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
                          vote_every: int = 1, accum_steps: int = 1,
-                         vote_buckets: int = 1) -> dict:
+                         vote_buckets: int = 1,
+                         dcn_pipeline_depth: int = 0) -> dict:
     """Accounting for bytes RECEIVED per worker, per optimizer step.
 
     The reference ships int64-packed tensors via all_gather: every worker
@@ -232,6 +269,20 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
             :func:`bucket_bounds` — which, by the bucket-boundary alignment,
             is exactly the unbucketed total: bucketing changes when bytes
             move (overlapped with compute), never how many.
+        dcn_pipeline_depth: cross-step pipeline depth of the hier wire's
+            level-2 (DCN) leg (optim.distributed_lion): at depth d > 0 the
+            cross-group packed-verdict ring launched at step t is consumed
+            only at step t+d, so its round-trip latency hides behind d
+            steps of compute. The OVERLAPPED leg still moves exactly the
+            same bytes every step — one launch and one consume execute per
+            step in steady state, so ``bytes_per_step``/``dcn_bytes_per_
+            step`` (and the measured counters they're cross-checked
+            against: ``comm_drift_bytes`` stays 0) are depth-invariant.
+            What depth changes is the ``dcn_overlap_frac`` extra: the
+            fraction of the DCN leg's LATENCY eligible to leave the
+            critical path (1.0 once the leg rides the ring, 0.0 for the
+            synchronous depth-0 wire). The measured counterpart comes from
+            the bench_dcn ablation (scripts/bench_dcn.py).
 
     Returns:
         dict with bytes received per worker per optimizer step for this
@@ -265,9 +316,16 @@ def wire_bytes_per_param(num_params: int, world_size: int, wire: str,
                     if ours and world_size > 1 else 0.0)
     if kind == "hier":
         dcn = sum(d for _, d in per_bucket)
+        # the level-2 leg's latency leaves the critical path entirely once
+        # it rides the cross-step ring (depth ≥ 1) — and only then; no leg
+        # exists to hide at W=1 or single-group (g=W) topologies
+        dcn_overlap = (1.0 if (dcn_pipeline_depth > 0 and dcn > 0
+                               and world_size > 1) else 0.0)
         extras = {"hier_groups": world_size // group,
                   "dcn_bytes_per_step": dcn,
-                  "dcn_bits_per_param": 8.0 * dcn / max(num_params, 1)}
+                  "dcn_bits_per_param": 8.0 * dcn / max(num_params, 1),
+                  "dcn_pipeline_depth": max(dcn_pipeline_depth, 0),
+                  "dcn_overlap_frac": dcn_overlap}
     if world_size <= 1:
         # one voter: every wire short-circuits (a psum/all_gather over a
         # 1-device axis is a no-op — no bytes cross any fabric). Reporting

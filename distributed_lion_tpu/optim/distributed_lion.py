@@ -46,8 +46,11 @@ from jax import lax
 from distributed_lion_tpu.ops import lion_math
 from distributed_lion_tpu.ops.codec import (
     bucket_bounds,
+    hier_chunk_slot_bytes,
+    hier_ring_slot_bytes,
     pack_signs,
     packed_size,
+    parse_wire,
     vote_chunk_elems,
 )
 from distributed_lion_tpu.optim.lion import (
@@ -141,6 +144,7 @@ def distributed_lion(
     wire: str = "sign_psum",
     vote_every: int = 1,
     vote_buckets: int = 1,
+    dcn_pipeline_depth: int = 0,
     mom_dtype: Optional[jnp.dtype] = None,
     kernel: str = "auto",
     row_block: int = 0,
@@ -185,6 +189,29 @@ def distributed_lion(
             is elected or how much ships. Composes with ``vote_every``
             (the rotating 1/K slice is itself voted bucket-wise) and the
             stochastic path. 1 = the monolithic vote.
+        dcn_pipeline_depth: d > 0 (hier wire only) enables the *cross-step
+            DCN pipeline*: each step still computes and combines its level-1
+            ICI tally immediately and launches the level-2 cross-group
+            (DCN) ring for its own ballot — but the ring's result is only
+            CONSUMED d steps later, riding ``LionState.dcn_ring`` (one slot
+            per in-flight step, codec.hier_ring_slot_bytes layout) so the
+            slow leg's round trip hides behind d steps of compute instead
+            of sitting on every step's critical path. The elected signs
+            applied at step t are therefore the complete two-level election
+            of step t−d's ballots — uniformly d steps stale on every
+            worker, so replicas stay bit-identical; the first d steps apply
+            no update (momentum still accumulates — the same cold-start
+            rule as ``vote_every``'s unvoted slots). Composes with
+            ``vote_buckets`` (each bucket launches/consumes its own ring
+            segment), ``vote_every`` (the consumed election lands in the
+            elected cache's slot (t−d) mod K) and the vote guard (the ring
+            slot carries its launch-time group-health mask; a group fully
+            quarantined mid-flight abstains from the stale tally at
+            consume). Byte volume per step is depth-invariant — one launch
+            and one consume execute every step — so ``comm_drift_bytes``
+            stays 0. 0 = today's synchronous hier wire (bit-identical to
+            the pre-pipeline election). Routed to the XLA path (the Pallas
+            fused-apply kernels assume fresh per-bucket totals).
         mom_dtype: momentum dtype override (default: param dtype, ref :185).
         kernel: 'auto' (fused Pallas kernels on TPU, plain XLA elsewhere),
             'pallas' (force; interpreted off-TPU — tests), or 'xla'.
@@ -233,9 +260,15 @@ def distributed_lion(
         None). Params in/out are replicated; ``state.exp_avg`` is this
         worker's momentum shard (see :func:`init_global_state`).
     """
-    from distributed_lion_tpu.ops.codec import parse_wire
-
-    parse_wire(wire)  # raises on unknown formats; accepts "hier:<g>" too
+    wire_kind, wire_group = parse_wire(wire)  # raises on unknown formats
+    if dcn_pipeline_depth < 0:
+        raise ValueError(
+            f"dcn_pipeline_depth must be >= 0, got {dcn_pipeline_depth}")
+    if dcn_pipeline_depth > 0 and wire_kind != "hier":
+        raise ValueError(
+            f"dcn_pipeline_depth pipelines the hier wire's level-2 (DCN) "
+            f"leg; wire {wire!r} has no such leg — use 'hier:<g>' or depth 0"
+        )
     if axis_name is None:
         # The reference's uninitialized-process-group fallback is plain local
         # Lion (distributed_lion.py:165-166). Refuse to silently drop an
@@ -255,6 +288,12 @@ def distributed_lion(
             raise ValueError(
                 "the vote guard protects the election; with axis_name=None "
                 "there is no election to guard — use lion() for local "
+                "training"
+            )
+        if dcn_pipeline_depth > 0:
+            raise ValueError(
+                "dcn_pipeline_depth pipelines the vote wire; with "
+                "axis_name=None there is no wire — use lion() for local "
                 "training"
             )
         return lion(learning_rate, b1, b2, weight_decay, mom_dtype)
@@ -363,7 +402,8 @@ def distributed_lion(
         if not bounds:  # zero-coordinate pytree: nothing to vote or apply
             out_state = LionState(state.count + 1, state.exp_avg,
                                   state.rng, state.elected,
-                                  state.health, state.prev_ballot)
+                                  state.health, state.prev_ballot,
+                                  state.dcn_ring)
             out = (params, out_state)
             if telemetry:
                 out = out + (_vt.empty_frame(0),)
@@ -414,7 +454,7 @@ def distributed_lion(
         for k in range(len(bounds)):
             ballots = _bucket_ballots(k)
             totals.append(collectives.vote_total(
-                ballots > 0, axis_name, wire, alive))
+                ballots > 0, axis_name, wire, alive, state.count))
             if telemetry:
                 h, d = pallas_lion.bucket_vote_stats(
                     ballots, totals[k], w, _vt.NBINS, interpret=interpret,
@@ -453,12 +493,13 @@ def distributed_lion(
             new_prev = packed_now
         out = (
             jax.tree.unflatten(treedef, new_p),
-            # this path is gated to vote_every == 1, where the elected-sign
-            # cache is None — but the invariant is "state passes through",
-            # not "elected may be dropped": a future un-gating must not
-            # silently lose the cache
+            # this path is gated to vote_every == 1 and dcn_depth == 0,
+            # where the elected-sign cache and the DCN ring are None — but
+            # the invariant is "state passes through", not "they may be
+            # dropped": a future un-gating must not silently lose either
             LionState(state.count + 1, jax.tree.unflatten(treedef, new_m),
-                      state.rng, state.elected, state.health, new_prev),
+                      state.rng, state.elected, state.health, new_prev,
+                      state.dcn_ring),
         )
         if not telemetry:
             return out if gframe is None else out + (gframe,)
@@ -477,14 +518,75 @@ def distributed_lion(
         }
         return out + (frame,) if gframe is None else out + (frame, gframe)
 
+    def _hier_pipelined(vec, count, ring, alive):
+        """Cross-step pipelined hier election (``dcn_pipeline_depth`` > 0):
+        launch this step's level-1 (ICI) + level-2 (DCN) tallies for every
+        bucket of ``vec`` into the ring slot the consume just vacated, and
+        elect from the slot launched ``dcn_pipeline_depth`` steps ago —
+        the complete, uniformly-stale election of step count − d's ballots
+        (replica-identical by construction). Returns ``(elected [n] bool,
+        elect_valid scalar bool, new_ring)``; ``elect_valid`` is False for
+        the first d cold-start steps, when no in-flight tally has landed
+        yet. In the jaxpr the fresh launch slots feed ONLY the ring output,
+        which is what lets the DCN ppermute ring overlap the following
+        steps' compute (XLA async collectives; ``lax.scan`` over fused
+        steps)."""
+        n = vec.shape[0]
+        w = collectives.axis_size(axis_name)
+        bounds = bucket_bounds(n, max(vote_buckets, 1), w, wire)
+        expected = sum(hier_chunk_slot_bytes(size, w, wire_group)
+                       for _, size in bounds)
+        if ring.shape[-1] != expected:
+            raise ValueError(
+                f"dcn_ring slot holds {ring.shape[-1]} bytes but this "
+                f"ballot/bucket layout needs {expected} — the ring was "
+                "built for a different world/wire/bucket config "
+                "(init_global_state and the step must agree)")
+        slot_idx = lax.rem(count, jnp.int32(dcn_pipeline_depth))
+        old_slot = lax.dynamic_slice(
+            ring, (slot_idx, jnp.int32(0)), (1, ring.shape[-1]))[0]
+        seg_off = 0
+        new_segs, elected_parts = [], []
+        for start, size in bounds:
+            seg_len = hier_chunk_slot_bytes(size, w, wire_group)
+            votes_b = lax.slice(vec, (start,), (start + size,))
+            new_seg = collectives.hier_launch(
+                votes_b, axis_name, w, wire_group, alive, count)
+            old_seg = lax.slice(old_slot, (seg_off,), (seg_off + seg_len,))
+            # token=new_seg[:1]: inert on real hardware (the fault is not
+            # armed, no dependency is traced); under the dcn_delay link
+            # emulator it pins the consume gate behind this step's launch
+            # so the emulated flight time spans the real d steps of compute
+            elected_parts.append(collectives.hier_consume(
+                old_seg, size, axis_name, w, wire_group, alive, count,
+                depth=dcn_pipeline_depth, token=new_seg[:1]))
+            new_segs.append(new_seg)
+            seg_off += seg_len
+        new_slot = (new_segs[0] if len(new_segs) == 1
+                    else jnp.concatenate(new_segs))
+        new_ring = lax.dynamic_update_slice(
+            ring, new_slot[None], (slot_idx, jnp.int32(0)))
+        elected = (elected_parts[0] if len(elected_parts) == 1
+                   else jnp.concatenate(elected_parts))
+        return elected, count >= dcn_pipeline_depth, new_ring
+
     def _elect_lazy(flat_votes, state: LionState, alive=None):
         """vote_every > 1: vote the rotating slice, refresh the packed sign
         cache, return (full elected bools, update-validity mask, new cache,
-        telemetry aux, refreshed guard prev-ballot or None). The aux —
-        (slice ballots, slice totals, slice elections, real-coordinate mask
-        over the padded slice) — feeds the vote-health frame; it is dead
-        code XLA prunes when telemetry is off. ``alive`` masks quarantined
-        workers out of the slice election (the guard's enforce mode)."""
+        telemetry aux, refreshed guard prev-ballot or None, new DCN ring or
+        None). The aux — (slice ballots, slice totals, slice elections,
+        real-coordinate mask over the CONSUMED slice, real-coordinate mask
+        over the LAUNCHED slice) — feeds the vote-health and guard frames;
+        it is dead code XLA prunes when both are off. ``alive`` masks
+        quarantined workers out of the slice election (the guard's enforce
+        mode).
+
+        Under the cross-step DCN pipeline (``dcn_pipeline_depth`` d > 0)
+        the slice LAUNCHED this step is slot count mod K as always, but the
+        election CONSUMED — and written into the elected cache — is of the
+        slice launched d steps ago, slot (count − d) mod K: sign staleness
+        compounds to ≤ K + d steps, and slot j's coordinates first receive
+        an update at count == j + d (the combined cold start)."""
         from distributed_lion_tpu.ops.codec import pack_signs, unpack_signs
 
         n = flat_votes.shape[0]
@@ -494,31 +596,58 @@ def distributed_lion(
         ) if vote_every * chunk > n else flat_votes
         slot = lax.rem(state.count, jnp.int32(vote_every))
         sl = lax.dynamic_slice(padded, (slot * chunk,), (chunk,))
-        # the rotating 1/K slice votes bucket-wise too: same elected bits,
-        # but the slice's wire splits into vote_buckets pipelineable chunks
-        totals_sl = collectives.vote_total_bucketed(
-            sl, axis_name, wire, vote_buckets, alive)
-        elected_sl = totals_sl > 0
-        new_cache = lax.dynamic_update_slice(
-            state.elected, pack_signs(elected_sl), (slot * chunk // 8,)
+        new_ring = None
+        if dcn_pipeline_depth > 0:
+            # launch the fresh slice's tallies into the ring; elect the
+            # slice launched d steps ago. The consumed election belongs to
+            # slot (count − d) mod K of the rotation.
+            elected_sl, elect_valid, new_ring = _hier_pipelined(
+                sl, state.count, state.dcn_ring, alive)
+            totals_sl = jnp.where(elected_sl, 1, -1)
+            write_slot = lax.rem(state.count - dcn_pipeline_depth,
+                                 jnp.int32(vote_every))
+        else:
+            # the rotating 1/K slice votes bucket-wise too: same elected
+            # bits, but the slice's wire splits into pipelineable chunks
+            totals_sl = collectives.vote_total_bucketed(
+                sl, axis_name, wire, vote_buckets, alive, state.count)
+            elected_sl = totals_sl > 0
+            elect_valid = jnp.asarray(True)
+            write_slot = slot
+        cache_upd = lax.dynamic_update_slice(
+            state.elected, pack_signs(elected_sl), (write_slot * chunk // 8,)
         )
+        # during the pipeline's cold start no election landed: the cache
+        # must not adopt the zero-slot garbage (write_slot also clamps
+        # negative there — the where() discards that write entirely)
+        new_cache = (cache_upd if dcn_pipeline_depth == 0
+                     else jnp.where(elect_valid, cache_upd, state.elected))
         new_prev = None
         if guard_on:
             # the guard's prev-ballot cache mirrors the elected cache's
-            # slot layout, so XOR-ing old vs new isolates this slot's
-            # flips (only its bytes change) against the SAME slot's ballot
-            # one full rotation (K steps) ago
+            # slot layout and tracks the LAUNCHED slice (the local ballot
+            # cast this step), so XOR-ing old vs new isolates this slot's
+            # flips against the SAME slot's ballot one rotation (K steps)
+            # ago — launch-side at any pipeline depth
             new_prev = lax.dynamic_update_slice(
                 state.prev_ballot, pack_signs(sl), (slot * chunk // 8,))
         bits = unpack_signs(new_cache, (vote_every * chunk,))
-        # cold start: slot j is first voted at count == j, so until then its
-        # coordinates get no update (replicas agree — count is shared)
+        # cold start: slot j's election first LANDS at count == j + d, so
+        # until then its coordinates get no update (replicas agree — count
+        # is shared)
         slot_idx = jnp.arange(vote_every * chunk, dtype=jnp.int32) // chunk
-        valid = slot_idx <= state.count
-        # only the LAST slot can run past n: alignment pads the slice there
-        mask_sl = (slot * chunk + jnp.arange(chunk, dtype=jnp.int32)) < n
+        valid = slot_idx <= state.count - dcn_pipeline_depth
+        # only the LAST slot can run past n: alignment pads the slice there.
+        # The consume mask covers the slice the ELECTION belongs to (and is
+        # all-False while no election has landed); the launch mask covers
+        # the slice the local ballots were cast for.
+        ar = jnp.arange(chunk, dtype=jnp.int32)
+        mask_launch = (slot * chunk + ar) < n
+        mask_consume = (((write_slot * chunk + ar) < n) & elect_valid
+                        if dcn_pipeline_depth > 0 else mask_launch)
         return bits[:n], valid[:n], new_cache, (sl, totals_sl, elected_sl,
-                                                mask_sl), new_prev
+                                                mask_consume, mask_launch), \
+            new_prev, new_ring
 
     def _make_frame(local, totals, elected, *, mask, voted, valid,
                     elected_packed, flip_valid):
@@ -564,7 +693,8 @@ def distributed_lion(
             grads = jax.tree.map(
                 lambda g: jnp.where(jnp.isfinite(g), g, jnp.zeros_like(g)),
                 grads)
-        if interpret is not None and not stochastic and vote_every == 1:
+        if (interpret is not None and not stochastic and vote_every == 1
+                and dcn_pipeline_depth == 0):
             p_dtypes = {p.dtype for p in jax.tree.leaves(params)}
             m_dtypes = {m.dtype for m in jax.tree.leaves(state.exp_avg)}
             if len(p_dtypes) == 1 and len(m_dtypes) == 1:
@@ -601,11 +731,55 @@ def distributed_lion(
         flat = _flatten_votes(votes)
         new_cache = state.elected
         new_prev = state.prev_ballot
+        new_ring = state.dcn_ring
         frame = None
         gframe = None
-        if vote_every == 1:
+        if vote_every == 1 and dcn_pipeline_depth > 0:
+            # cross-step pipelined hier wire: launch this step's tallies
+            # into the ring, apply the election of step count − d's ballots
+            # (uniformly stale → replicas agree); the first d steps apply
+            # no sign update (decay still runs — the lazy-slot rule)
+            elected, elect_valid, new_ring = _hier_pipelined(
+                flat, state.count, state.dcn_ring, alive)
+            totals = jnp.where(elected, 1, -1)  # ±1 proxy (hier never
+            # moves the tally magnitude — the telemetry histogram is
+            # zeroed for proxy wires regardless)
+            signs = jnp.where(elected, 1.0, -1.0) * elect_valid
+            signs_tree = _split_votes(signs, votes)
+            new_params = jax.tree.map(
+                lambda p, s: p - jnp.asarray(lr, p.dtype) * s.astype(p.dtype),
+                decayed, signs_tree,
+            )
+            n_flat = flat.shape[0]
+            if telemetry:
+                frame = _make_frame(
+                    flat, totals, elected,
+                    mask=jnp.broadcast_to(elect_valid, flat.shape),
+                    voted=jnp.where(elect_valid, n_flat, 0),
+                    valid=jnp.where(elect_valid, n_flat, 0)
+                    .astype(jnp.int32),
+                    elected_packed=None,
+                    # the first landed election (count == d) has only the
+                    # zero-init accumulator to XOR against
+                    flip_valid=state.count >= dcn_pipeline_depth + 1)
+            if guard_on:
+                packed_now = pack_signs(flat)
+                gframe = _guard_frame(
+                    w_guard, guard_nf,
+                    _ballot_flips(packed_now, state.prev_ballot),
+                    state.count >= 1,
+                    # local FRESH ballot vs the d-step-stale consensus —
+                    # staleness inflates every worker equally, so the
+                    # guard's RELATIVE outlier rule still separates a sick
+                    # voter; zero while no election has landed
+                    jnp.where(elect_valid,
+                              jnp.mean((flat != elected)
+                                       .astype(jnp.float32)), 0.0),
+                    n_flat)
+                new_prev = packed_now
+        elif vote_every == 1:
             totals = collectives.vote_total_bucketed(
-                flat, axis_name, wire, vote_buckets, alive)
+                flat, axis_name, wire, vote_buckets, alive, state.count)
             elected = totals > 0
             elected_tree = _split_votes(elected, votes)
             # 4) apply the elected ±1 update (ref :91-92). The psum output is
@@ -630,39 +804,52 @@ def distributed_lion(
                     flat.shape[0])
                 new_prev = packed_now
         else:
-            elected, valid, new_cache, aux, lazy_prev = _elect_lazy(
-                flat, state, alive)
+            elected, valid, new_cache, aux, lazy_prev, lazy_ring = \
+                _elect_lazy(flat, state, alive)
+            if lazy_ring is not None:
+                new_ring = lazy_ring
             signs = jnp.where(elected, 1.0, -1.0) * valid
             signs_tree = _split_votes(signs, votes)
             new_params = jax.tree.map(
                 lambda p, s: p - jnp.asarray(lr, p.dtype) * s.astype(p.dtype),
                 decayed, signs_tree,
             )
-            sl, totals_sl, elected_sl, mask_sl = aux
+            sl, totals_sl, elected_sl, mask_sl, mask_launch = aux
+            # under the DCN pipeline the launched slice (local ballots sl)
+            # and the consumed election (elected_sl) cover DIFFERENT
+            # coordinate slots — a local-vs-elected comparison would be
+            # cross-coordinate noise, so disagreement reports 0 there
+            # (documented in ARCHITECTURE 'DCN overlap')
+            dis_defined = dcn_pipeline_depth == 0
             if telemetry:
                 frame = _make_frame(
-                    sl, totals_sl, elected_sl, mask=mask_sl,
+                    sl, totals_sl, elected_sl,
+                    mask=(mask_sl if dis_defined
+                          else jnp.zeros_like(mask_sl)),
                     voted=jnp.sum(mask_sl.astype(jnp.int32)),
                     valid=jnp.sum(valid.astype(jnp.int32)),
                     elected_packed=new_cache,
-                    # the refreshed slot last voted at count − K: before a
-                    # full rotation its cache bytes are the zero init, not
-                    # a previous election
-                    flip_valid=state.count >= vote_every)
+                    # the refreshed slot last voted one rotation (K steps,
+                    # + the pipeline's d) ago: before that its cache bytes
+                    # are the zero init, not a previous election
+                    flip_valid=state.count >= vote_every
+                    + dcn_pipeline_depth)
             if guard_on:
-                voted_sl = jnp.sum(mask_sl.astype(jnp.int32))
-                dis_sl = jnp.sum(((sl != elected_sl) & mask_sl)
-                                 .astype(jnp.int32))
+                voted_launch = jnp.sum(mask_launch.astype(jnp.int32))
+                dis_sl = (jnp.sum(((sl != elected_sl) & mask_sl)
+                                  .astype(jnp.int32)) if dis_defined
+                          else jnp.zeros((), jnp.int32))
                 gframe = _guard_frame(
                     w_guard, guard_nf,
                     _ballot_flips(lazy_prev, state.prev_ballot),
                     # the refreshed slot's previous ballot is real only
                     # after a full rotation (same cold start as the flip
-                    # telemetry)
+                    # telemetry; prev_ballot tracks LAUNCHES, so the
+                    # pipeline depth does not enter)
                     state.count >= vote_every,
                     dis_sl.astype(jnp.float32)
-                    / jnp.maximum(voted_sl, 1).astype(jnp.float32),
-                    voted_sl)
+                    / jnp.maximum(voted_launch, 1).astype(jnp.float32),
+                    voted_launch)
                 new_prev = lazy_prev
         if telemetry and stochastic:
             # quantizer noise: how often the stochastic ballot differs from
@@ -680,7 +867,7 @@ def distributed_lion(
             lambda g, m: lion_math.momentum_update(g, m, b2), grads, state.exp_avg
         )
         out_state = LionState(state.count + 1, new_m, state.rng, new_cache,
-                              state.health, new_prev)
+                              state.health, new_prev, new_ring)
         out = (new_params, out_state)
         if telemetry:
             out = out + (frame,)
@@ -688,7 +875,13 @@ def distributed_lion(
             out = out + (gframe,)
         return out
 
-    return FunctionalOptimizer(init=init, step=step)
+    # meta: the comm config init_global_state needs to shape world-sized
+    # state (the DCN pipeline ring) that init cannot know the width of
+    return FunctionalOptimizer(init=init, step=step, meta={
+        "wire": wire, "vote_every": vote_every,
+        "vote_buckets": max(vote_buckets, 1),
+        "dcn_pipeline_depth": dcn_pipeline_depth,
+    })
 
 
 # ---------------------------------------------------------------------------
@@ -717,18 +910,34 @@ def init_global_state(opt: FunctionalOptimizer, params, world: int,
                                   st_shapes.prev_ballot.dtype))
     health = (None if st_shapes.prev_ballot is None
               else jnp.ones((world,), jnp.bool_))
+    # DCN pipeline ring (dcn_pipeline_depth > 0, hier wire): one slot per
+    # in-flight step of per-worker packed level-2 tallies. Like health, it
+    # is created HERE — its slot width needs the world size (W/g groups),
+    # which worker-level init cannot know. The comm config rides opt.meta.
+    meta = opt.meta or {}
+    depth = int(meta.get("dcn_pipeline_depth", 0) or 0)
+    dcn_ring = None
+    if depth > 0:
+        _, group = parse_wire(meta["wire"])
+        n = sum(p.size for p in jax.tree.leaves(params))
+        slot = hier_ring_slot_bytes(n, world, group,
+                                    meta.get("vote_buckets", 1) or 1,
+                                    meta.get("vote_every", 1) or 1)
+        dcn_ring = jnp.zeros((world, depth, slot), jnp.uint8)
     return LionState(count=jnp.zeros((), jnp.int32), exp_avg=exp_avg, rng=rng,
-                     elected=elected, health=health, prev_ballot=prev_ballot)
+                     elected=elected, health=health, prev_ballot=prev_ballot,
+                     dcn_ring=dcn_ring)
 
 
 def squeeze_worker_state(state: LionState) -> LionState:
     """Inside shard_map: drop this worker's leading [1] momentum (and guard
-    prev-ballot) axis; the elected-sign cache and health mask are replicated
-    and pass through."""
+    prev-ballot / DCN-ring) axis; the elected-sign cache and health mask are
+    replicated and pass through."""
     return LionState(state.count, jax.tree.map(lambda m: m[0], state.exp_avg),
                      state.rng, state.elected, state.health,
                      None if state.prev_ballot is None
-                     else state.prev_ballot[0])
+                     else state.prev_ballot[0],
+                     None if state.dcn_ring is None else state.dcn_ring[0])
 
 
 def expand_worker_state(state: LionState) -> LionState:
@@ -736,7 +945,9 @@ def expand_worker_state(state: LionState) -> LionState:
     return LionState(state.count, jax.tree.map(lambda m: m[None], state.exp_avg),
                      state.rng, state.elected, state.health,
                      None if state.prev_ballot is None
-                     else state.prev_ballot[None])
+                     else state.prev_ballot[None],
+                     None if state.dcn_ring is None
+                     else state.dcn_ring[None])
 
 
 def remap_worker_momentum(exp_avg, old_world: int, new_world: int):

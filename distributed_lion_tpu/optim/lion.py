@@ -47,6 +47,17 @@ class LionState(NamedTuple):
     # globally stacked [world, bytes], sharded over the data axis) — the
     # frozen-ballot detector's XOR base. Shaped like the elected cache under
     # vote_every > 1 (per-slot byte-aligned layout), packed_size(n) otherwise.
+    dcn_ring: Optional[jnp.ndarray] = None  # uint8 [depth, slot_bytes] ring
+    # of in-flight level-2 (DCN) hier tallies; present only under
+    # --dcn_pipeline_depth > 0 on the hier wire. Slot (count mod depth)
+    # holds the packed per-group verdict stack hier_launch produced at step
+    # count − depth (codec.hier_ring_slot_bytes layout), consumed by
+    # hier_consume this step before being overwritten with this step's
+    # launch. Per-worker divergent (each member owns a different 1/g chunk
+    # of coordinates), so stored globally stacked [world, depth, bytes] and
+    # sharded over the data axis like exp_avg/prev_ballot. Created by
+    # init_global_state (slot width needs the world size); serializes with
+    # the checkpoint so crash-resume stays bit-identical mid-flight.
 
 
 def _validate(lr_init: float, b1: float, b2: float) -> None:
@@ -69,10 +80,17 @@ class FunctionalOptimizer(NamedTuple):
     ``step`` returns new params directly (rather than optax-style additive
     updates) so the multiplicative weight-decay ordering of the reference is
     preserved bit-for-bit in low precision.
+
+    ``meta`` (optional) carries the build-time comm config world-level
+    helpers need but ``init`` cannot know — ``init_global_state`` shapes the
+    DCN pipeline ring from ``meta['wire'] / ['vote_every'] /
+    ['vote_buckets'] / ['dcn_pipeline_depth']`` once the world size is in
+    hand (same reason the guard's ``health`` mask is created there).
     """
 
     init: Callable[..., LionState]
     step: Callable[..., tuple]
+    meta: Optional[dict] = None
 
 
 def lion(

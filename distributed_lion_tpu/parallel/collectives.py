@@ -42,6 +42,9 @@ smaller-value behavior on even worlds (SURVEY §2.3 step 6).
 
 from __future__ import annotations
 
+import threading
+import time
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -53,6 +56,7 @@ from distributed_lion_tpu.ops.codec import (
     parse_wire,
     unpack_signs,
 )
+from distributed_lion_tpu.train import resilience
 
 
 class WireTally:
@@ -105,6 +109,129 @@ class WireTally:
 WIRE_TALLY = WireTally()
 
 
+class DcnWaitTally:
+    """Measured residual waits of the emulated DCN link (the ``dcn_delay``
+    fault, train/resilience registry): per step key, the MAX wait any
+    device/bucket paid at the consume gate — devices run concurrently, so
+    the max is the step's critical-path exposure to the link's latency.
+    Sub-delay values mean the cross-step pipeline (``--dcn_pipeline_depth``)
+    hid part of the round trip behind compute; the trainer drains this at
+    log cadence into the ``dcn_wait_s`` metric and bench_dcn derives its
+    measured overlap fraction from it. Host-side only — the traced step
+    never reads it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._waits: dict = {}
+
+    def add(self, key, wait_s: float) -> None:
+        with self._lock:
+            self._waits[key] = max(self._waits.get(key, 0.0), float(wait_s))
+
+    def pop(self) -> dict:
+        """{step key: max wait seconds} accumulated since the last pop."""
+        with self._lock:
+            out, self._waits = self._waits, {}
+            return out
+
+
+DCN_WAIT = DcnWaitTally()
+
+# launch wall-clock stamps of the emulated DCN link, keyed by the optimizer
+# step count the launching program carried (first device to stamp a step
+# wins; pruned as consumes pass)
+_DCN_STAMPS: dict = {}
+_DCN_STAMPS_LOCK = threading.Lock()
+
+
+def dcn_link_reset() -> None:
+    """Reset the emulated DCN link between runs: stamps are keyed by the
+    optimizer step count, so a fresh run re-using counts 0..N would
+    otherwise find a previous run's long-expired stamps and pay no latency
+    at all. Benches and tests call this before every measured leg."""
+    with _DCN_STAMPS_LOCK:
+        _DCN_STAMPS.clear()
+    DCN_WAIT.pop()
+
+
+def _dcn_host_launch(slot, count, delay_s):
+    """Host half of the launch gate: stamp 'the transfer for step `count`
+    started now'. Identity on the data."""
+    key = int(count)
+    with _DCN_STAMPS_LOCK:
+        _DCN_STAMPS.setdefault(key, time.monotonic())
+        for k in [k for k in _DCN_STAMPS if k < key - 64]:
+            del _DCN_STAMPS[k]
+    return slot
+
+def _dcn_host_consume(slot, count, delay_s, depth):
+    """Host half of the consume gate: block until the transfer launched at
+    step ``count − depth`` has been on the (emulated) link for ``delay_s``
+    seconds. The wall clock already spent by the intervening steps counts
+    toward the deadline — that is exactly what cross-step pipelining buys —
+    so the residual wait recorded into DCN_WAIT measures the UNHIDDEN part
+    of the round trip. Identity on the data."""
+    key = int(count) - depth
+    if key >= 0:
+        with _DCN_STAMPS_LOCK:
+            t0 = _DCN_STAMPS.get(key)
+        if t0 is not None:
+            rem = t0 + delay_s - time.monotonic()
+            if rem > 0:
+                time.sleep(rem)
+            DCN_WAIT.add(key, max(rem, 0.0))
+    return slot
+
+
+def _dcn_gate_launch(slot: jnp.ndarray, count):
+    """Trace-time hook of the ``dcn_delay`` fault on the level-2 launch: a
+    no-op unless the fault is armed AT TRACE TIME (the unarmed step's jaxpr
+    carries zero host callbacks — the trace_check contract). With no step
+    count threaded (direct majority_vote_* callers) the link degrades to a
+    synchronous sleep at the consume gate."""
+    delay = resilience.fault("dcn_delay")
+    if not delay or count is None:
+        return slot
+    from functools import partial as _partial
+
+    # fault-injection-only path: the callback exists to EMULATE a slow DCN
+    # link on CPU and is never traced in production steps
+    return jax.pure_callback(  # graft: disable=DLT003
+        _partial(_dcn_host_launch, delay_s=float(delay)),
+        jax.ShapeDtypeStruct(slot.shape, slot.dtype), slot, count)
+
+
+def _dcn_gate_consume(slot: jnp.ndarray, count, depth: int, token=None):
+    """Trace-time hook of the ``dcn_delay`` fault on the level-2 consume.
+    ``token`` (any small array computed from THIS step's launch) pins the
+    gate behind the launch in XLA's serial CPU schedule, so the emulated
+    gap between stamp and consume is the real ``depth`` steps of compute —
+    without it XLA:CPU may hoist the wait to the start of the program and
+    fake a synchronous link. No-op (and dependency-free) unless the fault
+    is armed at trace time."""
+    delay = resilience.fault("dcn_delay")
+    if not delay:
+        return slot
+    from functools import partial as _partial
+
+    if count is None:
+        # no step key: synchronous-link fallback — sleep the full delay
+        def _sync(slot_h):
+            time.sleep(float(delay))
+            DCN_WAIT.add(None, float(delay))
+            return slot_h
+
+        return jax.pure_callback(  # graft: disable=DLT003
+            _sync, jax.ShapeDtypeStruct(slot.shape, slot.dtype), slot)
+    args = (slot, count) if token is None else (slot, count, token)
+
+    def _consume(slot_h, count_h, *_tok):
+        return _dcn_host_consume(slot_h, count_h, float(delay), int(depth))
+
+    return jax.pure_callback(  # graft: disable=DLT003
+        _consume, jax.ShapeDtypeStruct(slot.shape, slot.dtype), *args)
+
+
 def axis_size(axis_name: str) -> int:
     """Static size of a bound mesh axis (the reference's world_size,
     distributed_lion.py:80)."""
@@ -112,7 +239,7 @@ def axis_size(axis_name: str) -> int:
 
 
 def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str,
-               alive=None) -> jnp.ndarray:
+               alive=None, count=None) -> jnp.ndarray:
     """The vote reduction over workers. Every wire satisfies the contract
     callers rely on — ``total > 0`` ⇔ majority True, ``total ≤ 0`` ⇔ elect −1
     (ties → −1, the torch.mode smaller-value rule) — but only ``sign_psum``
@@ -132,6 +259,10 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str,
     ties electing −1. With ``alive`` all-True the masked election is
     bit-identical to ``alive=None`` for every wire (pinned by
     tests/test_vote_guard.py) — the guard's all-healthy contract.
+
+    ``count`` (optional replicated int32 scalar — the optimizer step count)
+    is consumed ONLY by the ``dcn_delay`` fault's link emulator on the hier
+    wire; it never enters the election math.
     """
     w = axis_size(axis_name)
     kind, group = parse_wire(wire)  # raises on unknown formats
@@ -175,12 +306,13 @@ def vote_total(vote_pos: jnp.ndarray, axis_name: str, wire: str,
                          1, -1)
     # kind == "hier": per-worker tallies never leave the ICI subgroup, so
     # (like packed_a2a) only a ±1 proxy of the elected sign is available.
-    return jnp.where(_hier_elect(vote_pos, axis_name, w, group, alive), 1, -1)
+    return jnp.where(_hier_elect(vote_pos, axis_name, w, group, alive,
+                                 count), 1, -1)
 
 
 def vote_total_buckets(
     vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int,
-    alive=None,
+    alive=None, count=None,
 ) -> list[jnp.ndarray]:
     """The bucketed wire: one *independent* collective per contiguous ballot
     chunk (codec.bucket_bounds — the same boundaries the byte accounting
@@ -195,22 +327,22 @@ def vote_total_buckets(
     bounds = bucket_bounds(vote_pos.shape[0], vote_buckets, w, wire)
     return [
         vote_total(lax.slice(vote_pos, (start,), (start + size,)),
-                   axis_name, wire, alive)
+                   axis_name, wire, alive, count)
         for start, size in bounds
     ]
 
 
 def vote_total_bucketed(
     vote_pos: jnp.ndarray, axis_name: str, wire: str, vote_buckets: int,
-    alive=None,
+    alive=None, count=None,
 ) -> jnp.ndarray:
     """Concatenated bucketed vote — same contract (and bit pattern) as
     :func:`vote_total`, but issued as ``vote_buckets`` independent
     collectives XLA's async scheduler can overlap with unrelated compute."""
     if vote_buckets <= 1:
-        return vote_total(vote_pos, axis_name, wire, alive)
+        return vote_total(vote_pos, axis_name, wire, alive, count)
     totals = vote_total_buckets(vote_pos, axis_name, wire, vote_buckets,
-                                alive)
+                                alive, count)
     return totals[0] if len(totals) == 1 else jnp.concatenate(totals)
 
 
@@ -254,9 +386,170 @@ def _packed_a2a_elect(vote_pos: jnp.ndarray, axis_name: str, w: int,
     return unpack_signs(gathered.reshape(-1), (n,))
 
 
+def _intra_perm(w: int, g: int) -> list:
+    """The intra-group ring permutation (member i → member i+1 mod g)."""
+    return [(s, (s // g) * g + ((s % g) + 1) % g) for s in range(w)]
+
+
+def hier_launch(vote_pos: jnp.ndarray, axis_name: str, w: int,
+                group_size: int, alive=None, count=None) -> jnp.ndarray:
+    """Phases 1+2 of the hier election — everything UP TO the point where
+    the level-2 (DCN) traffic has arrived: intra-group ballot
+    reduce-scatter (ICI), then the cross-group ring of the owners' packed
+    level-1 verdict chunks, gathered per source group instead of folded
+    into a count so the consume half can re-judge group health later.
+
+    Returns the flat uint8 *slot segment* for this ballot chunk
+    (codec.hier_chunk_slot_bytes): a ``[n_groups]`` launch-time group-alive
+    byte mask followed by the ``[n_groups, chunk/8]`` packed verdict stack
+    for this worker's OWNED 1/g chunk of coordinates. Per-worker divergent
+    (each member owns a different chunk id) — under cross-step pipelining
+    (``--dcn_pipeline_depth``) the slot rides ``LionState.dcn_ring`` for
+    ``d`` steps before :func:`hier_consume` turns it into elected bits; the
+    synchronous wire (depth 0) consumes it immediately. In the jaxpr the
+    slot's only consumer at depth ≥ 1 is the state output, which is what
+    lets XLA's async collective scheduling (and ``lax.scan`` over fused
+    steps) overlap the DCN ring with the following steps' compute.
+
+    ``count`` is the optimizer step count, used ONLY by the ``dcn_delay``
+    fault's link emulator (train/resilience registry) to stamp the
+    transfer's launch wall time.
+    """
+    if w % group_size:
+        raise ValueError(
+            f"hier wire: group size {group_size} does not divide world {w}"
+        )
+    g = group_size
+    n_groups = w // g
+    n = vote_pos.shape[0]
+    acc = jnp.int8 if g <= 127 else jnp.int32
+    chunk = 8 * a2a_chunk_bytes(n, g)  # byte-aligned coords per member
+    pad = g * chunk - n
+    flat = (jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)])
+            if pad else vote_pos)
+    buf = jnp.where(flat, 1, -1).astype(acc).reshape(g, chunk)
+    group_alive = None
+    if alive is not None:
+        # level 1: my ballots abstain from the reduce-scatter when I am
+        # quarantined (I still relay partial sums — the ring needs me)
+        own_alive = alive[lax.axis_index(axis_name)]
+        buf = jnp.where(own_alive, buf, jnp.zeros_like(buf))
+        group_alive = alive.reshape(w // g, g).any(axis=1)
+    idx = lax.axis_index(axis_name) % g  # my position within the group
+    intra_perm = _intra_perm(w, g)
+
+    # phase 1 — reduce-scatter (lax.scan ring, one traced hop): at hop t I
+    # pass on the partial sum of chunk (idx − t) mod g and fold my ballots
+    # into the arriving partial, ending with the full tally of owned chunk
+    # (idx + 1) mod g.
+    def _rs_hop(msg, t):
+        msg = lax.ppermute(msg, axis_name, intra_perm)
+        recv = (idx - t - 1) % g
+        return msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0], None
+
+    msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
+    if g > 1 and w > 1:  # leg 1: (g−1) ballot-chunk hops at the acc width
+        WIRE_TALLY.record("ici", (g - 1) * chunk * jnp.dtype(acc).itemsize)
+    if g > 1:
+        msg, _ = lax.scan(_rs_hop, msg, jnp.arange(g - 1))
+    verdict_own = msg > 0  # subgroup tie → −1, for my owned coords
+
+    # phase 2 — cross-group ring of packed verdicts, GATHERED per source
+    # group: member i of every group owns the SAME chunk id, so a ring over
+    # same-position peers delivers every group's verdict for my coords. The
+    # hop-t packet originated at group (my_group − t − 1) mod G; storing
+    # arrivals by source (instead of folding them into a count here) moves
+    # the health gating and the majority threshold to hier_consume, where
+    # the CURRENT alive mask is known — that is what keeps a group
+    # quarantined mid-flight from poisoning a stale tally.
+    cross_perm = [
+        (s, ((s // g + 1) % n_groups) * g + s % g) for s in range(w)
+    ]
+    my_group = lax.axis_index(axis_name) // g
+    packed_own = pack_signs(verdict_own)  # [chunk/8] uint8
+    stack = jnp.zeros((n_groups, chunk // 8), jnp.uint8)
+    stack = lax.dynamic_update_slice(stack, packed_own[None], (my_group, 0))
+
+    def _cross_hop(carry, t):
+        stack, rot = carry
+        rot = lax.ppermute(rot, axis_name, cross_perm)
+        src = (my_group - t - 1) % n_groups
+        stack = lax.dynamic_update_slice(stack, rot[None], (src, 0))
+        return (stack, rot), None
+
+    if n_groups > 1 and w > 1:  # leg 2: the ONLY cross-group (DCN) traffic
+        WIRE_TALLY.record("dcn", (n_groups - 1) * (chunk // 8))
+    if n_groups > 1:
+        (stack, _), _ = lax.scan(_cross_hop, (stack, packed_own),
+                                 jnp.arange(n_groups - 1))
+    mask_row = (group_alive.astype(jnp.uint8) if group_alive is not None
+                else jnp.ones((n_groups,), jnp.uint8))
+    slot = jnp.concatenate([mask_row, stack.reshape(-1)])
+    return _dcn_gate_launch(slot, count)
+
+
+def hier_consume(slot: jnp.ndarray, n: int, axis_name: str, w: int,
+                 group_size: int, alive=None, count=None, depth: int = 0,
+                 token=None) -> jnp.ndarray:
+    """Phase 3 of the hier election, fed by a (possibly ``depth`` steps
+    stale) :func:`hier_launch` slot: gate each source group's verdict chunk
+    by its health at BOTH ends of the flight (the slot's launch-time mask
+    AND the current ``alive`` — a group fully quarantined mid-flight
+    abstains from the stale tally), take the majority over the surviving
+    quorum (ties → −1, both levels), then reassemble the full elected
+    vector with the intra-group (ICI) ring all-gather of the packed elected
+    chunks. Elections are replicated: every worker combines the same
+    per-group verdicts under the same masks.
+
+    A worker quarantined mid-flight inside a still-healthy group keeps its
+    launch-time level-1 contribution — the per-worker ballots were folded
+    into the group verdict before the guard could know, and only group-
+    granular abstention is possible at level 2 (documented staleness
+    semantics, ARCHITECTURE 'DCN overlap').
+
+    ``count``/``depth``/``token`` feed the ``dcn_delay`` link emulator only
+    (see :func:`_dcn_gate_consume`).
+    """
+    g = group_size
+    n_groups = w // g
+    chunk = 8 * a2a_chunk_bytes(n, g)
+    slot = _dcn_gate_consume(slot, count, depth, token)
+    launch_mask = slot[:n_groups] > 0
+    stack = slot[n_groups:].reshape(n_groups, chunk // 8)
+    effective = launch_mask
+    if alive is not None:
+        effective = launch_mask & alive.reshape(n_groups, g).any(axis=1)
+    bits = unpack_signs(stack.reshape(-1), (n_groups, chunk))
+    contrib = bits.astype(jnp.int32) * effective.astype(jnp.int32)[:, None]
+    counts = contrib.sum(0)  # [chunk] per-coordinate +1-verdict tally
+    elected_own = counts * 2 > effective.astype(jnp.int32).sum()
+
+    # phase 3 — intra-group all-gather of the packed elected chunks.
+    idx = lax.axis_index(axis_name) % g
+    own = (idx + 1) % g
+    intra_perm = _intra_perm(w, g)
+
+    def _ag_hop(carry, t):
+        out, rot = carry
+        rot = lax.ppermute(rot, axis_name, intra_perm)
+        # the hop-t packet originated at the member t+1 behind me, which
+        # owns chunk (idx − t − 1 + 1) mod g
+        out = lax.dynamic_update_slice(out, rot[None], ((idx - t) % g, 0))
+        return (out, rot), None
+
+    packed_own = pack_signs(elected_own)  # [chunk/8] uint8
+    out = jnp.zeros((g, chunk // 8), jnp.uint8)
+    out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
+    if g > 1 and w > 1:  # leg 3: (g−1) packed elected-chunk hops
+        WIRE_TALLY.record("ici", (g - 1) * (chunk // 8))
+    if g > 1:
+        (out, _), _ = lax.scan(_ag_hop, (out, packed_own), jnp.arange(g - 1))
+    return unpack_signs(out.reshape(-1), (g * chunk,))[:n]
+
+
 def _hier_elect(
     vote_pos: jnp.ndarray, axis_name: str, w: int, group_size: int,
-    alive=None,
+    alive=None, count=None,
 ) -> jnp.ndarray:
     """Hierarchical majority-of-majorities vote over a two-level fabric.
 
@@ -285,122 +578,32 @@ def _hier_elect(
     number of groups that still hold a healthy member). A quarantined worker
     still computes/forwards ring traffic — elections stay replicated; only
     its ballot's weight is gone.
+
+    All three legs run as ppermute rings under ``lax.scan`` (subgrouped
+    psum/all_gather via axis_index_groups is not supported under
+    shard_map), chunked so no leg ever moves the full ballot vector more
+    than once:
+
+    1. intra-group reduce-scatter — (g−1)·n/g ballot bytes, ICI;
+    2. cross-group ring of the owners' bit-packed verdict chunks — the only
+       traffic that crosses the group boundary ((W/g − 1)·n/(8g) bytes DCN);
+    3. intra-group ring all-gather of the packed ELECTED chunks
+       ((g−1)·n/(8g) ≈ n/8 bytes, ICI).
+
+    Byte accounting in ops/codec.wire_bytes_per_param mirrors exactly this.
+
+    Since the cross-step DCN pipeline (``--dcn_pipeline_depth``,
+    optim.distributed_lion) the implementation is the launch/consume split:
+    phases 1+2 live in :func:`hier_launch` (producing the per-group packed
+    verdict slot), the masked threshold + phase 3 in :func:`hier_consume`.
+    This synchronous composition — consume the slot in the same step it was
+    launched — is the depth-0 wire, bit-identical to the pre-split election
+    (integer tallies summed in a different order; pinned by
+    tests/test_dcn_overlap.py against an independent reference).
     """
-    if w % group_size:
-        raise ValueError(
-            f"hier wire: group size {group_size} does not divide world {w}"
-        )
-    g = group_size
-    n_groups = w // g
-    n = vote_pos.shape[0]
-    # All three legs run as ppermute rings (subgrouped psum/all_gather via
-    # axis_index_groups is not supported under shard_map), chunked so no leg
-    # ever moves the full ballot vector more than once:
-    #   1. intra-group reduce-scatter — after g−1 hops member i holds the
-    #      exact group tally for its OWNED 1/g chunk of coordinates
-    #      (received: (g−1)·n/g ballot bytes, ICI);
-    #   2. cross-group ring of the owners' bit-packed verdict chunks — the
-    #      only traffic that crosses the group boundary (DCN leg:
-    #      (W/g − 1)·n/(8g) bytes — the flat vote's DCN volume ÷ g);
-    #   3. intra-group ring all-gather of the packed ELECTED chunks to
-    #      reassemble the full vector (received: (g−1)·n/(8g) ≈ n/8 bytes).
-    # Byte accounting in ops/codec.wire_bytes_per_param mirrors exactly this.
-    acc = jnp.int8 if g <= 127 else jnp.int32
-    chunk = 8 * a2a_chunk_bytes(n, g)  # byte-aligned coords per member —
-    # the same pad-to-equal-byte-chunks rule as the a2a wire, shared with
-    # codec.wire_bytes_per_param's hier branch so accounting can't drift
-    pad = g * chunk - n
-    flat = (jnp.concatenate([vote_pos, jnp.zeros((pad,), vote_pos.dtype)])
-            if pad else vote_pos)
-    buf = jnp.where(flat, 1, -1).astype(acc).reshape(g, chunk)
-    group_alive = None
-    if alive is not None:
-        # level 1: my ballots abstain from the reduce-scatter when I am
-        # quarantined (I still relay partial sums — the ring needs me)
-        own_alive = alive[lax.axis_index(axis_name)]
-        buf = jnp.where(own_alive, buf, jnp.zeros_like(buf))
-        # level 2: groups are consecutive g-worker spans of the data axis,
-        # so the per-group health is a reshape-any over the mask
-        group_alive = alive.reshape(w // g, g).any(axis=1)
-    idx = lax.axis_index(axis_name) % g  # my position within the group
-    intra_perm = [(s, (s // g) * g + ((s % g) + 1) % g) for s in range(w)]
-
-    # All three rings run under lax.scan — one traced hop re-executed g−1
-    # (or W/g−1) times — so trace/compile size is O(1) in the ring length
-    # and pod-scale groups (g=16+, dozens of groups) compile flat instead of
-    # unrolling hundreds of ppermute ops (the hops themselves are inherently
-    # serialized either way; scan adds no extra latency on the wire).
-
-    # phase 1 — reduce-scatter: at hop t I pass on the partial sum of chunk
-    # (idx − t) mod g and fold my ballots into the arriving partial, ending
-    # with the full tally of owned chunk (idx + 1) mod g.
-    own = (idx + 1) % g
-
-    def _rs_hop(msg, t):
-        msg = lax.ppermute(msg, axis_name, intra_perm)
-        recv = (idx - t - 1) % g
-        return msg + lax.dynamic_slice(buf, (recv, 0), (1, chunk))[0], None
-
-    msg = lax.dynamic_slice(buf, (idx % g, 0), (1, chunk))[0]
-    if g > 1 and w > 1:  # leg 1: (g−1) ballot-chunk hops at the acc width
-        WIRE_TALLY.record("ici", (g - 1) * chunk * jnp.dtype(acc).itemsize)
-    if g > 1:
-        msg, _ = lax.scan(_rs_hop, msg, jnp.arange(g - 1))
-    verdict_own = msg > 0  # subgroup tie → −1, for my owned coords
-
-    # phase 2 — cross-group ring of packed verdicts: member i of every group
-    # owns the SAME chunk id, so a ring over same-position peers accumulates
-    # the group-verdict count coordinate-aligned; arrival order is irrelevant
-    # to a running count.
-    cross_perm = [
-        (s, ((s // g + 1) % n_groups) * g + s % g) for s in range(w)
-    ]
-    my_group = lax.axis_index(axis_name) // g
-
-    def _cross_hop(carry, t):
-        count, rot = carry
-        rot = lax.ppermute(rot, axis_name, cross_perm)
-        contrib = unpack_signs(rot, (chunk,)).astype(jnp.int32)
-        if group_alive is not None:
-            # the hop-t packet originated at group (my_group − t − 1): a
-            # fully-quarantined group's verdict chunk abstains at level 2
-            src = (my_group - t - 1) % n_groups
-            contrib = jnp.where(group_alive[src], contrib, 0)
-        return (count + contrib, rot), None
-
-    count = verdict_own.astype(jnp.int32)
-    if group_alive is not None:
-        count = jnp.where(group_alive[my_group], count,
-                          jnp.zeros_like(count))
-    if n_groups > 1 and w > 1:  # leg 2: the ONLY cross-group (DCN) traffic
-        WIRE_TALLY.record("dcn", (n_groups - 1) * (chunk // 8))
-    if n_groups > 1:
-        (count, _), _ = lax.scan(
-            _cross_hop, (count, pack_signs(verdict_own)),
-            jnp.arange(n_groups - 1))
-    if group_alive is None:
-        elected_own = count * 2 > n_groups  # group-level tie → −1
-    else:
-        # threshold shrinks to the healthy-group quorum (tie still → −1)
-        elected_own = count * 2 > group_alive.astype(jnp.int32).sum()
-
-    # phase 3 — intra-group all-gather of the packed elected chunks.
-    def _ag_hop(carry, t):
-        out, rot = carry
-        rot = lax.ppermute(rot, axis_name, intra_perm)
-        # the hop-t packet originated at the member t+1 behind me, which
-        # owns chunk (idx − t − 1 + 1) mod g
-        out = lax.dynamic_update_slice(out, rot[None], ((idx - t) % g, 0))
-        return (out, rot), None
-
-    packed_own = pack_signs(elected_own)  # [chunk/8] uint8
-    out = jnp.zeros((g, chunk // 8), jnp.uint8)
-    out = lax.dynamic_update_slice(out, packed_own[None], (own, 0))
-    if g > 1 and w > 1:  # leg 3: (g−1) packed elected-chunk hops
-        WIRE_TALLY.record("ici", (g - 1) * (chunk // 8))
-    if g > 1:
-        (out, _), _ = lax.scan(_ag_hop, (out, packed_own), jnp.arange(g - 1))
-    return unpack_signs(out.reshape(-1), (g * chunk,))[:n]
+    slot = hier_launch(vote_pos, axis_name, w, group_size, alive, count)
+    return hier_consume(slot, vote_pos.shape[0], axis_name, w, group_size,
+                        alive, count, depth=0)
 
 
 def majority_vote_hier(

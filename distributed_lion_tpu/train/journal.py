@@ -43,9 +43,10 @@ Record schema (validated by scripts/validate_metrics.py):
 - ``meta``/``journal_start``: adds ``wall`` (``time.time()`` at the same
   instant as ``t``) — the anchor the analyzer uses to map each rank's
   monotonic clock onto one wall timeline (skew correction).
-- ``span``: adds ``dur`` (seconds). A span whose ``thread`` field is
-  ``"committer"`` ran on a background thread and is excluded from
-  step-wall attribution (it overlaps compute by design).
+- ``span``: adds ``dur`` (seconds). A span stamped with a ``thread`` field
+  (``"committer"`` for the checkpoint commit thread, ``"dcn-link"`` for
+  the emulated DCN link's residual waits) ran off the step thread and is
+  excluded from step-wall attribution (it overlaps compute by design).
 - free-form extra fields must be JSON scalars; non-finite floats are
   serialized as ``null`` with the repr under ``<k>_repr``.
 

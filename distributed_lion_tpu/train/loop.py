@@ -118,6 +118,20 @@ class TrainConfig:
     # — bucketing changes WHEN bytes move, never what is elected. 0 = auto
     # (resolve_auto_comm): 4 when W > 1 and the per-step ballot slice is
     # ≥ AUTO_BUCKET_MIN_COORDS, else 1 (the monolithic vote).
+    dcn_pipeline_depth: int = 0  # d > 0 (hier wire only): cross-step DCN
+    # overlap — each step computes/combines its level-1 ICI tally
+    # immediately and LAUNCHES the level-2 cross-group (DCN) ring for its
+    # own ballot, but consumes the ring only d steps later (the in-flight
+    # packed tallies ride LionState.dcn_ring, one slot per step), so the
+    # slow fabric's round trip hides behind d steps of compute instead of
+    # bounding every step. Elections applied at step t are the complete
+    # two-level election of step t−d's ballots — uniformly stale, replicas
+    # bit-identical; the first d steps apply no update (cold start, the
+    # vote_every rule). Composes with vote_buckets/vote_every/the vote
+    # guard; bytes per step are depth-invariant (comm_drift_bytes stays 0).
+    # 0 = today's synchronous hier wire. Checkpoints carry the ring, so
+    # crash-resume stays bit-identical at any depth; a depth toggle on
+    # resume errors loudly. See ARCHITECTURE 'DCN overlap'.
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     row_block: int = 0  # Pallas lion kernel tile rows (multiple of 32).
     # 0 = auto: the Trainer consults the device-keyed autotune cache
@@ -490,6 +504,28 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             "--vote_guard protects the majority-vote election; the AdamW "
             "path has no vote to guard — drop one of the two flags"
         )
+    if cfg.dcn_pipeline_depth > 0:
+        from distributed_lion_tpu.ops.codec import parse_wire
+
+        if not cfg.lion:
+            raise ValueError(
+                "--dcn_pipeline_depth pipelines the vote wire; the AdamW "
+                "path has no vote collective — drop one of the two flags")
+        if cfg.wire == "auto":
+            # the Trainer resolves 'auto' before reaching here, so a
+            # literal sentinel means a standalone caller skipped
+            # resolve_auto_comm — and staleness must never ride an
+            # implicit wire choice either way
+            raise ValueError(
+                f"--dcn_pipeline_depth {cfg.dcn_pipeline_depth} needs an "
+                "explicitly named hier wire, but the wire is the "
+                "unresolved 'auto' sentinel — pass --wire hier:<g>")
+        if parse_wire(cfg.wire)[0] != "hier":
+            raise ValueError(
+                f"--dcn_pipeline_depth {cfg.dcn_pipeline_depth} pipelines "
+                f"the hier wire's level-2 (DCN) leg, but the wire here is "
+                f"{cfg.wire!r} — a wire without a DCN leg has nothing to "
+                "overlap; pass --wire hier:<g>")
     if cfg.lion:
         mom_dtype = jnp.dtype(cfg.mom_dtype) if cfg.mom_dtype else None
         return distributed_lion(
@@ -506,6 +542,7 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
             wire="sign_psum" if cfg.wire == "auto" else cfg.wire,
             vote_every=cfg.vote_every or 1,
             vote_buckets=cfg.vote_buckets or 1,
+            dcn_pipeline_depth=cfg.dcn_pipeline_depth,
             kernel=cfg.kernel,
             row_block=cfg.row_block,
             mom_dtype=mom_dtype,
@@ -532,12 +569,16 @@ def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
         # stacked per-worker momentum: [world, ...] over 'data' (+ any
         # tensor-parallel dims the param itself carries); the elected-sign
         # cache (vote_every > 1) and the guard's health mask are replicated;
-        # the guard's per-worker previous ballot shards like the momenta
+        # the guard's per-worker previous ballot and the DCN pipeline ring
+        # (each member owns a different 1/g coordinate chunk) shard like
+        # the momenta
         guard_on = cfg.vote_guard != "off"
         return LionState(count=P(), exp_avg=exp_avg_specs, rng=P(),
                          elected=P() if cfg.vote_every > 1 else None,
                          health=P() if guard_on else None,
-                         prev_ballot=P(DATA_AXIS) if guard_on else None)
+                         prev_ballot=P(DATA_AXIS) if guard_on else None,
+                         dcn_ring=(P(DATA_AXIS)
+                                   if cfg.dcn_pipeline_depth > 0 else None))
     if cfg.zero1:
         # [world, chunk] m/v sharded over 'data': ZeRO-1 state partitioning
         return Zero1State(count=P(), m=P(DATA_AXIS), v=P(DATA_AXIS))
@@ -752,6 +793,8 @@ class Trainer:
                     else NamedSharding(mesh, P()),
                     prev_ballot=None if state.prev_ballot is None
                     else NamedSharding(mesh, P(DATA_AXIS)),
+                    dcn_ring=None if state.dcn_ring is None
+                    else NamedSharding(mesh, P(DATA_AXIS)),
                 ),
             )
         elif cfg.zero1:
@@ -865,7 +908,8 @@ class Trainer:
         return comm_report(self.n_params, self.world, self.cfg.wire, steps_per_sec,
                            vote_every=self.cfg.vote_every,
                            accum_steps=self.cfg.gradient_accumulation_steps,
-                           vote_buckets=self.cfg.vote_buckets or 1)
+                           vote_buckets=self.cfg.vote_buckets or 1,
+                           dcn_pipeline_depth=self.cfg.dcn_pipeline_depth)
 
     # -------------------------------------------------------------- telemetry
     def telemetry_summary(self, reset: bool = False) -> Optional[dict]:
@@ -1418,6 +1462,31 @@ class Trainer:
                     # (profiling.comm_report); the measured counterpart is
                     # bench.py's overlap-ablation comm_overlap_frac
                     m["comm_overlap_frac"] = comm.get("comm_overlap_frac", 0.0)
+                    if "dcn_overlap_frac" in comm:
+                        # analytic share of the hier wire's level-2 latency
+                        # off the critical path under --dcn_pipeline_depth;
+                        # measured counterpart: bench_dcn's depth ablation
+                        m["dcn_overlap_frac"] = comm["dcn_overlap_frac"]
+                from distributed_lion_tpu.parallel.collectives import (
+                    DCN_WAIT,
+                )
+
+                dcn_waits = DCN_WAIT.pop()
+                if dcn_waits:
+                    # the emulated DCN link's measured residual (unhidden)
+                    # wait this interval — nonzero only under the dcn_delay
+                    # fault (train/resilience registry); sub-delay values
+                    # are the cross-step pipeline visibly hiding the leg
+                    wait_s = sum(dcn_waits.values())
+                    m["dcn_wait_s"] = wait_s
+                    if self.cfg.journal:
+                        # thread-tagged: the wait happened inside the
+                        # device program (run_analyze excludes it from
+                        # step-thread attribution — it overlaps dispatch)
+                        jr.record({"kind": "span", "name": "dcn_wait",
+                                   "dur": round(wait_s, 9),
+                                   "step": self.step_count,
+                                   "thread": "dcn-link"})
                 hbm = peak_hbm_gb()
                 if hbm is not None:
                     m["peak_hbm_gb"] = hbm
@@ -1628,6 +1697,7 @@ class Trainer:
                   "has_vote_health": self._telemetry_on,
                   "has_guard": self._guard is not None,
                   "wire": self.cfg.wire, "vote_every": self.cfg.vote_every,
+                  "dcn_pipeline_depth": self.cfg.dcn_pipeline_depth,
                   **self.data_meta})
 
     def _with_guard_fields(self, tpl: dict, on: bool,
@@ -1796,7 +1866,8 @@ class Trainer:
             legacy_state = self._pack_state_rng(self.state)
             if self.cfg.lion:
                 legacy_state = legacy_state._replace(health=None,
-                                                     prev_ballot=None)
+                                                     prev_ballot=None,
+                                                     dcn_ring=None)
             tries.append({"params": self.params,
                           "opt_state": legacy_state,
                           "step": np.asarray(self.step_count, np.int64)})
@@ -1892,6 +1963,28 @@ class Trainer:
             meta = (self.checkpointer.manifest_meta(step)
                     if self.cfg.ckpt_integrity else None) or {}
             ckpt_world = int(meta.get("world", self.world))
+            if meta:
+                # a depth toggle is an operator decision, never a silent
+                # remap: the ring holds IN-FLIGHT level-2 tallies whose
+                # slot count and staleness semantics are the depth — there
+                # is no meaning-preserving reshape between depths (in a
+                # stamped manifest, an absent key = pre-ring checkpoint =
+                # depth 0). Checkpoints with NO manifest meta at all
+                # (--ckpt_integrity false / legacy dirs) cannot be
+                # depth-checked up front: a matching depth restores through
+                # the normal templates, and a mismatch surfaces as the
+                # all-templates-failed RuntimeError below, which names the
+                # depth toggle as a candidate cause.
+                ckpt_depth = int(meta.get("dcn_pipeline_depth", 0) or 0)
+                if ckpt_depth != self.cfg.dcn_pipeline_depth:
+                    raise ValueError(
+                        f"checkpoint step {step} was written at "
+                        f"--dcn_pipeline_depth {ckpt_depth} but this run "
+                        f"uses {self.cfg.dcn_pipeline_depth}: the in-flight"
+                        " DCN tally ring does not survive a depth change. "
+                        "Resume with the matching depth (then change it at "
+                        "the NEXT fresh start), or point --output_dir "
+                        "elsewhere")
             if ckpt_world != self.world:
                 # a mismatched world is an operator decision, not a bad
                 # checkpoint — never silently fall back past it
@@ -1906,6 +1999,14 @@ class Trainer:
                         "--elastic_resume remaps the stacked per-worker "
                         "Lion momenta; the AdamW/ZeRO-1 states have no "
                         "defined remap")
+                if self.cfg.dcn_pipeline_depth > 0:
+                    raise NotImplementedError(
+                        "--elastic_resume cannot remap the DCN pipeline "
+                        "ring: its slots are in-flight level-2 tallies "
+                        "whose chunk ownership and group count are "
+                        "functions of the world size. Resume at the "
+                        "original world (drain the pipeline), or restart "
+                        "with --dcn_pipeline_depth 0")
             try:
                 self._restore_step(step, meta, ckpt_world)
             except Exception as e:
@@ -1929,7 +2030,14 @@ class Trainer:
                 f"resume_from_checkpoint: all {len(candidates)} verified "
                 f"checkpoint(s) (steps {candidates}) failed to restore "
                 "into this run's state structure — likely a model/optimizer"
-                " config change since they were written. Refusing to "
+                " config change since they were written"
+                + (" (this run's --dcn_pipeline_depth "
+                   f"{self.cfg.dcn_pipeline_depth} is one candidate: a "
+                   "checkpoint without manifest meta cannot be "
+                   "depth-checked up front, and the DCN ring does not "
+                   "survive a depth change)"
+                   if self.cfg.dcn_pipeline_depth > 0 else "")
+                + ". Refusing to "
                 "silently restart from step 0; pass --resume_from_checkpoint"
                 " false (or point --output_dir elsewhere) to start fresh")
 

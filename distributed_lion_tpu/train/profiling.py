@@ -158,7 +158,7 @@ def peak_hbm_gb() -> Optional[float]:
 def comm_report(num_params: int, world: int, wire: str,
                 steps_per_sec: Optional[float] = None,
                 vote_every: int = 1, accum_steps: int = 1,
-                vote_buckets: int = 1) -> dict:
+                vote_buckets: int = 1, dcn_pipeline_depth: int = 0) -> dict:
     """Vote-collective wire accounting (+ bandwidth when a rate is known).
 
     ``comm_overlap_frac`` is the ANALYTIC pipelineable share of the wire
@@ -167,10 +167,18 @@ def comm_report(num_params: int, world: int, wire: str,
     first can ride behind compute — 0.0 for the monolithic vote, ≈(B−1)/B
     for B equal buckets. The measured counterpart (step-time actually
     recovered on hardware) comes from bench.py's overlap-ablation rows.
+
+    ``dcn_overlap_frac`` (hier wire only) is the analytic share of the
+    level-2 (DCN) leg's LATENCY eligible to leave the critical path under
+    ``--dcn_pipeline_depth``: 1.0 once the leg rides the cross-step ring
+    (depth ≥ 1 — the whole round trip hides behind d steps of compute),
+    0.0 for the synchronous wire. Bytes are depth-invariant. The measured
+    counterpart comes from the bench_dcn ablation (scripts/bench_dcn.py).
     """
     acct = wire_bytes_per_param(num_params, world, wire,
                                 vote_every=vote_every, accum_steps=accum_steps,
-                                vote_buckets=vote_buckets)
+                                vote_buckets=vote_buckets,
+                                dcn_pipeline_depth=dcn_pipeline_depth)
     out = {
         "wire": acct["wire"],
         "comm_bytes_per_step": acct["bytes_per_step"],
@@ -185,6 +193,8 @@ def comm_report(num_params: int, world: int, wire: str,
     if "dcn_bytes_per_step" in acct:  # hier wire: the slow-fabric leg alone
         out["comm_dcn_bytes_per_step"] = acct["dcn_bytes_per_step"]
         out["comm_dcn_bits_per_param"] = acct["dcn_bits_per_param"]
+        out["dcn_pipeline_depth"] = acct["dcn_pipeline_depth"]
+        out["dcn_overlap_frac"] = acct["dcn_overlap_frac"]
     if steps_per_sec:
         out["comm_mbytes_per_sec"] = acct["bytes_per_step"] * steps_per_sec / 1e6
     return out

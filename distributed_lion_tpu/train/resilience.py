@@ -47,6 +47,21 @@ from typing import Any, Iterable, Optional
 #   ckpt_crash_before_marker   (bool: manifest lands, commit marker doesn't)
 #   ckpt_slow_commit     (float: seconds the commit thread stalls, i.e. a
 #                         slow serialize/write — what async saving must hide)
+#   dcn_delay            (float: seconds of round-trip latency the hier
+#                         wire's level-2 (DCN) leg is emulated to take.
+#                         Consumed at TRACE time by parallel.collectives'
+#                         launch/consume gates: the launch stamps a wall
+#                         clock per optimizer step, the consume blocks
+#                         until stamp + delay — so compute executed between
+#                         launch and consume (the --dcn_pipeline_depth
+#                         cross-step window) counts toward the deadline and
+#                         only the UNHIDDEN residual is paid, recorded in
+#                         collectives.DCN_WAIT. This is how the bench_dcn
+#                         ablation shapes DCN latency on a CPU mesh. Arm
+#                         BEFORE building the optimizer/trainer (trace
+#                         time); call collectives.dcn_link_reset() between
+#                         measured legs.)
+#   journal_torn_write   (int: tear the next N journal sink writes)
 #   ballot_poison        ((kind, worker, start_step) from parse_poison():
 #                         the trainer's step bakes a worker-k gradient
 #                         transform in at trace time — nan_grads → NaN,

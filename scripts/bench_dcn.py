@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""Cross-step DCN overlap evidence (ISSUE 8): the hier wire's level-2 leg
+under ``--dcn_pipeline_depth`` {0, 1, 2} with an injected ``dcn_delay``
+link, plus the bits-per-param × steps-to-loss frontier.
+
+Writes ONE strict-JSON artifact, ``<out>/dcn_overlap.json`` (schema in
+scripts/validate_metrics.py; judged by check_evidence's ``dcn_overlap``
+stage):
+
+- ``bit_identity`` — the ``dcn_delay`` fault is TIMING-ONLY (depth-0 loss
+  curves byte-identical armed vs unarmed), and the depth-0 wire is
+  deterministic across fresh trainers. The depth-0 == pre-split-election
+  pin lives in tests/test_dcn_overlap.py (vs an independent reference
+  implementation); this artifact records the runtime-provable halves.
+- ``ablation`` — per depth {0, 1, 2}: wall ms/step and the emulated link's
+  measured residual wait (collectives.DCN_WAIT — the UNHIDDEN part of the
+  injected round trip). The consume gate blocks only until
+  ``launch_stamp + delay``, so compute executed during the d-step flight
+  counts toward the deadline: depth 0 pays ~the full delay every step,
+  depth ≥ 1 pays only what d steps of compute could not cover.
+- ``overlap`` — ``recovered_frac_depth{1,2}`` = 1 − wait_d/wait_0: the
+  fraction of the per-step latency the synchronous wire loses that the
+  pipeline hid. The acceptance floor is ``DCN_OVERLAP_MIN`` (0.8 at
+  depth 1 with a 100 ms link — ISSUE 8).
+- ``frontier`` — bits/param/step (analytic, codec.wire_bytes_per_param ==
+  measured: comm_drift_bytes is pinned 0 by tests) × steps-to-target-loss
+  rows across wire configs, the comm-cost/convergence trade the paper's
+  thesis is about. Target = the sign_psum baseline's final loss +
+  ``TARGET_MARGIN_NATS`` (pre-registered; null steps_to_loss = never
+  reached within the budget).
+- ``parity`` — depth {1, 2} loss parity vs depth 0 over the tail
+  (``PARITY_TAIL_FRAC``, the parity_strict methodology): mean |Δloss| ≤
+  ``DCN_PARITY_EPS_NATS``, pre-registered BEFORE capture.
+
+CPU is this bench's native habitat — the link is emulated wherever it
+runs, and the CPU mesh is where DCN shaping is reproducible — so a
+CPU-produced artifact is first-class evidence here (unlike throughput
+benches); ``meta.backend`` records what measured it. The runbook re-runs
+it on chip (stage 5g) so the pipeline is also proven against real XLA
+async scheduling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# the W=4 (g=2) acceptance topology needs 4 devices; on a bare CPU host
+# jax exposes 1 — fork it to 4 virtual devices BEFORE jax loads (the
+# conftest trick). TPU/GPU backends are left untouched.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" or not os.environ.get(
+        "JAX_PLATFORMS"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=4").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# ---- pre-registered criteria (fixed BEFORE the data lands) ----
+DCN_DELAY_MS = 100.0        # the injected level-2 round trip (ISSUE 8)
+DCN_OVERLAP_MIN = 0.8       # depth-1 must hide >= this fraction of it
+# depth {1,2} tail-loss gap bound vs depth 0, parity_strict methodology
+# (mean |Δloss| over the tail window). Two scales, both pre-registered:
+# - full scale (>= PARITY_FULL_MIN_PARAMS, the on-chip gpt2-small leg):
+#   the absolute check_evidence.PARITY_EPS_NATS bound.
+# - reduced CPU scale (this script's default shape, <1M params over a
+#   short horizon): tiny-scale tails are noisy and ANY change to the
+#   election sequence — including merely choosing a different exact wire —
+#   moves them by O(0.1) nats, so an absolute bound would measure the
+#   scale, not the staleness. The reduced criterion is RELATIVE: the
+#   d-step-stale election must track the synchronous hier election within
+#   max(DCN_PARITY_EPS_NATS_REDUCED, RELATIVE_FACTOR x the benign gap) —
+#   where the benign gap is the tail MAD between the sign_psum and
+#   synchronous-hier legs, same seed and data: the trajectory divergence
+#   two EXACT elections already exhibit at this scale. Staleness bounded
+#   by 1.5x the cost of a wire swap is the claim; the artifact records
+#   both gaps so the judgement is inspectable.
+DCN_PARITY_EPS_NATS = 0.05
+DCN_PARITY_EPS_NATS_REDUCED = 0.10
+DCN_PARITY_RELATIVE_FACTOR = 1.5
+PARITY_FULL_MIN_PARAMS = 10_000_000
+PARITY_TAIL_FRAC = 0.75     # tail window start (parity_strict methodology)
+TARGET_MARGIN_NATS = 0.02   # frontier target = slowest leg's final + this
+# (a COMMON attainable target: every leg crosses it, so steps_to_loss
+# ranks convergence speed per bits/param instead of reading mostly-null)
+
+WIRE = "hier:2"             # W=4, g=2: 2 groups, a real cross-group leg
+WORLD = 4
+
+
+def _mesh():
+    import jax
+
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < WORLD:
+        raise SystemExit(f"bench_dcn needs >= {WORLD} devices, have "
+                         f"{len(jax.devices())}")
+    return make_mesh(data=WORLD, devices=jax.devices()[:WORLD])
+
+
+def _model_cfg():
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+
+    # sized so a CPU step's COMPUTE lands around 1-2x the injected 100 ms
+    # link — the regime where one step of compute can cover the round trip
+    # (depth-1 steady state waits max(0, L − P), so compute ≥ L hides all
+    # of it) — while the whole matrix runs in minutes. Measured
+    # ~100-150 ms/step on a 4-virtual-device host CPU at this shape.
+    return GPT2Config.tiny(vocab_size=512, n_layer=2, n_head=4,
+                           d_model=128, n_ctx=64)
+
+
+def _train_cfg(steps, depth, wire=WIRE, vote_every=1):
+    from distributed_lion_tpu.train.loop import TrainConfig
+
+    return TrainConfig(
+        lion=True, async_grad=True, wire=wire, vote_every=vote_every,
+        vote_buckets=1, dcn_pipeline_depth=depth, learning_rate=1e-3,
+        warmup_steps=2, max_steps=steps, per_device_train_batch_size=2,
+        gradient_accumulation_steps=1, block_size=64, logging_steps=1,
+        eval_steps=10**9, save_steps=10**9, output_dir=None,
+    )
+
+
+def _run_leg(steps, depth, *, wire=WIRE, vote_every=1, delay_s=None,
+             timed_tail=0):
+    """One training leg. Returns (curve {step: loss}, row dict). With
+    ``delay_s`` the dcn_delay fault is armed for the WHOLE leg (trace
+    time); ``timed_tail`` > 0 additionally times the last N steps as a
+    separate train() call (compile + pipeline cold start excluded) and
+    reports ms_per_step + the emulated link's residual wait."""
+    import jax
+
+    from distributed_lion_tpu.data.sources import (
+        batch_iterator,
+        synthetic_lm_dataset,
+    )
+    from distributed_lion_tpu.parallel import collectives
+    from distributed_lion_tpu.train import resilience
+    from distributed_lion_tpu.train.loop import Trainer
+
+    model = _model_cfg()
+    mesh = _mesh()
+    resilience.inject_fault("dcn_delay", delay_s)
+    collectives.dcn_link_reset()
+    try:
+        tr = Trainer.for_gpt2(_train_cfg(steps, depth, wire, vote_every),
+                              mesh, model, seed=3)
+        blocks = synthetic_lm_dataset(
+            max(64, tr.global_train_batch()), 64, model.vocab_size, seed=1)
+        it = batch_iterator(blocks, tr.global_train_batch(), seed=5)
+        hist = tr.train(it, max_steps=steps - timed_tail)
+        row = {"depth": depth, "wire": wire, "vote_every": vote_every,
+               "delay_ms": None if delay_s is None else delay_s * 1e3}
+        if timed_tail:
+            t0 = time.monotonic()
+            tail = tr.train(it, max_steps=timed_tail)
+            wall = time.monotonic() - t0
+            hist += tail
+            # the trainer drains collectives.DCN_WAIT into the dcn_wait_s
+            # metric at log cadence (logging_steps=1 here), so the residual
+            # wait is read back from the history rows — popping the global
+            # here would race the loop's own drain
+            row["timed_steps"] = timed_tail
+            row["ms_per_step"] = round(wall / timed_tail * 1e3, 3)
+            row["dcn_wait_ms_per_step"] = round(
+                sum(h.get("dcn_wait_s", 0.0) for h in tail)
+                / timed_tail * 1e3, 3)
+        tr.close()
+        curve = {h["step"]: h["loss"] for h in hist if "loss" in h}
+        return curve, row
+    finally:
+        resilience.inject_fault("dcn_delay", None)
+        collectives.dcn_link_reset()
+
+
+def _tail_mad(a: dict, b: dict, steps: int) -> float:
+    common = [s for s in sorted(set(a) & set(b))
+              if s >= PARITY_TAIL_FRAC * steps]
+    return sum(abs(a[s] - b[s]) for s in common) / max(len(common), 1)
+
+
+def _steps_to(curve: dict, target: float):
+    hit = [s for s, v in sorted(curve.items()) if v <= target]
+    return hit[0] if hit else None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO, "runs",
+                                                  "dcn_overlap"))
+    ap.add_argument("--steps", type=int, default=80,
+                    help="frontier/parity leg length (optimizer steps)")
+    ap.add_argument("--ablation_steps", type=int, default=8,
+                    help="timed steps per ablation depth cell")
+    ap.add_argument("--delay_ms", type=float, default=DCN_DELAY_MS)
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.devices()[0].platform
+    delay_s = args.delay_ms / 1e3
+    from distributed_lion_tpu.ops.codec import wire_bytes_per_param
+    from distributed_lion_tpu.models.gpt2 import count_params, gpt2_init
+
+    n_params = count_params(gpt2_init(jax.random.key(0), _model_cfg()))
+
+    # ---- bit-identity: the fault is timing-only; depth 0 deterministic
+    print("[bench_dcn] bit-identity legs (depth 0, fault armed vs not)",
+          flush=True)
+    c_plain, _ = _run_leg(10, 0)
+    c_plain2, _ = _run_leg(10, 0)
+    c_armed, _ = _run_leg(10, 0, delay_s=delay_s)
+    bit_identity = {
+        "depth0_deterministic": c_plain == c_plain2,
+        "depth0_fault_inert": c_plain == c_armed,
+        "refactor_identity": "pinned by tests/test_dcn_overlap.py against "
+                             "an independent majority-of-majorities "
+                             "reference",
+    }
+
+    # ---- the depth ablation under the injected link
+    ablation = []
+    for depth in (0, 1, 2):
+        print(f"[bench_dcn] ablation depth={depth} "
+              f"delay={args.delay_ms:.0f}ms", flush=True)
+        _, row = _run_leg(4 + args.ablation_steps, depth, delay_s=delay_s,
+                          timed_tail=args.ablation_steps)
+        ablation.append(row)
+    wait0 = ablation[0]["dcn_wait_ms_per_step"]
+    overlap = {
+        "injected_ms": args.delay_ms,
+        "lost_ms_per_step_depth0": wait0,
+        "criterion": f"recovered_frac_depth1 >= {DCN_OVERLAP_MIN}",
+    }
+    for row in ablation[1:]:
+        frac = (1.0 - row["dcn_wait_ms_per_step"] / wait0) if wait0 else 0.0
+        overlap[f"recovered_frac_depth{row['depth']}"] = round(frac, 4)
+    overlap["pass"] = (wait0 > 0
+                       and overlap["recovered_frac_depth1"]
+                       >= DCN_OVERLAP_MIN)
+
+    # ---- frontier + parity legs (no fault: convergence, not timing)
+    legs = [
+        ("sign_psum", 1, 0),
+        (WIRE, 1, 0),
+        (WIRE, 1, 1),
+        (WIRE, 1, 2),
+        (WIRE, 4, 1),
+    ]
+    curves, frontier = {}, []
+    for wire, ve, depth in legs:
+        print(f"[bench_dcn] frontier leg wire={wire} vote_every={ve} "
+              f"depth={depth}", flush=True)
+        curve, _ = _run_leg(args.steps, depth, wire=wire, vote_every=ve)
+        curves[(wire, ve, depth)] = curve
+    target = round(max(c[max(c)] for c in curves.values())
+                   + TARGET_MARGIN_NATS, 6)
+    for wire, ve, depth in legs:
+        acct = wire_bytes_per_param(n_params, WORLD, wire, vote_every=ve,
+                                    dcn_pipeline_depth=depth)
+        curve = curves[(wire, ve, depth)]
+        frontier.append({
+            "wire": wire, "vote_every": ve, "dcn_pipeline_depth": depth,
+            "bits_per_param": round(acct["bits_per_param"], 4),
+            "dcn_bits_per_param": round(acct.get("dcn_bits_per_param", 0.0),
+                                        4),
+            "dcn_overlap_frac": acct.get("dcn_overlap_frac", 0.0),
+            "steps_to_loss": _steps_to(curve, target),
+            "target_loss": target,
+            "final_loss": round(curve[max(curve)], 6),
+        })
+    gap1 = _tail_mad(curves[(WIRE, 1, 1)], curves[(WIRE, 1, 0)], args.steps)
+    gap2 = _tail_mad(curves[(WIRE, 1, 2)], curves[(WIRE, 1, 0)], args.steps)
+    benign = _tail_mad(curves[("sign_psum", 1, 0)], curves[(WIRE, 1, 0)],
+                       args.steps)
+    full_scale = n_params >= PARITY_FULL_MIN_PARAMS
+    bound = (DCN_PARITY_EPS_NATS if full_scale
+             else max(DCN_PARITY_EPS_NATS_REDUCED,
+                      DCN_PARITY_RELATIVE_FACTOR * benign))
+    parity = {
+        "bound_nats": round(bound, 6),
+        "scale": "full" if full_scale else "reduced",
+        "benign_wire_gap_nats": round(benign, 6),
+        "relative_factor": (None if full_scale
+                            else DCN_PARITY_RELATIVE_FACTOR),
+        "tail_frac": PARITY_TAIL_FRAC,
+        "depth1_gap_nats": round(gap1, 6),
+        "depth2_gap_nats": round(gap2, 6),
+        "pass": gap1 <= bound and gap2 <= bound,
+    }
+
+    doc = {
+        "meta": {
+            "backend": backend,
+            "world": WORLD, "wire": WIRE, "n_params": int(n_params),
+            "steps": args.steps, "ablation_steps": args.ablation_steps,
+            "note": "CPU-produced artifacts are first-class here: the DCN "
+                    "link is emulated on every backend (see module doc)",
+        },
+        "bit_identity": bit_identity,
+        "ablation": ablation,
+        "overlap": overlap,
+        "frontier": frontier,
+        "parity": parity,
+    }
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "dcn_overlap.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, allow_nan=False)
+        f.write("\n")
+    print(json.dumps({"artifact": path, "overlap_pass": overlap["pass"],
+                      "parity_pass": parity["pass"],
+                      "bit_identity": bit_identity["depth0_fault_inert"]},
+                     allow_nan=False), flush=True)
+    return 0 if (overlap["pass"] and parity["pass"]
+                 and bit_identity["depth0_fault_inert"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,6 +13,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py vote_guard     # poisoned-run rescue
     python scripts/check_evidence.py autotune       # TPU-keyed tuning cache
     python scripts/check_evidence.py journal        # run-journal attribution
+    python scripts/check_evidence.py dcn_overlap    # pipelined hier DCN leg
     python scripts/check_evidence.py all
 
 parity:vote / parity:lazy are STRICT since ISSUE 6: a leg counts as
@@ -518,6 +519,57 @@ def _run_analyze_module():
     return mod
 
 
+# the DCN-overlap stage (ISSUE 8): scripts/bench_dcn.py's artifact under
+# runs/dcn_overlap — (a) passes the strict dcn_overlap.json schema
+# (validate_metrics, loaded by FILE PATH so this script stays jax-free),
+# (b) the depth-0 bit-identity legs hold (the dcn_delay fault is
+# timing-only and the synchronous wire deterministic), (c) the depth-1
+# pipeline recovered >= DCN_OVERLAP_MIN of the injected per-step latency,
+# (d) the bits-per-param × steps-to-loss frontier is present and
+# row-valid, and (e) the pre-registered depth {1,2} loss-parity bound
+# held. A CPU-produced artifact is first-class here: the DCN link is
+# emulated on every backend (the point is the pipeline mechanism, not
+# chip throughput); meta.backend records what measured it.
+DCN_OVERLAP_MIN = 0.8
+DCN_ARTIFACT = os.path.join(REPO, "runs", "dcn_overlap", "dcn_overlap.json")
+
+
+def _validate_metrics_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "dlt_validate_metrics_standalone",
+        os.path.join(REPO, "scripts", "validate_metrics.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def dcn_overlap_ok(path: str = DCN_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    bit = doc.get("bit_identity", {})
+    if not (bit.get("depth0_deterministic") is True
+            and bit.get("depth0_fault_inert") is True):
+        return False
+    overlap = doc.get("overlap", {})
+    frac = overlap.get("recovered_frac_depth1")
+    if not isinstance(frac, (int, float)) or frac < DCN_OVERLAP_MIN:
+        return False
+    if not doc.get("frontier"):
+        return False
+    return doc.get("parity", {}).get("pass") is True
+
+
 def journal_ok(dirname: str = "journal") -> bool:
     base = (dirname if os.path.isabs(dirname)
             else os.path.join(REPO, "runs", dirname))
@@ -554,6 +606,7 @@ STAGES = [
     ("vote_guard", vote_guard_ok),
     ("autotune", autotune_ok),
     ("journal", journal_ok),
+    ("dcn_overlap", dcn_overlap_ok),
 ]
 
 # automation (the watcher exit condition) judges the parity legs on
@@ -618,6 +671,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return autotune_ok()
     if what == "journal":
         return journal_ok(arg or "journal")
+    if what == "dcn_overlap":
+        return dcn_overlap_ok(arg or DCN_ARTIFACT)
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -31,9 +31,12 @@ JSON artifacts, so EVERY JSON artifact the repo writes passes one
 validator: crash bundles (``crash/step_*/bundle.json`` — must carry
 step/reason/config, telemetry.write_crash_bundle), checkpoint
 manifests (``manifest.json`` — must carry format/step/files with
-sha256+bytes per file, checkpoint.write_manifest), and the autotune
+sha256+bytes per file, checkpoint.write_manifest), the autotune
 tuning cache (``tuning_cache.json`` — full check delegated to
-ops/autotune.validate_cache_doc, the cache's single schema authority).
+ops/autotune.validate_cache_doc, the cache's single schema authority),
+and the DCN-overlap evidence artifact (``dcn_overlap.json`` —
+scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
+rows are strict-validated per row).
 The same NaN-token rejection applies: all the writers pass
 ``allow_nan=False`` and this script is the CI check that they keep
 doing so.
@@ -169,11 +172,56 @@ def validate_journal_file(path: str) -> list[str]:
 # required top-level keys per known single-document artifact name.
 # (tuning_cache.json is NOT listed here: it dispatches below on its
 # embedded format stamp — any filename, e.g. a $DLT_TUNE_CACHE override —
-# and delegates wholesale to ops/autotune.validate_cache_doc.)
+# and delegates wholesale to ops/autotune.validate_cache_doc.
+# dcn_overlap.json has its own branch too: its frontier rows carry a
+# per-row schema the generic required-keys check can't express.)
 _DOC_SCHEMAS = {
     "bundle.json": ("step", "reason", "config"),
     "manifest.json": ("format", "step", "files"),
 }
+
+
+def _dcn_overlap_errors(path: str, doc: dict) -> list[str]:
+    """Strict schema of the DCN-overlap evidence artifact
+    (scripts/bench_dcn.py; judged by check_evidence's ``dcn_overlap``
+    stage): the four evidence sections present, ablation rows carrying
+    finite timings, and every frontier row a
+    bits-per-param × steps-to-loss point (``steps_to_loss`` null = the
+    target was never reached within the leg's budget — allowed, but the
+    key must exist so a silently-dropped measurement can't masquerade as
+    a complete table)."""
+    errors = []
+    for key in ("meta", "bit_identity", "ablation", "overlap", "frontier",
+                "parity"):
+        if key not in doc:
+            errors.append(f"{path}: missing required key {key!r}")
+    for name, row_keys in (("ablation",
+                            ("depth", "ms_per_step",
+                             "dcn_wait_ms_per_step")),
+                           ("frontier",
+                            ("wire", "bits_per_param", "steps_to_loss",
+                             "target_loss", "final_loss"))):
+        rows = doc.get(name)
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: {name!r} must be a non-empty list")
+            continue
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                errors.append(f"{path}: {name}[{i}] is not an object")
+                continue
+            for k in row_keys:
+                if k not in row:
+                    errors.append(f"{path}: {name}[{i}] missing {k!r}")
+                elif k != "steps_to_loss" and not (
+                        isinstance(row[k], str) if k == "wire"
+                        else _finite_number(row[k])):
+                    errors.append(f"{path}: {name}[{i}].{k} is not "
+                                  f"{'a string' if k == 'wire' else 'finite'}")
+    for section, key in (("overlap", "pass"), ("parity", "pass")):
+        sec = doc.get(section)
+        if isinstance(sec, dict) and not isinstance(sec.get(key), bool):
+            errors.append(f"{path}: {section}.{key} must be a bool")
+    return errors
 _SHA256 = re.compile(r"^[0-9a-f]{64}$")
 _TUNE_CACHE_FORMAT = "dlt-tune-cache-v1"  # == ops/autotune.CACHE_FORMAT
 
@@ -217,6 +265,8 @@ def validate_json_doc(path: str) -> list[str]:
     if not isinstance(doc, dict):
         return [f"{path}: document is {type(doc).__name__}, not an object"]
     name = os.path.basename(path)
+    if name == "dcn_overlap.json":
+        return _dcn_overlap_errors(path, doc)
     if name == "tuning_cache.json" or doc.get("format") == _TUNE_CACHE_FORMAT:
         # dispatch on the embedded format stamp as well as the canonical
         # name: a cache at any $DLT_TUNE_CACHE path (the documented drive)

@@ -45,6 +45,8 @@ def _assert_resumed_matches(tmp_path, mesh, model, blocks, **kw):
     ref_mom = jax.device_get(t_ref.state.exp_avg)
     ref_elected = (None if t_ref.state.elected is None
                    else np.asarray(jax.device_get(t_ref.state.elected)))
+    ref_ring = (None if t_ref.state.dcn_ring is None
+                else np.asarray(jax.device_get(t_ref.state.dcn_ring)))
     t_ref.close()
 
     # interrupted run: checkpoint at step 2, then 'killed' between the save
@@ -60,6 +62,8 @@ def _assert_resumed_matches(tmp_path, mesh, model, blocks, **kw):
     got_mom = jax.device_get(t2.state.exp_avg)
     got_elected = (None if t2.state.elected is None
                    else np.asarray(jax.device_get(t2.state.elected)))
+    got_ring = (None if t2.state.dcn_ring is None
+                else np.asarray(jax.device_get(t2.state.dcn_ring)))
     t2.close()
 
     np.testing.assert_array_equal(part1 + part2, ref_losses)
@@ -67,6 +71,8 @@ def _assert_resumed_matches(tmp_path, mesh, model, blocks, **kw):
     jax.tree.map(np.testing.assert_array_equal, got_mom, ref_mom)
     if ref_elected is not None:
         np.testing.assert_array_equal(got_elected, ref_elected)
+    if ref_ring is not None:
+        np.testing.assert_array_equal(got_ring, ref_ring)
 
 
 @pytest.mark.parametrize("stoch", [False, True], ids=["det", "stoch"])
@@ -102,3 +108,35 @@ def test_crash_resume_lazy_elected_cache_bit_identical(tmp_path):
     model = GPT2Config.tiny()
     blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
     _assert_resumed_matches(tmp_path, mesh, model, blocks, vote_every=4)
+
+
+def test_crash_resume_dcn_ring_mid_flight_bit_identical(tmp_path):
+    """ISSUE 8 satellite: hier wire at dcn_pipeline_depth=2, killed at
+    step 2 — the ring holds the IN-FLIGHT level-2 tallies of steps 0 and 1,
+    neither yet consumed. The resumed run's steps 3/4 consume tallies
+    launched on the other side of the crash; losses, params, momenta and
+    the ring itself must stay bit-identical to the uninterrupted run."""
+    mesh = make_mesh(data=8)
+    model = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
+    _assert_resumed_matches(tmp_path, mesh, model, blocks, wire="hier:4",
+                            dcn_pipeline_depth=2)
+
+
+def test_resume_depth_toggle_errors_loudly(tmp_path):
+    """A checkpoint written at one --dcn_pipeline_depth must refuse to
+    restore at another: the ring's slot count IS the staleness semantics —
+    there is no meaning-preserving reshape — and silently reinitializing
+    it would drop in-flight elections."""
+    mesh = make_mesh(data=8)
+    model = GPT2Config.tiny()
+    blocks = synthetic_lm_dataset(64, 32, model.vocab_size, seed=1)
+    out = str(tmp_path / "run")
+    t1, _ = _run(_cfg(out, 2, wire="hier:4", dcn_pipeline_depth=2),
+                 mesh, model, blocks)
+    t1.close()
+    for other in (0, 1):
+        with pytest.raises(ValueError, match="dcn_pipeline_depth"):
+            Trainer.for_gpt2(
+                _cfg(out, 4, wire="hier:4", dcn_pipeline_depth=other),
+                mesh, model, seed=3)

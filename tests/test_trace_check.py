@@ -73,6 +73,59 @@ def test_collective_inventory_matches_wire_recipe(mesh8, wire, vote_buckets):
     assert len(rep["observed"]) == per_bucket * vote_buckets
 
 
+@pytest.mark.parametrize("depth", [0, 1])
+def test_hier_dcn_depth_inventory_invariant(mesh8, depth):
+    """ISSUE 8: the hier wire's collective inventory is DEPTH-invariant —
+    at any --dcn_pipeline_depth every step runs exactly one launch (legs
+    1+2) and one consume (leg 3), so the expected set equals the
+    synchronous wire's: no duplicate DCN collective, ICI legs unchanged,
+    and zero host callbacks (the dcn_delay emulator is only traced when
+    the fault is armed)."""
+    tr = _trainer(mesh8, wire="hier:4", vote_buckets=2,
+                  dcn_pipeline_depth=depth)
+    rep = trace_check.check_trainer(tr, _batch(tr))
+    tr.close()
+    assert rep["ok"], (rep["expected"], rep["observed"],
+                       rep["host_callbacks"])
+    assert rep["expected"] == [list(c) for c in trace_check.expected_wire_calls(
+        tr.n_params, 8, "hier:4", vote_buckets=2, dcn_pipeline_depth=0)]
+    assert len(rep["observed"]) == 3 * 2  # 3 ppermute sites x 2 buckets
+
+
+def test_hier_duplicate_dcn_collective_detected(mesh8):
+    """The failure mode the depth cells exist to catch: a broken pipeline
+    that consumes BOTH a fresh and a stale election per step (e.g. a
+    cold-start implemented as a traced second election instead of the
+    valid-mask) doubles leg-3 ring call sites — the contract must FAIL it,
+    not average it away."""
+    from functools import partial as _partial
+
+    from distributed_lion_tpu.ops.codec import hier_chunk_slot_bytes
+
+    # n large enough that the DCN/elected legs' chunk/8 operands clear
+    # SCALAR_MAX (tiny ballots would file them as scalar reductions)
+    n, g = 8192, 4
+
+    @_partial(jax.shard_map, mesh=mesh8, in_specs=(P("data"), P()),
+              out_specs=P(), check_vma=False)
+    def broken(b, ring):
+        slot = collectives.hier_launch(b[0], DATA_AXIS, 8, g)
+        fresh = collectives.hier_consume(slot, n, DATA_AXIS, 8, g)
+        stale = collectives.hier_consume(ring[0], n, DATA_AXIS, 8, g)
+        return fresh & stale
+
+    ring = jnp.zeros((8, hier_chunk_slot_bytes(n, 8, g)), jnp.uint8)
+    ballots = jnp.zeros((8, n), jnp.bool_)
+    calls, callbacks = trace_check.collective_calls(broken, ballots, ring)
+    observed = sorted(c.key for c in calls
+                      if c.nelems > trace_check.SCALAR_MAX)
+    expected = trace_check.expected_wire_calls(n, 8, f"hier:{g}",
+                                               dcn_pipeline_depth=1)
+    assert not callbacks
+    assert observed != expected  # the duplicate consume must surface
+    assert len(observed) == len(expected) + 1
+
+
 def test_lazy_vote_inventory(mesh8):
     """vote_every=4: the wire recipe's expected set follows the rotating
     1/K slice (codec.vote_chunk_elems), not the full ballot."""
