@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional
+from typing import List, Optional
 
 
 @dataclasses.dataclass
@@ -26,7 +26,14 @@ class GenerateArguments:
     model_family: str = "gpt2"  # gpt2 | llama
     model_name: str = "tiny"    # gpt2: gpt2_124m | tiny; llama: llama2_7b | llama3_8b | tiny
     tokenizer_name: Optional[str] = None  # HF cache name; byte tokenizer otherwise
-    prompt: str = "Hello"
+    prompt: List[str] = dataclasses.field(default_factory=list)
+    # one or more prompts (--prompt "a" "b" "c"); several prompts batch into
+    # ONE left-padded generate call with per-row position offsets — each
+    # row attends/positions exactly as its solo run would (greedy outputs
+    # are identical to solo runs; see main() on sampling). With neither
+    # --prompt nor --prompt_file, "Hello" is the smoke default
+    prompt_file: Optional[str] = None  # one prompt per line; appended to
+    # --prompt (blank lines skipped)
     max_new_tokens: int = 64
     temperature: float = 0.8
     top_k: Optional[int] = 40
@@ -93,7 +100,9 @@ def build(args: GenerateArguments):
         params = (hf_params if hf_params is not None
                   else load_pytree(args.model_path) if args.model_path
                   else gpt2_init(jax.random.key(args.seed), cfg))
-        decode = partial(lambda c, p, t, k, pos: gpt2_decode(p, t, c, k, pos), cfg)
+        decode = partial(
+            lambda c, p, t, k, pos, off=None: gpt2_decode(p, t, c, k, pos, off),
+            cfg)
         init_cache = partial(gpt2_init_cache, cfg)
     elif args.model_family == "llama":
         from distributed_lion_tpu.models.llama import (
@@ -104,7 +113,9 @@ def build(args: GenerateArguments):
         params = (hf_params if hf_params is not None
                   else load_pytree(args.model_path) if args.model_path
                   else llama_init(jax.random.key(args.seed), cfg))
-        decode = partial(lambda c, p, t, k, pos: llama_decode(p, t, c, k, pos), cfg)
+        decode = partial(
+            lambda c, p, t, k, pos, off=None: llama_decode(p, t, c, k, pos, off),
+            cfg)
         init_cache = partial(llama_init_cache, cfg)
     else:
         raise ValueError(f"unknown model family {args.model_family!r}")
@@ -120,23 +131,48 @@ def main(argv=None):
 
     force_cpu_platform()
     import jax.numpy as jnp
+    import numpy as np
 
     from distributed_lion_tpu.models.generate import generate
     from distributed_lion_tpu.utils.argparsing import parse_dataclasses
 
     (args,) = parse_dataclasses((GenerateArguments,), argv)
     tok, cfg, params, decode, init_cache = build(args)
-    ids = tok.encode(args.prompt, add_bos=False) or [0]
-    prompt = jnp.asarray([ids], jnp.int32)
+    prompts = list(args.prompt)
+    if args.prompt_file:
+        with open(args.prompt_file) as f:
+            prompts += [ln.rstrip("\n") for ln in f if ln.strip()]
+        if not prompts:
+            raise ValueError(
+                f"no prompts: --prompt_file {args.prompt_file!r} holds no "
+                "non-blank lines and no --prompt was given")
+    elif not prompts:
+        prompts = ["Hello"]  # the historical smoke default
+    # NOTE: at temperature > 0 the batched draws share one PRNG stream
+    # over the [B, V] batch, so SAMPLED continuations differ from solo
+    # invocations (greedy rows are identical to solo runs — pinned by
+    # test); per-request streams live in the serving engine (run_serve)
+    ids = [tok.encode(p, add_bos=False) or [0] for p in prompts]
+    T = max(len(i) for i in ids)
+    # LEFT-pad to the longest prompt: every row's last prompt token sits at
+    # slot T-1 (so one shared sampling position), and the pad widths flow
+    # to the model as per-row position offsets + attention masks — each
+    # row attends and positions exactly as its solo run would
+    batch = np.zeros((len(ids), T), np.int32)
+    for r, seq in enumerate(ids):
+        batch[r, T - len(seq):] = seq
+    lens = jnp.asarray([len(seq) for seq in ids], jnp.int32)
     out = generate(
-        decode, init_cache, params, prompt, args.max_new_tokens,
+        decode, init_cache, params, jnp.asarray(batch), args.max_new_tokens,
         key=jax.random.key(args.seed), temperature=args.temperature,
         top_k=args.top_k, top_p=args.top_p,
         eos_id=getattr(tok, "eos_id", None),
+        prompt_lens=None if len(ids) == 1 else lens,
     )
-    text = tok.decode([int(t) for t in out[0]])
-    print(args.prompt + text)
-    return text
+    texts = [tok.decode([int(t) for t in row]) for row in out]
+    for p, t in zip(prompts, texts):
+        print(p + t)
+    return texts[0] if len(texts) == 1 else texts
 
 
 if __name__ == "__main__":

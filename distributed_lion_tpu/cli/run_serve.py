@@ -1,0 +1,110 @@
+"""Serving entry point: continuous batching over a paged KV cache.
+
+Completes the train → export → SERVE cycle (ROADMAP item 4): loads the
+same checkpoints ``run_generate`` does (model.npz, training output dirs,
+HF save_pretrained dirs — family auto-detected), builds the serving
+engine (serve/engine.py), and drains a request file:
+
+    python -m distributed_lion_tpu.cli.run_serve \
+        --model_path ./out --model_family gpt2 --model_name tiny \
+        --requests requests.jsonl --out responses.jsonl \
+        --quant nf4 --max_seqs 32 --block_size 16
+
+With no --requests, --prompt strings (repeatable) become the workload —
+a smoke mode mirroring run_generate. ``--journal_dir`` records
+``serve/*`` spans (train/journal) for ``cli/run_analyze``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ServeArguments:
+    requests: Optional[str] = None   # request JSONL (serve/api schema);
+    # unset → --prompt strings (GenerateArguments) become the workload.
+    # Sampling (--temperature/--top_k/--top_p/--seed) and --max_new_tokens
+    # ride GenerateArguments — one knob surface across generate and serve
+    out: Optional[str] = None        # response JSONL (default stdout)
+    max_seqs: int = 8
+    block_size: int = 16
+    max_blocks_per_seq: int = 8
+    num_blocks: int = 0              # 0 = auto (max_seqs * max_blocks_per_seq)
+    prefill_cap_tokens: int = 512
+    quant: str = "none"              # none | nf4 | int8 (ops/quant)
+    journal_dir: Optional[str] = None
+
+
+def build_engine(gen_args, serve_args: "ServeArguments"):
+    """(tokenizer, engine) from the run_generate model surface + serve
+    knobs — shared by this CLI, the decode bench, and tests."""
+    from distributed_lion_tpu.cli.run_generate import build
+    from distributed_lion_tpu.serve.engine import (
+        ServeConfig,
+        ServeModel,
+        ServingEngine,
+    )
+
+    tok, cfg, params, _, _ = build(gen_args)
+    model = (ServeModel.for_gpt2(params, cfg)
+             if gen_args.model_family == "gpt2"
+             else ServeModel.for_llama(params, cfg))
+    engine = ServingEngine(model, ServeConfig(
+        max_seqs=serve_args.max_seqs, block_size=serve_args.block_size,
+        max_blocks_per_seq=serve_args.max_blocks_per_seq,
+        num_blocks=serve_args.num_blocks,
+        prefill_cap_tokens=serve_args.prefill_cap_tokens,
+        max_new_tokens=gen_args.max_new_tokens,
+        temperature=gen_args.temperature, top_k=gen_args.top_k,
+        top_p=gen_args.top_p, quant=serve_args.quant,
+        eos_id=getattr(tok, "eos_id", None)))
+    return tok, engine
+
+
+def main(argv=None):
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
+
+    force_cpu_platform()
+
+    from distributed_lion_tpu.cli.run_generate import GenerateArguments
+    from distributed_lion_tpu.serve import api
+    from distributed_lion_tpu.serve.engine import Request
+    from distributed_lion_tpu.train import journal as journal_mod
+    from distributed_lion_tpu.utils.argparsing import parse_dataclasses
+
+    gen_args, args = parse_dataclasses((GenerateArguments, ServeArguments),
+                                       argv)
+    jrnl = None
+    if args.journal_dir:
+        jrnl = journal_mod.Journal(args.journal_dir)
+        journal_mod.install(jrnl)
+    try:
+        tok, engine = build_engine(gen_args, args)
+        if args.requests:
+            records = api.serve_request_file(engine, args.requests,
+                                             args.out or "/dev/stdout", tok)
+        else:
+            prompts = list(gen_args.prompt) or ["Hello"]  # smoke default
+            reqs = [Request(req_id=f"req{i}",
+                            tokens=tok.encode(p, add_bos=False) or [0],
+                            max_new_tokens=gen_args.max_new_tokens,
+                            seed=gen_args.seed)
+                    for i, p in enumerate(prompts)]
+            records = api.handle_requests(engine, reqs, tokenizer=tok)
+            for p, rec in zip(prompts, records):
+                print(json.dumps({"prompt": p, **rec}, allow_nan=False),
+                      flush=True)
+        journal_mod.active().event("serve_done", **{
+            k: int(v) for k, v in engine.stats.items()})
+        return records
+    finally:
+        if jrnl is not None:
+            journal_mod.uninstall(jrnl)
+            jrnl.close()
+
+
+if __name__ == "__main__":
+    main()

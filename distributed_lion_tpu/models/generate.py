@@ -34,6 +34,18 @@ def sample_logits(logits: jnp.ndarray, key, temperature: float = 1.0,
     logits-processor ordering does)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(
+        key, filter_logits(logits, temperature, top_k, top_p), axis=-1)
+
+
+def filter_logits(logits: jnp.ndarray, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """The warper half of :func:`sample_logits` without the draw:
+    temperature + top-k/top-p filtering, filtered-out entries at ``-inf``.
+    Split out so the serving engine (serve/engine.py) can draw with
+    PER-ROW keys — one key per request, making a request's samples
+    independent of which batch slot it rides in."""
     logits = logits / temperature
     if top_k is not None or top_p is not None:
         # ONE descending argsort serves both filters (each runs inside the
@@ -58,7 +70,7 @@ def sample_logits(logits: jnp.ndarray, key, temperature: float = 1.0,
         keep = jnp.zeros_like(keep_sorted).at[
             jnp.arange(logits.shape[0])[:, None], order].set(keep_sorted)
         logits = jnp.where(keep, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
 
 
 @partial(jax.jit, static_argnames=("decode_fn", "init_cache_fn", "max_new_tokens",
@@ -68,27 +80,44 @@ def generate(decode_fn, init_cache_fn, params, prompt: jnp.ndarray,
              max_new_tokens: int, *, key=None, temperature: float = 0.0,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              eos_id: Optional[int] = None,
-             pad_id: int = 0, max_len: Optional[int] = None) -> jnp.ndarray:
+             pad_id: int = 0, max_len: Optional[int] = None,
+             prompt_lens: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` continuations for ``prompt`` [B, T].
 
-    ``decode_fn(params, tokens, cache, pos) -> (logits, cache)`` and
-    ``init_cache_fn(batch, max_len) -> cache`` come from the model module
-    (``gpt2_decode``/``gpt2_init_cache`` or the llama pair, partially applied
-    over their config). Returns [B, max_new_tokens] token ids.
+    ``decode_fn(params, tokens, cache, pos[, offset]) -> (logits, cache)``
+    and ``init_cache_fn(batch, max_len) -> cache`` come from the model
+    module (``gpt2_decode``/``gpt2_init_cache`` or the llama pair, partially
+    applied over their config). Returns [B, max_new_tokens] token ids.
+
+    ``prompt_lens`` [B] enables batched variable-length prompts: each row
+    is LEFT-padded to T (pad tokens first, real tokens right-aligned so
+    row b's last prompt token sits at slot T-1 for every row), and the
+    per-row pad width ``T - prompt_lens`` flows to the model as the decode
+    offset — pad slots are masked out of attention and position ids count
+    from the first REAL token, so each row ATTENDS with solo semantics
+    (greedy outputs match solo runs exactly; sampled draws still share
+    one PRNG stream over the batch — per-request streams are the serving
+    engine's job, serve/engine.py).
     """
     B, T = prompt.shape
     total = max_len or (T + max_new_tokens)
     cache = init_cache_fn(B, total)
     key = key if key is not None else jax.random.key(0)
+    offset = None if prompt_lens is None else (T - prompt_lens).astype(jnp.int32)
 
-    logits, cache = decode_fn(params, prompt, cache, 0)  # prefill
+    def dec(params, toks, cache, pos):
+        if offset is None:
+            return decode_fn(params, toks, cache, pos)
+        return decode_fn(params, toks, cache, pos, offset)
+
+    logits, cache = dec(params, prompt, cache, 0)  # prefill
     tok = sample_logits(logits[:, -1], key, temperature, top_k, top_p)
     finished = jnp.zeros((B,), bool) if eos_id is None else tok == eos_id
 
     def step(carry, i):
         tok, cache, finished, key = carry
         key, sub = jax.random.split(key)
-        logits, cache = decode_fn(params, tok[:, None], cache, T + i)
+        logits, cache = dec(params, tok[:, None], cache, T + i)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(finished, pad_id, nxt)

@@ -220,7 +220,10 @@ def _qkv_project(x, w):
         delta = jnp.einsum("btr,rce->btce", xa, w.B.astype(x.dtype),
                            preferred_element_type=jnp.float32).astype(x.dtype)
         return base + w.scaling * delta
-    return jnp.einsum("btd,dce->btce", x, w.astype(x.dtype),
+    # maybe_dequant: NF4/int8 frozen-weight serving (ops/quant) — a
+    # QuantizedTensor in the qkv slot dequantizes into the matmul's
+    # producer fusion; dense weights pass through untouched
+    return jnp.einsum("btd,dce->btce", x, maybe_dequant(w, x.dtype).astype(x.dtype),
                       preferred_element_type=jnp.float32).astype(x.dtype)
 
 
@@ -519,10 +522,13 @@ def gpt2_init_cache(cfg: GPT2Config, batch: int, max_len: int) -> list:
     ]
 
 
-def _decode_attention(x, p, cfg: GPT2Config, c, pos):
+def _decode_attention(x, p, cfg: GPT2Config, c, pos, offset=None):
     """Cache-aware attention for S new tokens at absolute position ``pos``:
     project qkv for the new tokens, write k/v into the cache, attend q over
-    the whole (masked) cache."""
+    the whole (masked) cache. ``offset`` (optional [B] int32) is the
+    per-row count of left-pad slots in a batched, variable-length prompt
+    (cli/run_generate's multi-prompt mode): slots below it are masked out
+    of every row's attention, so the pad prefix never leaks into scores."""
     B, S, _ = x.shape
     H, hd = cfg.n_head, cfg.head_dim
     qkv = _qkv_project(x, p["qkv"]) + p["qkv_b"].astype(x.dtype)
@@ -533,7 +539,12 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos):
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k_cache,
                         preferred_element_type=jnp.float32) / math.sqrt(hd)
     valid = jnp.arange(T)[None, :] <= (pos + jnp.arange(S))[:, None]  # causal + unwritten
-    scores = jnp.where(valid[None, None], scores, -1e30)
+    if offset is None:
+        scores = jnp.where(valid[None, None], scores, -1e30)
+    else:
+        row_valid = valid[None] & (jnp.arange(T)[None, None, :]
+                                   >= offset[:, None, None])
+        scores = jnp.where(row_valid[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, v_cache,
                      preferred_element_type=jnp.float32).astype(x.dtype)
@@ -542,36 +553,137 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos):
     return out, {"k": k_cache, "v": v_cache}
 
 
-def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list, pos):
+def _decode_mlp(x, p, cfg: GPT2Config):
+    """The post-attention half of a decode block (dense MLP or the MoE
+    FFN with decode-friendly capacity) — shared by the dense-cache and
+    paged decode paths so their numerics cannot drift."""
+    if "moe" in p:  # MoE checkpoint: single-device routing, no collectives
+        from distributed_lion_tpu.parallel.expert import moe_ffn
+
+        B2, S2, D2 = x.shape
+        h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
+        # single-token decode steps (S=1) get no-drop capacity (a cap of
+        # ~B*1.25/E would drop colliding tokens systematically); prefill
+        # keeps the training capacity bound — cap=n there would size
+        # every expert's buffer to the full prompt (E x the memory)
+        y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
+                       axis_name=None,
+                       capacity_override=B2 * S2 if S2 == 1 else None)
+        return x + y.reshape(B2, S2, D2)
+    return x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+
+
+def _decode_embed(params, tokens, cfg: GPT2Config, pos, offset):
+    """Token + position embeddings for a decode chunk. Scalar ``pos``
+    slices wpe uniformly; with per-row ``offset`` (left-padded batch) each
+    row gathers its own shifted position ids (clipped at 0 — pad slots
+    reuse position 0, masked out of attention anyway). Both lookups route
+    through lora_embed/maybe_dequant so NF4-quantized tables serve."""
+    from distributed_lion_tpu.models.lora import lora_embed
+    from distributed_lion_tpu.ops.quant import maybe_dequant
+
+    B, S = tokens.shape
+    x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
+    if offset is None:
+        wpe = maybe_dequant(params["wpe"], cfg.compute_dtype)
+        return x + lax.dynamic_slice_in_dim(wpe, pos, S, axis=0).astype(
+            cfg.compute_dtype)
+    pos_ids = jnp.clip(pos + jnp.arange(S)[None, :] - offset[:, None],
+                       0, cfg.n_ctx - 1)
+    return x + lora_embed(params["wpe"], pos_ids, cfg.compute_dtype)
+
+
+def _tied_logits(x, params, cfg: GPT2Config):
+    from distributed_lion_tpu.ops.quant import maybe_dequant
+
+    logits = jnp.einsum("btd,vd->btv", x,
+                        maybe_dequant(params["wte"], x.dtype).astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[..., : cfg.vocab_size]
+
+
+def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
+                pos, offset=None):
     """Incremental forward: ``tokens`` [B, S] are the next S tokens at
-    absolute positions [pos, pos+S). Returns (logits [B, S, vocab] f32,
+    absolute cache slots [pos, pos+S). Returns (logits [B, S, vocab] f32,
     updated cache). ``gpt2_decode(params, prompt, cfg, cache, 0)`` is the
     prefill; single-token calls are the decode loop. Matches ``gpt2_apply``
-    logits position-for-position (pinned by tests/test_generate.py)."""
-    B, S = tokens.shape
-    x = params["wte"][tokens].astype(cfg.compute_dtype)
-    x = x + lax.dynamic_slice_in_dim(params["wpe"], pos, S, axis=0).astype(cfg.compute_dtype)
+    logits position-for-position (pinned by tests/test_generate.py).
+    ``offset`` [B]: per-row left-pad width for batched variable-length
+    prompts — row b's real tokens sit at slots >= offset[b] and get
+    position ids ``slot - offset[b]`` (solo semantics, shifted)."""
+    if offset is not None and any("moe" in p for p in params["blocks"]):
+        # left-pad tokens would be routed and consume expert capacity,
+        # displacing real tokens a solo run keeps — the batched outputs
+        # would silently diverge from solo runs
+        raise ValueError(
+            "left-padded batched decode is not supported for MoE "
+            "checkpoints (pad tokens would consume expert capacity); "
+            "generate MoE prompts one at a time")
+    x = _decode_embed(params, tokens, cfg, pos, offset)
     new_cache = []
     for p, c in zip(params["blocks"], cache):
-        a, c = _decode_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, c, pos)
-        x = x + a
-        if "moe" in p:  # MoE checkpoint: single-device routing, no collectives
-            from distributed_lion_tpu.parallel.expert import moe_ffn
-
-            B2, S2, D2 = x.shape
-            h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
-            # single-token decode steps (S=1) get no-drop capacity (a cap of
-            # ~B*1.25/E would drop colliding tokens systematically); prefill
-            # keeps the training capacity bound — cap=n there would size
-            # every expert's buffer to the full prompt (E x the memory)
-            y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                           axis_name=None,
-                           capacity_override=B2 * S2 if S2 == 1 else None)
-            x = x + y.reshape(B2, S2, D2)
-        else:
-            x = x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+        a, c = _decode_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, c,
+                                 pos, offset)
+        x = _decode_mlp(x + a, p, cfg)
         new_cache.append(c)
     x = _layer_norm(x, params["ln_f"])
-    logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(x.dtype),
-                        preferred_element_type=jnp.float32)
-    return logits[..., : cfg.vocab_size], new_cache
+    return _tied_logits(x, params, cfg), new_cache
+
+
+def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid):
+    """The paged twin of :func:`_decode_attention`: scatter the new k/v
+    into block-table pages, attend over the gathered history
+    (ops.attention.paged_decode_attention — same masked-softmax chain as
+    the dense path, so greedy decode is bit-identical when T matches)."""
+    from distributed_lion_tpu.ops.attention import (
+        paged_decode_attention,
+        paged_scatter_kv,
+    )
+
+    B, S, _ = x.shape
+    H, hd = cfg.n_head, cfg.head_dim
+    qkv = _qkv_project(x, p["qkv"]) + p["qkv_b"].astype(x.dtype)
+    q, k, v = (qkv[:, :, i].reshape(B, S, H, hd) for i in range(3))
+    k_pages = paged_scatter_kv(c["k"], tables, pos, k.astype(c["k"].dtype), valid)
+    v_pages = paged_scatter_kv(c["v"], tables, pos, v.astype(c["v"].dtype), valid)
+    out = paged_decode_attention(q.transpose(0, 2, 1, 3), k_pages, v_pages,
+                                 tables, pos)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    out = _proj(out, p["proj"]) + p["proj_b"].astype(x.dtype)
+    return out, {"k": k_pages, "v": v_pages}
+
+
+def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
+                      pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
+                      valid=None):
+    """Block-table decode (the serving engine's model hook): ``tokens``
+    [B, S] where row b's tokens sit at absolute positions
+    ``pos[b] .. pos[b]+S-1`` of its own sequence; ``pages`` is the
+    per-layer page pool ({"k","v"} of [num_blocks, block_size, H, hd]),
+    ``tables`` [B, blocks_per_seq] the per-row block tables, ``valid``
+    optional [B, S] (False = right-pad tail of a bucketed prefill — no
+    page write, logits discarded by the caller). Returns (logits
+    [B, S, vocab] f32, updated pages). Positions are PER ROW, so one call
+    serves prefill (S = padded prompt, pos = 0) and the rolling decode
+    tick (S = 1, pos = per-slot lengths) — one jitted program each."""
+    if any("moe" in p for p in params["blocks"]):
+        # see ServeModel.for_gpt2: a padded prefill routes pad tokens
+        # through expert capacity, silently breaking bit-identity
+        raise ValueError(
+            "paged decode does not support MoE checkpoints yet (pad tokens "
+            "would consume expert capacity in the bucketed prefill)")
+    pos_ids = jnp.clip(pos[:, None] + jnp.arange(tokens.shape[1])[None, :],
+                       0, cfg.n_ctx - 1)
+    from distributed_lion_tpu.models.lora import lora_embed
+
+    x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
+    x = x + lora_embed(params["wpe"], pos_ids, cfg.compute_dtype)
+    new_pages = []
+    for p, c in zip(params["blocks"], pages):
+        a, c = _paged_attention_block(_layer_norm(x, p["ln_1"]), p["attn"],
+                                      cfg, c, tables, pos, valid)
+        x = _decode_mlp(x + a, p, cfg)
+        new_pages.append(c)
+    x = _layer_norm(x, params["ln_f"])
+    return _tied_logits(x, params, cfg), new_pages

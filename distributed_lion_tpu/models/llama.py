@@ -149,10 +149,16 @@ def rope_angles(t: int, head_dim: int, theta: float, offset=0) -> tuple:
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
     """x: [B, H, T, hd]; rotate pairs (even, odd) — the interleaved
-    formulation."""
+    formulation. cos/sin are [T, hd/2] (one position track shared by the
+    batch) or [B, T, hd/2] (per-row position tracks: the paged decode tick
+    and left-padded batched generation gather each row its own angles)."""
     x1, x2 = x[..., 0::2], x[..., 1::2]
-    c = cos[None, None, :, :].astype(x.dtype)
-    s = sin[None, None, :, :].astype(x.dtype)
+    if cos.ndim == 3:
+        c = cos[:, None, :, :].astype(x.dtype)
+        s = sin[:, None, :, :].astype(x.dtype)
+    else:
+        c = cos[None, None, :, :].astype(x.dtype)
+        s = sin[None, None, :, :].astype(x.dtype)
     out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
     return out.reshape(x.shape)
 
@@ -239,7 +245,11 @@ def llama_init_cache(cfg: LlamaConfig, batch: int, max_len: int) -> list:
     ]
 
 
-def _decode_attention(x, p, cfg: LlamaConfig, c, pos, cos, sin):
+def _decode_attention(x, p, cfg: LlamaConfig, c, pos, cos, sin, offset=None):
+    """``offset`` (optional [B] int32): per-row left-pad width in a
+    batched variable-length prompt — cache slots below it are masked out
+    of that row's attention (cli/run_generate multi-prompt mode; the rope
+    angles are already per-row-shifted by the caller)."""
     B, S, _ = x.shape
     H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
     q = _matmul(x, p["wq"]).reshape(B, S, H, hd).transpose(0, 2, 1, 3)
@@ -256,7 +266,12 @@ def _decode_attention(x, p, cfg: LlamaConfig, c, pos, cos, sin):
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k_full,
                         preferred_element_type=jnp.float32) / math.sqrt(hd)
     valid = jnp.arange(T)[None, :] <= (pos + jnp.arange(S))[:, None]
-    scores = jnp.where(valid[None, None], scores, -1e30)
+    if offset is None:
+        scores = jnp.where(valid[None, None], scores, -1e30)
+    else:
+        row_valid = valid[None] & (jnp.arange(T)[None, None, :]
+                                   >= offset[:, None, None])
+        scores = jnp.where(row_valid[:, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, v_full,
                      preferred_element_type=jnp.float32).astype(x.dtype)
@@ -264,10 +279,20 @@ def _decode_attention(x, p, cfg: LlamaConfig, c, pos, cos, sin):
     return _matmul(out, p["wo"]), {"k": k_cache, "v": v_cache}
 
 
-def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: list, pos):
+def _head_logits(x, params):
+    return jnp.einsum("btd,dv->btv", x,
+                      maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: list,
+                 pos, offset=None):
     """Incremental forward with rotary offset: prefill with the prompt at
     pos=0, then one token at a time. Matches ``llama_apply`` logits
-    position-for-position (tests/test_generate.py)."""
+    position-for-position (tests/test_generate.py). ``offset`` [B]: per-row
+    left-pad width for batched variable-length prompts — row b's tokens at
+    cache slot t get rotary position ``t - offset[b]`` and never attend
+    below slot ``offset[b]`` (solo semantics, shifted into the batch)."""
     B, S = tokens.shape
     from distributed_lion_tpu.models.lora import lora_embed
 
@@ -275,19 +300,79 @@ def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: lis
     # rope tables at the absolute positions of these S tokens: build a
     # max-length table once and slice at pos (pos is traced under jit)
     cos_all, sin_all = rope_angles(cache[0]["k"].shape[2], cfg.head_dim, cfg.rope_theta)
-    cos = jax.lax.dynamic_slice_in_dim(cos_all, pos, S, axis=0)
-    sin = jax.lax.dynamic_slice_in_dim(sin_all, pos, S, axis=0)
+    if offset is None:
+        cos = jax.lax.dynamic_slice_in_dim(cos_all, pos, S, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin_all, pos, S, axis=0)
+    else:
+        pos_ids = jnp.clip(pos + jnp.arange(S)[None, :] - offset[:, None],
+                           0, cos_all.shape[0] - 1)
+        cos, sin = cos_all[pos_ids], sin_all[pos_ids]  # [B, S, hd/2]
     new_cache = []
     for p, c in zip(params["blocks"], cache):
         a, c = _decode_attention(_rms_norm(x, p["ln_attn"], cfg.rms_eps), p["attn"],
-                                 cfg, c, pos, cos, sin)
+                                 cfg, c, pos, cos, sin, offset)
         x = x + a
         x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
         new_cache.append(c)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
-    logits = jnp.einsum("btd,dv->btv", x, maybe_dequant(params["lm_head"], x.dtype).astype(x.dtype),
-                        preferred_element_type=jnp.float32)
-    return logits, new_cache
+    return _head_logits(x, params), new_cache
+
+
+def _paged_attention_block(x, p, cfg: LlamaConfig, c, tables, pos, cos, sin,
+                           valid):
+    """The paged twin of :func:`_decode_attention` (serve/kv_cache layout):
+    scatter the roped new k (and v) into block-table pages, attend over
+    the gathered history via ops.attention.paged_decode_attention — the
+    same masked-softmax chain, so greedy decode is bit-identical to the
+    dense cache whenever the attended length matches."""
+    from distributed_lion_tpu.ops.attention import (
+        paged_decode_attention,
+        paged_scatter_kv,
+    )
+
+    B, S, _ = x.shape
+    H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    q = _matmul(x, p["wq"]).reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = _matmul(x, p["wk"]).reshape(B, S, KV, hd).transpose(0, 2, 1, 3)
+    v = _matmul(x, p["wv"]).reshape(B, S, KV, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin).transpose(0, 2, 1, 3)  # back to [B, S, KV, hd]
+    k_pages = paged_scatter_kv(c["k"], tables, pos, k.astype(c["k"].dtype), valid)
+    v_pages = paged_scatter_kv(c["v"], tables, pos, v.astype(c["v"].dtype), valid)
+    out = paged_decode_attention(q, k_pages, v_pages, tables, pos)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
+    return _matmul(out, p["wo"]), {"k": k_pages, "v": v_pages}
+
+
+def llama_decode_paged(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
+                       pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
+                       valid=None):
+    """Block-table decode (the serving engine's model hook): row b's
+    ``tokens`` [B, S] sit at positions ``pos[b] .. pos[b]+S-1`` of its own
+    sequence (rotary angles gathered per row); ``pages`` is the per-layer
+    {"k","v"} pool of [num_blocks, block_size, n_kv_head, hd] (GQA: pages
+    store kv heads un-repeated, like the dense cache). Returns (logits
+    [B, S, vocab] f32, updated pages). One jitted program serves both the
+    bucketed prefill (S = padded prompt, ``valid`` masks the tail) and the
+    rolling decode tick (S = 1, pos = per-slot lengths)."""
+    B, S = tokens.shape
+    from distributed_lion_tpu.models.lora import lora_embed
+
+    x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
+    max_pos = tables.shape[1] * pages[0]["k"].shape[1]
+    cos_all, sin_all = rope_angles(max_pos, cfg.head_dim, cfg.rope_theta)
+    pos_ids = jnp.clip(pos[:, None] + jnp.arange(S)[None, :], 0, max_pos - 1)
+    cos, sin = cos_all[pos_ids], sin_all[pos_ids]  # [B, S, hd/2]
+    new_pages = []
+    for p, c in zip(params["blocks"], pages):
+        a, c = _paged_attention_block(_rms_norm(x, p["ln_attn"], cfg.rms_eps),
+                                      p["attn"], cfg, c, tables, pos, cos, sin,
+                                      valid)
+        x = x + a
+        x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
+        new_pages.append(c)
+    x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
+    return _head_logits(x, params), new_pages
 
 
 def llama_hidden(

@@ -147,6 +147,97 @@ def attention_splash(q, k, v, *, causal: bool = True,
     return out.astype(q.dtype)
 
 
+# ------------------------------------------------------------- paged decode
+# The serving engine's KV layout (serve/kv_cache.py, vLLM's PagedAttention
+# design): each layer's cache is a fixed pool of pages [num_blocks,
+# block_size, kv_heads, head_dim]; a sequence owns an ordered list of page
+# indices (its block table). Allocation/free is HOST-side table math — the
+# device functions below are pure static-shape gathers/scatters, so the
+# decode tick stays one jitted program no matter how sequences come and go.
+# The sentinel block index == num_blocks (one past the pool) makes unused
+# table entries inert: scatters drop out-of-range writes, gathers fill 0.
+
+
+def paged_scatter_kv(pages: jnp.ndarray, tables: jnp.ndarray,
+                     pos: jnp.ndarray, new: jnp.ndarray,
+                     valid=None) -> jnp.ndarray:
+    """Write per-row new k (or v) rows into their block-table pages.
+
+    pages  [num_blocks, block_size, KV, hd] — one layer's pool (k or v);
+    tables [B, blocks_per_seq] int32 page ids (sentinel = num_blocks);
+    pos    [B] int32 — absolute position of each row's FIRST new token;
+    new    [B, S, KV, hd] — the S new tokens' projections per row;
+    valid  optional [B, S] bool — False entries are dropped (right-padded
+    prefill tails must not write garbage pages).
+
+    Token s of row b lands in page ``tables[b, (pos[b]+s)//block_size]`` at
+    offset ``(pos[b]+s) % block_size``. Rows whose table entry is the
+    sentinel (never allocated — e.g. an inactive decode slot) scatter out
+    of range and are dropped by XLA's scatter mode, not branched on.
+    """
+    B, S = new.shape[:2]
+    bs = pages.shape[1]
+    abs_pos = pos[:, None] + jnp.arange(S, dtype=pos.dtype)[None, :]  # [B,S]
+    blk = jnp.take_along_axis(tables, abs_pos // bs, axis=1,
+                              mode="clip")  # sentinel rides the VALUE
+    if valid is not None:
+        # out-of-range page id ⇒ the scatter drops the write
+        blk = jnp.where(valid, blk, pages.shape[0])
+    off = abs_pos % bs
+    flat = new.reshape((B * S,) + new.shape[2:])
+    return pages.at[blk.reshape(-1), off.reshape(-1)].set(
+        flat, mode="drop", unique_indices=False)
+
+
+def paged_gather_kv(pages: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
+    """[num_blocks, bs, KV, hd] pool + [B, nb] tables → [B, nb*bs, KV, hd]
+    contiguous per-row history (sentinel pages read as zeros — they are
+    masked out of attention by the caller's position bound anyway)."""
+    B, nb = tables.shape
+    bs = pages.shape[1]
+    got = jnp.take(pages, tables, axis=0, mode="fill", fill_value=0)
+    return got.reshape((B, nb * bs) + pages.shape[2:])
+
+
+def paged_decode_attention(q, k_pages, v_pages, tables, pos,
+                           start=None):
+    """Decode attention over a paged KV cache (new k/v already scattered).
+
+    q [B, H, S, hd] — queries for the S newest tokens of each row (rope
+    already applied by the model); k_pages/v_pages [num_blocks, bs, KV, hd];
+    tables [B, nb]; pos [B] — absolute position of each row's first new
+    token; ``start`` optional [B] — first VALID history slot (left-padded
+    batches mask the pad prefix). Returns [B, H, S, hd] in q's dtype.
+
+    The gather reassembles each row's history into the SAME contiguous
+    [B, T, KV, hd] layout the dense cache holds, then runs the identical
+    masked-softmax einsum chain — so greedy decode through pages is
+    bit-identical to the dense path whenever T matches (pinned by
+    tests/test_serve.py). GQA kv heads are repeated at attend time, exactly
+    like the dense caches store them un-repeated.
+    """
+    B, H, S, hd = q.shape
+    KV = k_pages.shape[2]
+    k_full = paged_gather_kv(k_pages, tables).transpose(0, 2, 1, 3)
+    v_full = paged_gather_kv(v_pages, tables).transpose(0, 2, 1, 3)
+    if KV != H:
+        rep = H // KV
+        k_full = jnp.repeat(k_full, rep, axis=1)
+        v_full = jnp.repeat(v_full, rep, axis=1)
+    T = k_full.shape[2]
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k_full,
+                        preferred_element_type=jnp.float32) / math.sqrt(hd)
+    t_idx = jnp.arange(T)[None, None, :]
+    valid = t_idx <= (pos[:, None] + jnp.arange(S)[None, :])[:, :, None]
+    if start is not None:
+        valid &= t_idx >= start[:, None, None]
+    scores = jnp.where(valid[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, v_full,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
 def parse_attn_spec(spec: str) -> tuple[str, int, int, int, int]:
     """Parse an attention spec ``impl[@BQxBKV[@BQBxBKVB]]`` into
     ``(impl, block_q, block_kv, block_q_bwd, block_kv_bwd)`` — e.g.

@@ -1,0 +1,20 @@
+"""Serving subsystem: continuous batching over a paged KV cache.
+
+The first net-new runtime beside the trainer (ROADMAP item 4): the
+reference repo trains but never serves; this package decodes vote-Lion
+checkpoints at production batch sizes on the same stack that trained them.
+
+- ``kv_cache``  — host-side page allocator + block tables (pure table
+  math; the device pool lives in ``ops.attention``'s paged primitives)
+- ``engine``    — admission scheduler + prefill/decode tick loop
+- ``api``       — request-file front end (offline mode for CI)
+"""
+
+from distributed_lion_tpu.serve.engine import (  # noqa: F401
+    Completion,
+    Request,
+    ServeConfig,
+    ServeModel,
+    ServingEngine,
+)
+from distributed_lion_tpu.serve.kv_cache import BlockTables, init_pages  # noqa: F401

@@ -1,0 +1,85 @@
+"""Request/response front end for the serving engine.
+
+Offline request-file mode (the CI-friendly surface): a JSONL file of
+requests in, a JSONL file of responses out — the same strict-JSON
+discipline as every other artifact (scripts/validate_metrics.py). Each
+request line:
+
+    {"id": "r1", "prompt": "Hello", "max_new_tokens": 32,
+     "seed": 0, "arrival_tick": 0}
+
+``prompt`` (text, run through the tokenizer) or ``tokens`` (explicit ids)
+— one of the two is required. ``arrival_tick`` staggers admission for
+continuous-batching runs (default 0 = all at start). Response lines carry
+the request id, the generated ids/text, and the finish reason::
+
+    {"id": "r1", "text": "...", "tokens": [...], "reason": "eos",
+     "prompt_len": 5, "n_generated": 12}
+
+A socket mode can ride the same :func:`handle_requests` core later; the
+offline mode is what CI and the decode bench gate on.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from distributed_lion_tpu.serve.engine import Completion, Request, ServingEngine
+
+
+def load_request_file(path: str, tokenizer=None
+                      ) -> Tuple[List[Request], Dict[Any, int]]:
+    """Parse a request JSONL into engine requests + arrival schedule.
+    Raises on a request with neither ``tokens`` nor (``prompt`` + a
+    tokenizer) — a silently-dropped request must not look served."""
+    requests: List[Request] = []
+    arrivals: Dict[Any, int] = {}
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            rid = d.get("id", f"req{i}")
+            if "tokens" in d:
+                toks = [int(t) for t in d["tokens"]]
+            elif "prompt" in d and tokenizer is not None:
+                toks = tokenizer.encode(d["prompt"], add_bos=False) or [0]
+            else:
+                raise ValueError(
+                    f"{path}:{i}: request needs 'tokens' or 'prompt' "
+                    "(with a tokenizer)")
+            requests.append(Request(
+                req_id=rid, tokens=list(toks),
+                max_new_tokens=d.get("max_new_tokens"),
+                seed=int(d.get("seed", 0))))
+            arrivals[rid] = int(d.get("arrival_tick", 0))
+    return requests, arrivals
+
+
+def completion_record(c: Completion, tokenizer=None) -> dict:
+    rec = {"id": c.req_id, "tokens": list(c.tokens), "reason": c.reason,
+           "prompt_len": c.prompt_len, "n_generated": len(c.tokens)}
+    if tokenizer is not None:
+        rec["text"] = tokenizer.decode([int(t) for t in c.tokens])
+    return rec
+
+
+def handle_requests(engine: ServingEngine, requests: List[Request],
+                    arrivals: Optional[Dict[Any, int]] = None,
+                    tokenizer=None) -> List[dict]:
+    """Drive the engine over a workload; response records in request
+    order (an unserved id would be loudly missing, not silently skipped)."""
+    done = engine.run(requests, arrivals or {})
+    return [completion_record(done[r.req_id], tokenizer) for r in requests]
+
+
+def serve_request_file(engine: ServingEngine, in_path: str, out_path: str,
+                       tokenizer=None) -> List[dict]:
+    requests, arrivals = load_request_file(in_path, tokenizer)
+    records = handle_requests(engine, requests, arrivals, tokenizer)
+    with open(out_path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, allow_nan=False) + "\n")
+    return records

@@ -1,0 +1,418 @@
+"""Continuous-batching inference engine over the paged KV cache.
+
+The serving counterpart of ``train/loop.py`` (ROADMAP item 4): requests
+join a rolling batch on arrival, leave on EOS/length/overflow, and every
+tick is ONE device dispatch — either a bucketed prefill or a decode step
+over all active slots. The host's only per-tick work is table math
+(serve/kv_cache.py) and reading back the tick's sampled tokens as one
+array; there is no per-token host sync inside a tick (graft-check DLT001
+pins the forbidden shape, tests/fixtures/analysis/serve/).
+
+Scheduling (the vLLM recipe, simplified to two tick kinds):
+
+- **admit** — pending requests take a free slot while pages fit, subject
+  to a fairness cap on prefill tokens per engine tick
+  (``prefill_cap_tokens``): a burst of long prompts cannot starve the
+  decode batch for more than one tick.
+- **prefill** — one dispatch per admitted request at a power-of-two
+  bucketed length (a handful of compiles total, never per-prompt), tail
+  masked via the scatter's ``valid`` lanes; samples the request's first
+  token inside the same dispatch.
+- **decode tick** — one dispatch advancing EVERY active slot one token:
+  block-table decode (``*_decode_paged``) + per-slot sampling. Per-slot
+  PRNG keys are ``fold_in(key(request.seed), generated_index)`` — a
+  request's sample stream depends only on the request, NOT on which slot
+  it rides or who shares the batch, which is what makes a staggered
+  continuous-batching run produce outputs identical to solo runs
+  (tests/test_serve.py pins it).
+- **evict** — EOS / ``max_new_tokens`` / cache-overflow slots free their
+  pages; the block table row goes back to sentinel, so the next decode
+  tick simply ignores the slot (no recompile, the shapes never changed).
+
+NF4/int8 frozen-weight serving: ``quant='nf4'`` re-packs the dense
+checkpoint through ``ops.quant.quantize_tree`` once at engine build; the
+decode paths dequantize inside each matmul's producer fusion
+(``maybe_dequant``), so a 7B checkpoint serves from ~0.5 byte/param of
+HBM plus the page pool.
+
+Journal spans (``serve/admit``, ``serve/prefill``, ``serve/decode_tick``,
+``serve/evict``) ride the PR-7 run journal when one is installed
+(train/journal.install), giving ``cli/run_analyze`` a per-tick timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from distributed_lion_tpu.serve.kv_cache import BlockTables, init_pages
+from distributed_lion_tpu.train import journal
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    max_seqs: int = 8            # rolling-batch width (decode slots)
+    block_size: int = 16         # tokens per KV page
+    max_blocks_per_seq: int = 8  # block-table width; per-seq cap =
+    #                              block_size * max_blocks_per_seq tokens
+    num_blocks: int = 0          # page-pool size; 0 = auto
+    #                              (max_seqs * max_blocks_per_seq: no slot
+    #                              can starve another at full occupancy)
+    prefill_cap_tokens: int = 512  # fairness cap: max PADDED prefill
+    #                              tokens admitted per engine tick (a
+    #                              single over-cap prompt still admits
+    #                              when the tick has admitted nothing —
+    #                              caps must not livelock)
+    max_new_tokens: int = 64     # per-request default budget
+    temperature: float = 0.0     # 0 = greedy; sampling knobs are engine-
+    top_k: Optional[int] = None  # static (one compiled tick), seeds are
+    top_p: Optional[float] = None  # per-request
+    quant: str = "none"          # none | nf4 | int8 frozen-weight serving
+    eos_id: Optional[int] = None
+
+    def resolved_num_blocks(self) -> int:
+        return self.num_blocks or self.max_seqs * self.max_blocks_per_seq
+
+
+@dataclasses.dataclass
+class Request:
+    req_id: Any
+    tokens: List[int]                    # prompt token ids (non-empty)
+    max_new_tokens: Optional[int] = None  # None = engine default
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Completion:
+    req_id: Any
+    prompt_len: int
+    tokens: List[int]    # generated ids (EOS included when emitted)
+    reason: str          # eos | length | overflow | rejected
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    budget: int          # max new tokens for this request
+    cache_len: int       # tokens whose k/v are in the pages
+    last_tok: int        # newest sampled token (not yet in the cache)
+    gen: List[int] = dataclasses.field(default_factory=list)
+
+
+class ServeModel:
+    """Family adapter: the paged decode hook + cache geometry the engine
+    needs, built from a (params, config) pair. ``decode_paged(params,
+    tokens, pages, tables, pos, valid)`` must return ``(logits [B,S,V]
+    f32, pages')`` — models/gpt2.gpt2_decode_paged and
+    models/llama.llama_decode_paged are the two implementations."""
+
+    def __init__(self, family: str, cfg: Any, params: Any,
+                 decode_paged: Callable, n_layer: int, kv_heads: int,
+                 head_dim: int, cache_dtype: Any,
+                 max_positions: Optional[int] = None):
+        self.family = family
+        self.cfg = cfg
+        self.params = params
+        self.decode_paged = decode_paged
+        self.n_layer = n_layer
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.cache_dtype = cache_dtype
+        # the model's position budget (gpt2: learned wpe rows; llama's
+        # rope extrapolates but n_ctx is still the trained horizon) — the
+        # engine refuses a page geometry that would silently alias/exceed
+        self.max_positions = max_positions
+
+    @staticmethod
+    def for_gpt2(params: Any, cfg: Any) -> "ServeModel":
+        from distributed_lion_tpu.models.gpt2 import gpt2_decode_paged
+
+        if getattr(cfg, "moe_experts", 0) > 0:
+            # a bucketed (right-padded) prefill would route pad tokens
+            # through the experts' fixed-capacity buffers, displacing real
+            # tokens a solo run keeps — silently breaking the engine's
+            # bit-identity guarantees. Refuse until the MoE decode path
+            # masks pads out of routing.
+            raise ValueError(
+                "MoE checkpoints are not supported by the paged serving "
+                "engine yet (pad tokens would consume expert capacity in "
+                "the bucketed prefill); serve a dense checkpoint or use "
+                "single-shot run_generate")
+
+        def decode(p, toks, pages, tables, pos, valid=None):
+            return gpt2_decode_paged(p, toks, cfg, pages, tables, pos, valid)
+
+        return ServeModel("gpt2", cfg, params, decode, cfg.n_layer,
+                          cfg.n_head, cfg.head_dim, cfg.compute_dtype,
+                          max_positions=cfg.n_ctx)
+
+    @staticmethod
+    def for_llama(params: Any, cfg: Any) -> "ServeModel":
+        from distributed_lion_tpu.models.llama import llama_decode_paged
+
+        def decode(p, toks, pages, tables, pos, valid=None):
+            return llama_decode_paged(p, toks, cfg, pages, tables, pos, valid)
+
+        return ServeModel("llama", cfg, params, decode, cfg.n_layer,
+                          cfg.n_kv_head, cfg.head_dim, cfg.compute_dtype,
+                          max_positions=cfg.n_ctx)
+
+
+def weight_bytes(params: Any) -> int:
+    """Actual storage bytes of a (possibly quantized) weight tree —
+    QuantizedTensor leaves count packed codes + absmax scales, dense
+    leaves their array bytes. The bench's NF4-vs-bf16 column."""
+    import jax
+
+    from distributed_lion_tpu.ops.quant import QuantizedTensor
+
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedTensor)):
+        if isinstance(leaf, QuantizedTensor):
+            total += leaf.codes.size * leaf.codes.dtype.itemsize
+            total += leaf.absmax.size * leaf.absmax.dtype.itemsize
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def _sample_rows(logits, seeds, counts, temperature: float,
+                 top_k: Optional[int], top_p: Optional[float]):
+    """[B, V] logits → [B] tokens with PER-ROW keys derived from
+    (request seed, generated-token index) — slot- and batch-independent
+    draws (see module doc). Greedy when ``temperature == 0``."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_lion_tpu.models.generate import filter_logits
+
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    keys = jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c))(seeds, counts)
+    return jax.vmap(jax.random.categorical)(keys, filtered)
+
+
+class ServingEngine:
+    """See module doc. Host-side driver: ``submit`` requests, call
+    ``step()`` per tick (or ``run()`` to drain a workload), collect
+    :class:`Completion`s."""
+
+    def __init__(self, model: ServeModel, cfg: ServeConfig):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.cfg = cfg
+        params = model.params
+        if cfg.quant not in ("none", "nf4", "int8"):
+            raise ValueError(f"unknown quant mode {cfg.quant!r}")
+        if cfg.quant != "none":
+            from distributed_lion_tpu.ops.quant import quantize_tree
+
+            params = quantize_tree(params, cfg.quant)
+        self.params = params
+        horizon = cfg.block_size * cfg.max_blocks_per_seq
+        if model.max_positions is not None and horizon > model.max_positions:
+            raise ValueError(
+                f"page geometry allows {horizon} tokens/seq but the model's "
+                f"position budget is {model.max_positions} (n_ctx); shrink "
+                "--block_size/--max_blocks_per_seq — positions past the "
+                "trained horizon would silently alias")
+        self.tables = BlockTables(cfg.resolved_num_blocks(), cfg.block_size,
+                                  cfg.max_seqs, cfg.max_blocks_per_seq)
+        self.pages = init_pages(model.n_layer, cfg.resolved_num_blocks(),
+                                cfg.block_size, model.kv_heads,
+                                model.head_dim, model.cache_dtype)
+        self.slots: List[Optional[_Slot]] = [None] * cfg.max_seqs
+        self.pending: deque = deque()
+        self.stats = {"ticks": 0, "decode_ticks": 0, "prefill_dispatches": 0,
+                      "decode_tokens": 0, "prefill_tokens": 0,
+                      "padded_prefill_tokens": 0, "evictions": 0}
+
+        # page donation halves the pool's HBM traffic on TPU; the CPU
+        # backend has no donation and would warn every tick
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        samp = (cfg.temperature, cfg.top_k, cfg.top_p)
+
+        def decode_tick(params, pages, tables, lens, last, seeds, counts):
+            logits, pages = model.decode_paged(params, last[:, None], pages,
+                                               tables, lens)
+            return _sample_rows(logits[:, -1], seeds, counts, *samp), pages
+
+        def prefill(params, pages, tables, toks, length, seed, count):
+            valid = jnp.arange(toks.shape[1])[None, :] < length
+            pos = jnp.zeros((1,), jnp.int32)
+            logits, pages = model.decode_paged(params, toks, pages, tables,
+                                               pos, valid)
+            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                                keepdims=False)
+            tok = _sample_rows(last[None], seed[None], count[None], *samp)
+            return tok[0], pages
+
+        self._decode_tick = jax.jit(decode_tick, donate_argnums=donate)
+        self._prefill = jax.jit(prefill, donate_argnums=donate)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, req: Request) -> None:
+        self.pending.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.pending) or any(s is not None for s in self.slots)
+
+    def _bucket(self, n: int) -> int:
+        """Padded prefill length: power-of-two pages, so prompt-length
+        variety costs O(log(max)) compiles, not one per length."""
+        bs = self.cfg.block_size
+        blocks = 1
+        while blocks * bs < n:
+            blocks *= 2
+        return min(blocks, self.cfg.max_blocks_per_seq) * bs
+
+    # -------------------------------------------------------------- ticks
+    def _admit(self, completions: List[Completion]) -> None:
+        import jax.numpy as jnp
+
+        budget = self.cfg.prefill_cap_tokens
+        admitted = 0
+        jrnl = journal.active()
+        while self.pending:
+            req = self.pending[0]
+            L = len(req.tokens)
+            if L == 0 or L > self.tables.max_tokens_per_seq - 1:
+                # -1: a prompt must leave room for one decode write
+                self.pending.popleft()
+                completions.append(Completion(req.req_id, L, [], "rejected"))
+                continue
+            P = self._bucket(L)
+            if admitted and P > budget:
+                break  # fairness cap — but never starve an empty tick
+            slot = self.tables.find_free_slot()
+            if slot is None or not self.tables.grow(slot, L + 1):
+                break  # no slot/pages: wait for evictions
+            self.pending.popleft()
+            with jrnl.span("serve/prefill", req_id=str(req.req_id),
+                           prompt_len=L, padded=P, slot=slot):
+                toks = np.zeros((1, P), np.int32)
+                toks[0, :L] = req.tokens
+                tok, self.pages = self._prefill(
+                    self.params, self.pages,
+                    jnp.asarray(self.tables.tables[slot:slot + 1]),
+                    jnp.asarray(toks), jnp.int32(L),
+                    jnp.uint32(req.seed), jnp.int32(0))
+                first = int(tok)  # ONE host sync per prefill dispatch
+            budget -= P
+            admitted += 1
+            self.stats["prefill_dispatches"] += 1
+            self.stats["prefill_tokens"] += L
+            self.stats["padded_prefill_tokens"] += P
+            slot_state = _Slot(req=req, cache_len=L, last_tok=first,
+                               budget=(req.max_new_tokens
+                                       or self.cfg.max_new_tokens))
+            slot_state.gen.append(first)
+            self.slots[slot] = slot_state
+            self._maybe_finish(slot, completions)
+
+    def _maybe_finish(self, slot: int, completions: List[Completion],
+                      overflow: bool = False) -> None:
+        s = self.slots[slot]
+        reason = None
+        if overflow:
+            reason = "overflow"
+        elif self.cfg.eos_id is not None and s.gen and \
+                s.gen[-1] == self.cfg.eos_id:
+            reason = "eos"
+        elif len(s.gen) >= s.budget:
+            reason = "length"
+        if reason is None:
+            return
+        with journal.active().span("serve/evict", req_id=str(s.req.req_id),
+                                   slot=slot, reason=reason,
+                                   n_generated=len(s.gen)):
+            self.tables.free_slot(slot)
+            self.slots[slot] = None
+            self.stats["evictions"] += 1
+        completions.append(
+            Completion(s.req.req_id, len(s.req.tokens), list(s.gen), reason))
+
+    def _decode(self, completions: List[Completion]) -> None:
+        import jax.numpy as jnp
+
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return
+        # grow tables for the tick's ONE write per active slot; a slot the
+        # pool can't grow is evicted as overflow (truncated output) so the
+        # rest of the batch keeps moving
+        for i in list(active):
+            if not self.tables.grow(i, self.slots[i].cache_len + 1):
+                self._maybe_finish(i, completions, overflow=True)
+                active.remove(i)
+        if not active:
+            return
+        S = self.cfg.max_seqs
+        lens = np.zeros((S,), np.int32)
+        last = np.zeros((S,), np.int32)
+        seeds = np.zeros((S,), np.uint32)
+        counts = np.zeros((S,), np.int32)
+        for i in active:
+            s = self.slots[i]
+            lens[i] = s.cache_len
+            last[i] = s.last_tok
+            seeds[i] = s.req.seed
+            counts[i] = len(s.gen)  # index of the token being sampled
+        with journal.active().span("serve/decode_tick", batch=len(active)):
+            toks, self.pages = self._decode_tick(
+                self.params, self.pages, jnp.asarray(self.tables.tables),
+                jnp.asarray(lens), jnp.asarray(last), jnp.asarray(seeds),
+                jnp.asarray(counts))
+            toks = np.asarray(toks)  # ONE host sync for the whole batch
+        self.stats["decode_ticks"] += 1
+        self.stats["decode_tokens"] += len(active)
+        for i in active:
+            s = self.slots[i]
+            s.cache_len += 1
+            s.last_tok = int(toks[i])
+            s.gen.append(int(toks[i]))
+            self._maybe_finish(i, completions)
+
+    def step(self) -> List[Completion]:
+        """One engine tick: admit/prefill under the fairness cap, then one
+        decode dispatch over the rolling batch. Returns the requests that
+        finished this tick."""
+        completions: List[Completion] = []
+        self.stats["ticks"] += 1
+        with journal.active().span("serve/admit",
+                                   pending=len(self.pending)):
+            self._admit(completions)
+        self._decode(completions)
+        return completions
+
+    # ---------------------------------------------------------- the driver
+    def run(self, requests: List[Request],
+            arrivals: Optional[Dict[Any, int]] = None,
+            max_ticks: int = 100_000) -> Dict[Any, Completion]:
+        """Drain a workload: ``arrivals`` maps req_id → engine tick at
+        which the request becomes visible (default: all at tick 0) — the
+        staggered-arrival harness the continuous-batching tests drive."""
+        arrivals = arrivals or {}
+        todo = sorted(requests, key=lambda r: arrivals.get(r.req_id, 0))
+        out: Dict[Any, Completion] = {}
+        tick = 0
+        while todo or self.has_work():
+            while todo and arrivals.get(todo[0].req_id, 0) <= tick:
+                self.submit(todo.pop(0))
+            for c in self.step():
+                out[c.req_id] = c
+            tick += 1
+            if tick > max_ticks:
+                raise RuntimeError(
+                    f"serving engine did not drain within {max_ticks} ticks "
+                    f"({len(self.pending)} pending, "
+                    f"{sum(s is not None for s in self.slots)} active)")
+        return out

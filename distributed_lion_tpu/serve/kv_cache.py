@@ -1,0 +1,122 @@
+"""Paged KV cache: a fixed page pool per layer + host-side block tables.
+
+The vLLM PagedAttention design (Kwon et al., 2023) mapped onto the repo's
+static-shape discipline: each layer's cache is ONE device array
+``[num_blocks, block_size, kv_heads, head_dim]`` (the pool), and a
+sequence owns an ordered list of page indices — its block table. All
+allocation and free is HOST-side integer table math in this module; the
+device never sees a dynamic shape, so the decode tick stays one jitted
+program while sequences join and leave the batch (serve/engine.py). The
+device-side scatter/gather/attend primitives live in
+``ops.attention`` (``paged_scatter_kv`` / ``paged_gather_kv`` /
+``paged_decode_attention``).
+
+Sentinel convention: unallocated table entries hold ``num_blocks`` (one
+past the pool). Scatters to a sentinel page drop (XLA scatter
+``mode='drop'``), gathers from it fill zeros — inactive decode slots and
+right-padded prefill tails are inert without a single host branch inside
+the compiled tick.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def init_pages(n_layer: int, num_blocks: int, block_size: int,
+               kv_heads: int, head_dim: int, dtype) -> list:
+    """The per-layer device page pool: ``[{"k", "v"}] * n_layer`` of
+    zeros ``[num_blocks, block_size, kv_heads, head_dim]``. Allocated
+    once at engine start — ticks update it in place (donated)."""
+    import jax.numpy as jnp
+
+    shape = (num_blocks, block_size, kv_heads, head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(n_layer)
+    ]
+
+
+class BlockTables:
+    """Host-side page allocator + per-slot block tables.
+
+    ``tables`` is the ``[max_seqs, max_blocks_per_seq]`` int32 array the
+    engine ships to the device each tick (sentinel-padded); ``owned[slot]``
+    counts the pages slot currently holds. Pure numpy/stdlib — this is
+    the "allocation is host-side table math, never a recompile" half of
+    the paged design, and it must stay importable without jax for the
+    bench's capacity planning.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
+                 max_blocks_per_seq: int):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need positive pool dims, got num_blocks={num_blocks} "
+                f"block_size={block_size}")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_seqs = int(max_seqs)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.sentinel = self.num_blocks
+        # LIFO free list: recently-freed pages are re-used first, which
+        # keeps the working set of the pool small and cache-warm
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self.tables = np.full((max_seqs, max_blocks_per_seq), self.sentinel,
+                              np.int32)
+        self.owned = np.zeros((max_seqs,), np.int32)
+
+    # ------------------------------------------------------------ capacity
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def max_tokens_per_seq(self) -> int:
+        return self.max_blocks_per_seq * self.block_size
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` cache entries."""
+        return -(-max(n_tokens, 0) // self.block_size)
+
+    def can_grow(self, slot: int, n_tokens: int) -> bool:
+        """Would :meth:`grow` succeed for ``n_tokens`` total tokens?"""
+        need = self.blocks_for(n_tokens)
+        if need > self.max_blocks_per_seq:
+            return False
+        return need - int(self.owned[slot]) <= len(self._free)
+
+    # ---------------------------------------------------------- alloc/free
+    def grow(self, slot: int, n_tokens: int) -> bool:
+        """Ensure ``slot``'s table covers ``n_tokens`` total cache
+        entries, allocating pages as needed. Returns False (allocating
+        NOTHING — all-or-nothing, so a half-grown slot can't strand
+        pages) when the pool or the table width can't fit it."""
+        if not self.can_grow(slot, n_tokens):
+            return False
+        need = self.blocks_for(n_tokens)
+        have = int(self.owned[slot])
+        for i in range(have, need):
+            self.tables[slot, i] = self._free.pop()
+        self.owned[slot] = need
+        return True
+
+    def free_slot(self, slot: int) -> int:
+        """Return all of ``slot``'s pages to the pool; the table row goes
+        back to sentinel (inert on device). Returns the page count freed."""
+        n = int(self.owned[slot])
+        for i in range(n):
+            self._free.append(int(self.tables[slot, i]))
+        self.tables[slot, :] = self.sentinel
+        self.owned[slot] = 0
+        return n
+
+    def find_free_slot(self) -> Optional[int]:
+        """Lowest slot index owning zero pages (the engine marks a slot
+        occupied by growing it; completed slots are freed)."""
+        for s in range(self.max_seqs):
+            if self.owned[s] == 0:
+                return s
+        return None

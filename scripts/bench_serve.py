@@ -1,0 +1,290 @@
+#!/usr/bin/env python
+"""Decode benchmark for the serving engine — the serving twin of bench.py.
+
+Measures the continuous-batching engine (distributed_lion_tpu/serve/) the
+way the training bench measures the train step, and writes ONE strict-JSON
+evidence artifact under ``runs/serving/`` that check_evidence's ``serving``
+stage judges (so serving regressions gate like training ones —
+ROADMAP item 4):
+
+- **decode rows** — tokens/s/chip at full-occupancy decode batch
+  {32, 128, 256} (every slot active, K timed one-dispatch ticks), each row
+  carrying the NF4-vs-bf16 weight-bytes column (ops/quant: the measured
+  storage of the quantized tree vs the 2-byte/param bf16 dense serve).
+- **prefill-share ablation** — the same staggered workload drained under
+  different ``prefill_cap_tokens`` fairness caps: how much decode
+  throughput a prefill burst is allowed to steal per tick.
+- **bit-identity markers** — (a) greedy decode through the paged engine
+  vs the dense-KV ``models/generate.generate`` on the same prompts with
+  MATCHED attended length (bit-identical logits ⇒ identical tokens), and
+  (b) a staggered continuous-batching run vs solo runs of each request.
+  Both recomputed live at artifact-capture time; check_evidence requires
+  them true.
+
+CPU-produced artifacts are first-class smoke evidence (tiny model — the
+engine mechanism, not chip throughput); ``meta.backend`` records what
+measured it, and the runbook re-captures on chip at gpt2_124m.
+
+    python scripts/bench_serve.py --out runs/serving
+    python scripts/bench_serve.py --batches 32 --ticks 10   # quick look
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROMPT_LEN = 16          # decode-row prompt length (uniform: the decode
+#                          measurement wants full slots, not prompt variety)
+DEFAULT_BATCHES = (32, 128, 256)
+
+
+def _build(model_name: str, family: str, quant: str, max_seqs: int,
+           block_size: int, max_blocks_per_seq: int,
+           prefill_cap: int = 1 << 30, temperature: float = 0.0):
+    import jax
+
+    from distributed_lion_tpu.serve.engine import (
+        ServeConfig,
+        ServeModel,
+        ServingEngine,
+    )
+
+    if family == "gpt2":
+        from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+        cfg = (GPT2Config.tiny() if model_name == "tiny"
+               else GPT2Config.gpt2_124m())
+        params = gpt2_init(jax.random.key(0), cfg)
+        model = ServeModel.for_gpt2(params, cfg)
+    else:
+        from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+
+        cfg = LlamaConfig.named(model_name)
+        params = llama_init(jax.random.key(0), cfg)
+        model = ServeModel.for_llama(params, cfg)
+    scfg = ServeConfig(max_seqs=max_seqs, block_size=block_size,
+                       max_blocks_per_seq=max_blocks_per_seq,
+                       prefill_cap_tokens=prefill_cap,
+                       temperature=temperature, quant=quant)
+    return ServingEngine(model, scfg), params, cfg
+
+
+def _prompts(n: int, vocab: int, length: int = PROMPT_LEN, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, vocab, length))) for _ in range(n)]
+
+
+def bench_decode(batch: int, model_name: str, family: str, quant: str,
+                 block_size: int, ticks: int, warmup: int) -> dict:
+    """Fill every slot, then time ``ticks`` full-batch decode dispatches."""
+    from distributed_lion_tpu.serve.engine import Request
+
+    need = PROMPT_LEN + warmup + ticks + 2
+    nblocks = -(-need // block_size)
+    engine, params, cfg = _build(model_name, family, quant, batch,
+                                 block_size, nblocks)
+    for i, toks in enumerate(_prompts(batch, cfg.vocab_size)):
+        engine.submit(Request(req_id=i, tokens=toks,
+                              max_new_tokens=need, seed=i))
+    while engine.pending:  # prefill phase (uncapped) until every slot runs
+        engine.step()
+    assert all(s is not None for s in engine.slots), "slots did not fill"
+    for _ in range(warmup):
+        engine.step()
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        engine.step()  # each tick host-syncs its token batch — the
+        #                dispatch is fully retired inside the window
+    dt = time.perf_counter() - t0
+    return {
+        "batch": batch,
+        "decode_ticks": ticks,
+        "ms_per_tick": round(dt / ticks * 1e3, 4),
+        "tokens_per_sec_per_chip": round(batch * ticks / dt, 2),
+        "quant": quant,
+    }
+
+
+def bench_prefill_share(model_name: str, family: str, quant: str,
+                        caps: list, block_size: int) -> list:
+    """Drain one staggered mixed workload per fairness cap: the ablation
+    showing what a prefill burst costs the decode batch."""
+    from distributed_lion_tpu.serve.engine import Request
+
+    rows = []
+    for cap in caps:
+        engine, params, cfg = _build(model_name, family, quant, 16,
+                                     block_size, 8, prefill_cap=cap)
+        prompts = _prompts(48, cfg.vocab_size, seed=7)
+        reqs = [Request(req_id=i, tokens=t, max_new_tokens=24, seed=i)
+                for i, t in enumerate(prompts)]
+        arrivals = {i: (i // 8) * 2 for i in range(len(reqs))}
+        t0 = time.perf_counter()
+        done = engine.run(reqs, arrivals)
+        dt = time.perf_counter() - t0
+        total = sum(len(c.tokens) for c in done.values())
+        st = engine.stats
+        rows.append({
+            "prefill_cap_tokens": cap,
+            "ticks": st["ticks"],
+            "tokens_per_sec": round(total / dt, 2),
+            "prefill_token_share": round(
+                st["padded_prefill_tokens"]
+                / max(st["padded_prefill_tokens"] + st["decode_tokens"], 1),
+                4),
+        })
+    return rows
+
+
+def bit_identity_markers(family: str) -> dict:
+    """Live recompute of the two serving bit-identity claims on the tiny
+    model (cheap on any backend) — the artifact must EARN its markers at
+    capture time, not copy them from a test run."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.models.generate import generate
+    from distributed_lion_tpu.serve.engine import Request
+
+    block_size, nblk = 4, 8                  # paged horizon = 32 tokens
+    new_tokens = 8
+    engine, params, cfg = _build("tiny", family, "none", 4, block_size, nblk)
+    if family == "gpt2":
+        from distributed_lion_tpu.models.gpt2 import gpt2_decode, gpt2_init_cache
+
+        def dec(p, t, c, pos, off=None):
+            return gpt2_decode(p, t, cfg, c, pos, off)
+
+        def ic(b, m):
+            return gpt2_init_cache(cfg, b, m)
+    else:
+        from distributed_lion_tpu.models.llama import llama_decode, llama_init_cache
+
+        def dec(p, t, c, pos, off=None):
+            return llama_decode(p, t, cfg, c, pos, off)
+
+        def ic(b, m):
+            return llama_init_cache(cfg, b, m)
+
+    # (a) paged engine vs dense generate, MATCHED attended length
+    # (max_len == blocks*block_size), uniform prompts, greedy
+    prompts = _prompts(4, cfg.vocab_size, length=7, seed=11)
+    dense = np.asarray(generate(
+        dec, ic, params, jnp.asarray(prompts, jnp.int32), new_tokens,
+        max_len=block_size * nblk))
+    done = engine.run([Request(req_id=i, tokens=t, max_new_tokens=new_tokens,
+                               seed=0) for i, t in enumerate(prompts)])
+    paged_vs_dense = all(
+        list(dense[i]) == done[i].tokens for i in range(len(prompts)))
+
+    # (b) staggered continuous batching vs solo runs, varied lengths
+    varied = [p[: 3 + 2 * i] for i, p in enumerate(_prompts(4, cfg.vocab_size,
+                                                            length=12, seed=13))]
+    reqs = [Request(req_id=i, tokens=t, max_new_tokens=new_tokens, seed=i)
+            for i, t in enumerate(varied)]
+    eng2, _, _ = _build("tiny", family, "none", 4, block_size, nblk)
+    stag = eng2.run(reqs, arrivals={0: 0, 1: 1, 2: 1, 3: 4})
+    ok = True
+    for r in reqs:
+        solo_eng, _, _ = _build("tiny", family, "none", 4, block_size, nblk)
+        solo = solo_eng.run([Request(r.req_id, list(r.tokens),
+                                     r.max_new_tokens, r.seed)])
+        ok = ok and solo[r.req_id].tokens == stag[r.req_id].tokens
+    return {"paged_vs_dense": bool(paged_vs_dense),
+            "batched_vs_solo": bool(ok)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(REPO, "runs", "serving"))
+    ap.add_argument("--model", default=None,
+                    help="tiny (default off-TPU) | gpt2_124m (default on TPU)"
+                         " | llama small/...")
+    ap.add_argument("--family", default="gpt2", choices=("gpt2", "llama"))
+    ap.add_argument("--quant", default="none",
+                    choices=("none", "nf4", "int8"),
+                    help="weight format of the MEASURED decode arm (the "
+                         "bytes columns always report both)")
+    ap.add_argument("--batches", default=",".join(map(str, DEFAULT_BATCHES)))
+    ap.add_argument("--block_size", type=int, default=16)
+    ap.add_argument("--ticks", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+
+    from distributed_lion_tpu.ops.quant import quantize_tree
+    from distributed_lion_tpu.serve.engine import weight_bytes
+
+    backend = jax.default_backend()
+    model_name = args.model or ("gpt2_124m" if backend == "tpu" else "tiny")
+    batches = [int(b) for b in args.batches.split(",") if b]
+
+    # the NF4-vs-bf16 column: measured storage bytes of the same tree in
+    # both formats (dense counted at 2 bytes/param — the bf16 serving
+    # copy — so an f32 checkpoint doesn't inflate the comparison)
+    _, params, cfg = _build(model_name, args.family, "none", 2,
+                            args.block_size, 2)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    bytes_bf16 = 2 * n_params
+    bytes_nf4 = weight_bytes(quantize_tree(params, "nf4"))
+    del params
+
+    decode_rows = []
+    for b in batches:
+        row = bench_decode(b, model_name, args.family, args.quant,
+                           args.block_size, args.ticks, args.warmup)
+        row["weight_bytes_bf16"] = int(bytes_bf16)
+        row["weight_bytes_nf4"] = int(bytes_nf4)
+        decode_rows.append(row)
+        print(json.dumps(row, allow_nan=False), flush=True)
+
+    share_rows = bench_prefill_share(model_name, args.family, args.quant,
+                                     [args.block_size, 4 * args.block_size,
+                                      1 << 30], args.block_size)
+    bits = bit_identity_markers(args.family)
+
+    doc = {
+        "meta": {
+            "backend": backend,
+            "device_kind": jax.devices()[0].device_kind,
+            "num_devices": 1,  # the engine is single-device today; rows
+            #                    are per chip by construction
+            "model": model_name,
+            "family": args.family,
+            "quant_measured": args.quant,
+            "block_size": args.block_size,
+            "prompt_len": PROMPT_LEN,
+            "n_params": int(n_params),
+        },
+        "decode": decode_rows,
+        "prefill_share": share_rows,
+        "bit_identity": bits,
+    }
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "serving.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, allow_nan=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(json.dumps({"artifact": path, **bits,
+                      "best_tokens_per_sec_per_chip": max(
+                          r["tokens_per_sec_per_chip"] for r in decode_rows)},
+                     allow_nan=False), flush=True)
+    return 0 if all(bits.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py autotune       # TPU-keyed tuning cache
     python scripts/check_evidence.py journal        # run-journal attribution
     python scripts/check_evidence.py dcn_overlap    # pipelined hier DCN leg
+    python scripts/check_evidence.py serving        # paged-KV decode bench
     python scripts/check_evidence.py all
 
 parity:vote / parity:lazy are STRICT since ISSUE 6: a leg counts as
@@ -570,6 +571,54 @@ def dcn_overlap_ok(path: str = DCN_ARTIFACT) -> bool:
     return doc.get("parity", {}).get("pass") is True
 
 
+# the serving stage (ISSUE 9): scripts/bench_serve.py's artifact under
+# runs/serving — (a) passes the strict serving.json schema
+# (validate_metrics, loaded by FILE PATH so this script stays jax-free),
+# (b) both live-recomputed bit-identity markers hold (paged-engine greedy
+# == dense-KV generate at matched attended length; staggered continuous
+# batching == solo runs per request), (c) a decode row exists at every
+# required batch size {32, 128, 256} with tokens/s/chip above the floor —
+# SERVE_MIN_TOKS is calibrated to the banked CPU smoke artifact (tiny
+# model on a 2-core box measures >1k; a TPU gpt2_124m run is orders of
+# magnitude above), so any regression that stalls the tick loop trips it
+# on every backend — and (d) the NF4 weight-bytes column actually shows
+# the 4-bit story (nf4 < bf16/3, i.e. < ~0.67 byte/param incl. scales).
+SERVE_ARTIFACT = os.path.join(REPO, "runs", "serving", "serving.json")
+SERVE_BATCHES = (32, 128, 256)
+SERVE_MIN_TOKS = 50.0
+
+
+def serving_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    bits = doc.get("bit_identity", {})
+    if not (bits.get("paged_vs_dense") is True
+            and bits.get("batched_vs_solo") is True):
+        return False
+    rows = {r.get("batch"): r for r in doc.get("decode", [])}
+    for b in SERVE_BATCHES:
+        row = rows.get(b)
+        if row is None or not isinstance(
+                row.get("tokens_per_sec_per_chip"), (int, float)):
+            return False
+        if row["tokens_per_sec_per_chip"] < SERVE_MIN_TOKS:
+            return False
+        if not (isinstance(row.get("weight_bytes_nf4"), int)
+                and isinstance(row.get("weight_bytes_bf16"), int)
+                and row["weight_bytes_nf4"] * 3 < row["weight_bytes_bf16"]):
+            return False
+    return True
+
+
 def journal_ok(dirname: str = "journal") -> bool:
     base = (dirname if os.path.isabs(dirname)
             else os.path.join(REPO, "runs", dirname))
@@ -607,6 +656,7 @@ STAGES = [
     ("autotune", autotune_ok),
     ("journal", journal_ok),
     ("dcn_overlap", dcn_overlap_ok),
+    ("serving", serving_ok),
 ]
 
 # automation (the watcher exit condition) judges the parity legs on
@@ -673,6 +723,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return journal_ok(arg or "journal")
     if what == "dcn_overlap":
         return dcn_overlap_ok(arg or DCN_ARTIFACT)
+    if what == "serving":
+        return serving_ok(arg or SERVE_ARTIFACT)
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

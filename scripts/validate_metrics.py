@@ -34,9 +34,11 @@ manifests (``manifest.json`` — must carry format/step/files with
 sha256+bytes per file, checkpoint.write_manifest), the autotune
 tuning cache (``tuning_cache.json`` — full check delegated to
 ops/autotune.validate_cache_doc, the cache's single schema authority),
-and the DCN-overlap evidence artifact (``dcn_overlap.json`` —
+the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
-rows are strict-validated per row).
+rows are strict-validated per row), and the serving-bench artifact
+(``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
+bit-identity document, per-row validated the same way).
 The same NaN-token rejection applies: all the writers pass
 ``allow_nan=False`` and this script is the CI check that they keep
 doing so.
@@ -173,12 +175,59 @@ def validate_journal_file(path: str) -> list[str]:
 # (tuning_cache.json is NOT listed here: it dispatches below on its
 # embedded format stamp — any filename, e.g. a $DLT_TUNE_CACHE override —
 # and delegates wholesale to ops/autotune.validate_cache_doc.
-# dcn_overlap.json has its own branch too: its frontier rows carry a
-# per-row schema the generic required-keys check can't express.)
+# dcn_overlap.json and serving.json have their own branches too: their
+# rows carry per-row schemas the generic required-keys check can't
+# express.)
 _DOC_SCHEMAS = {
     "bundle.json": ("step", "reason", "config"),
     "manifest.json": ("format", "step", "files"),
 }
+
+
+def _serving_errors(path: str, doc: dict) -> list[str]:
+    """Strict schema of the serving-bench evidence artifact
+    (scripts/bench_serve.py; judged by check_evidence's ``serving``
+    stage): decode rows each a tokens/s/chip measurement at one batch
+    size carrying the NF4-vs-bf16 weight-bytes column, the prefill-share
+    ablation rows, and the two live-recomputed bit-identity markers."""
+    errors = []
+    for key in ("meta", "decode", "prefill_share", "bit_identity"):
+        if key not in doc:
+            errors.append(f"{path}: missing required key {key!r}")
+    meta = doc.get("meta")
+    if isinstance(meta, dict):
+        for k in ("backend", "model", "family"):
+            if not isinstance(meta.get(k), str):
+                errors.append(f"{path}: meta.{k} must be a string")
+    for name, row_keys in (
+            ("decode", ("batch", "decode_ticks", "ms_per_tick",
+                        "tokens_per_sec_per_chip", "quant",
+                        "weight_bytes_bf16", "weight_bytes_nf4")),
+            ("prefill_share", ("prefill_cap_tokens", "ticks",
+                               "tokens_per_sec", "prefill_token_share"))):
+        rows = doc.get(name)
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: {name!r} must be a non-empty list")
+            continue
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                errors.append(f"{path}: {name}[{i}] is not an object")
+                continue
+            for k in row_keys:
+                if k not in row:
+                    errors.append(f"{path}: {name}[{i}] missing {k!r}")
+                elif k == "quant":
+                    if not isinstance(row[k], str):
+                        errors.append(f"{path}: {name}[{i}].quant is not "
+                                      "a string")
+                elif not _finite_number(row[k]):
+                    errors.append(f"{path}: {name}[{i}].{k} is not finite")
+    bits = doc.get("bit_identity")
+    if isinstance(bits, dict):
+        for k in ("paged_vs_dense", "batched_vs_solo"):
+            if not isinstance(bits.get(k), bool):
+                errors.append(f"{path}: bit_identity.{k} must be a bool")
+    return errors
 
 
 def _dcn_overlap_errors(path: str, doc: dict) -> list[str]:
@@ -267,6 +316,8 @@ def validate_json_doc(path: str) -> list[str]:
     name = os.path.basename(path)
     if name == "dcn_overlap.json":
         return _dcn_overlap_errors(path, doc)
+    if name == "serving.json":
+        return _serving_errors(path, doc)
     if name == "tuning_cache.json" or doc.get("format") == _TUNE_CACHE_FORMAT:
         # dispatch on the embedded format stamp as well as the canonical
         # name: a cache at any $DLT_TUNE_CACHE path (the documented drive)

@@ -1,0 +1,28 @@
+"""Fixture: the serving engine's one forbidden shortcut — PER-TOKEN host
+reads inside the jitted decode tick (the classic serving pitfall: an
+`int(token)` / EOS branch inside the compiled tick forces a device→host
+round trip per generated token and serializes the whole rolling batch).
+The real engine (serve/engine.py) samples the whole tick's tokens on
+device and the host reads ONE array per tick, at the dispatch boundary.
+Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py). Lives under fixtures/analysis/serve/ the
+way the DLT009 fixture lives under train/ — the fixture tree mirrors the
+package tree it pins."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decode_tick(params, pages, tables, lens, last_tok):
+    logits = (params["w"] * last_tok[:, None]).sum(-1)
+    tok = jnp.argmax(logits, axis=-1)
+    first = int(tok[0])            # DLT001: per-token host read in the tick
+    if float(logits.max()) > 0:    # DLT001: host-side EOS branch in the tick
+        lens = lens + 1
+    return tok, first, lens
+
+
+def host_tick_loop(engine, toks):
+    # NOT traced scope: reading the tick's WHOLE token array once per
+    # dispatch is the engine's documented sync point
+    return [int(t) for t in toks]

@@ -209,3 +209,21 @@ def test_guard_fixture_and_guard_modules_clean():
                 "parallel/collectives.py"):
         path = os.path.join(PKG, rel)
         assert lint.lint_file(path) == [], rel
+
+
+def test_serve_fixture_and_serve_modules_clean():
+    """ISSUE 9 satellite: the serving engine's decode tick must never
+    host-read per token — the classic serving pitfall (an `int(token)` /
+    EOS branch inside the jitted tick serializes the rolling batch). The
+    path-scoped fixture under fixtures/analysis/serve/ shows the
+    forbidden shape (DLT001 fires twice); the real serving modules lint
+    clean by file path — the engine's ONE host read per tick happens at
+    the dispatch boundary, outside traced scope."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt001_decode_tick_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for rel in ("serve/engine.py", "serve/kv_cache.py", "serve/api.py",
+                "ops/attention.py", "cli/run_serve.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel

@@ -156,6 +156,125 @@ def test_top_p_nucleus_filtering():
     assert set(full) == {0, 1, 2, 3}, set(full)
 
 
+def test_sample_logits_top_k_ge_vocab_keeps_everything():
+    """top_k >= vocab filters nothing: the draw is bit-identical to the
+    unfiltered draw under the same key (load-bearing once the serving
+    engine samples per-tick with caller-provided top_k)."""
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    for s in range(12):
+        key = jax.random.key(s)
+        plain = int(sample_logits(logits, key, 1.0)[0])
+        assert int(sample_logits(logits, key, 1.0, top_k=4)[0]) == plain
+        assert int(sample_logits(logits, key, 1.0, top_k=400)[0]) == plain
+
+
+def test_sample_logits_top_p_one_keeps_everything():
+    """top_p=1.0 keeps the full support (exclusive-cumulative mass before
+    the last token is < 1.0): bit-identical to the unfiltered draw."""
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    for s in range(12):
+        key = jax.random.key(s)
+        assert int(sample_logits(logits, key, 1.0, None, 1.0)[0]) == \
+            int(sample_logits(logits, key, 1.0)[0])
+
+
+def test_generate_pad_id_equals_eos_id():
+    """pad_id == eos_id must not re-trigger/flicker the finished mask:
+    after the first EOS the row is eos forever (the pad IS eos), and the
+    mask never un-finishes."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(2), cfg)
+    prompt = _tokens(cfg.vocab_size, 2, 5, seed=3)
+    decode = partial(_gpt2_decode_fn, cfg)
+    init_cache = partial(gpt2_init_cache, cfg)
+    greedy = np.asarray(generate(decode, init_cache, params, prompt, 8))
+    eos = int(greedy[0, 0])
+    out = np.asarray(generate(decode, init_cache, params, prompt, 8,
+                              eos_id=eos, pad_id=eos))
+    assert (out[0] == eos).all(), out[0]
+
+
+def test_generate_max_new_tokens_1():
+    """max_new_tokens=1 is a zero-length scan: shape [B, 1] and the one
+    token equals the prefill logits' argmax."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(2), cfg)
+    prompt = _tokens(cfg.vocab_size, 2, 5, seed=3)
+    decode = partial(_gpt2_decode_fn, cfg)
+    init_cache = partial(gpt2_init_cache, cfg)
+    out = np.asarray(generate(decode, init_cache, params, prompt, 1))
+    assert out.shape == (2, 1)
+    full = gpt2_apply(params, prompt, cfg)
+    np.testing.assert_array_equal(out[:, 0],
+                                  np.asarray(jnp.argmax(full[:, -1], -1)))
+
+
+def test_batched_left_padded_generate_matches_solo():
+    """ISSUE 9 satellite: variable-length prompts batch into one
+    left-padded generate call (per-row position offsets + pad masking) and
+    each row generates exactly what a solo run of its prompt does — for
+    BOTH families (llama exercises per-row rotary gathers)."""
+    from distributed_lion_tpu.models.llama import (
+        llama_decode, llama_init, llama_init_cache,
+    )
+
+    cases = [
+        ("gpt2", GPT2Config.tiny(), gpt2_init,
+         lambda cfg: (lambda p, t, c, pos, off=None:
+                      gpt2_decode(p, t, cfg, c, pos, off)),
+         gpt2_init_cache),
+        ("llama", LlamaConfig.tiny(), llama_init,
+         lambda cfg: (lambda p, t, c, pos, off=None:
+                      llama_decode(p, t, cfg, c, pos, off)),
+         llama_init_cache),
+    ]
+    rng = np.random.default_rng(1)
+    for fam, cfg, init, mk_dec, init_cache in cases:
+        params = init(jax.random.key(2), cfg)
+        dec = mk_dec(cfg)
+        ic = partial(init_cache, cfg)
+        prompts = [list(map(int, rng.integers(1, cfg.vocab_size, n)))
+                   for n in (3, 7, 5)]
+        T = max(len(p) for p in prompts)
+        batch = np.zeros((len(prompts), T), np.int32)
+        for i, p in enumerate(prompts):
+            batch[i, T - len(p):] = p  # left-pad: real tokens right-aligned
+        lens = jnp.asarray([len(p) for p in prompts], jnp.int32)
+        out = np.asarray(generate(dec, ic, params, jnp.asarray(batch), 6,
+                                  prompt_lens=lens))
+        for i, p in enumerate(prompts):
+            solo = np.asarray(generate(dec, ic, params,
+                                       jnp.asarray([p], jnp.int32), 6))
+            np.testing.assert_array_equal(out[i], solo[0], err_msg=f"{fam}:{i}")
+
+
+def test_generate_cli_multi_prompt(tmp_path, capsys):
+    """run_generate batches several --prompt values (and --prompt_file
+    lines) through ONE left-padded generate call; per-prompt output lines
+    match the single-prompt invocations."""
+    from distributed_lion_tpu.cli.run_generate import main
+
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("hello\n\nworld\n")
+    texts = main(["--model_family", "gpt2", "--model_name", "tiny",
+                  "--prompt", "ab", "cdef", "--prompt_file", str(pf),
+                  "--max_new_tokens", "4", "--temperature", "0"])
+    assert isinstance(texts, list) and len(texts) == 4
+    capsys.readouterr()
+    for prompt, text in zip(("ab", "cdef", "hello", "world"), texts):
+        solo = main(["--model_family", "gpt2", "--model_name", "tiny",
+                     "--prompt", prompt, "--max_new_tokens", "4",
+                     "--temperature", "0"])
+        assert solo == text, prompt
+    # --prompt_file ALONE must serve exactly the file's prompts — no
+    # default "Hello" sneaking into the batch
+    only_file = main(["--model_family", "gpt2", "--model_name", "tiny",
+                      "--prompt_file", str(pf), "--max_new_tokens", "4",
+                      "--temperature", "0"])
+    assert isinstance(only_file, list) and len(only_file) == 2
+    assert only_file == texts[2:]
+
+
 def test_top_p_degenerate_values_fall_back_to_greedy():
     import jax
     import jax.numpy as jnp

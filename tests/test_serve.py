@@ -1,0 +1,417 @@
+"""Serving subsystem (ISSUE 9): paged-KV decode bit-identical to the dense
+cache, continuous batching identical to solo runs, host-side page
+allocator invariants, NF4 frozen-weight serving, fairness cap, the
+request-file API, and the banked serving evidence artifact."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.models.gpt2 import (
+    GPT2Config, gpt2_decode, gpt2_decode_paged, gpt2_init, gpt2_init_cache,
+)
+from distributed_lion_tpu.models.llama import (
+    LlamaConfig, llama_decode, llama_decode_paged, llama_init,
+    llama_init_cache,
+)
+from distributed_lion_tpu.serve.engine import (
+    Request,
+    ServeConfig,
+    ServeModel,
+    ServingEngine,
+    weight_bytes,
+)
+from distributed_lion_tpu.serve.kv_cache import BlockTables
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tokens(vocab, b, t, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(1, vocab, (b, t)), jnp.int32)
+
+
+# ------------------------------------------------------- paged == dense
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_paged_decode_bit_identical_to_dense(family):
+    """Prefill + per-token decode through SHUFFLED block tables produces
+    bit-identical logits to the dense KV cache at the same attended
+    length — the paged layout is pure indirection, never arithmetic."""
+    if family == "gpt2":
+        cfg = GPT2Config.tiny()
+        params = gpt2_init(jax.random.key(0), cfg)
+        dec, icache, decp, kv = gpt2_decode, gpt2_init_cache, \
+            gpt2_decode_paged, cfg.n_head
+    else:
+        cfg = LlamaConfig.tiny()  # GQA: pages hold kv heads un-repeated
+        params = llama_init(jax.random.key(0), cfg)
+        dec, icache, decp, kv = llama_decode, llama_init_cache, \
+            llama_decode_paged, cfg.n_kv_head
+    B, L, bs, nb_seq = 2, 7, 4, 4          # both caches attend 16 slots
+    toks = _tokens(cfg.vocab_size, B, L)
+    cache = icache(cfg, B, bs * nb_seq)
+    dl, cache = dec(params, toks, cfg, cache, 0)
+    pages = [{k: jnp.zeros((B * nb_seq, bs, kv, cfg.head_dim),
+                           cfg.compute_dtype) for k in ("k", "v")}
+             for _ in range(cfg.n_layer)]
+    # interleaved/shuffled page ownership: the gather must reassemble
+    # purely via the table, not via any layout assumption
+    tables = jnp.asarray([[2, 0, 1, 3], [5, 7, 4, 6]], jnp.int32)
+    pl, pages = decp(params, toks, cfg, pages, tables,
+                     jnp.zeros((B,), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(dl), np.asarray(pl))
+    t_cur = jnp.argmax(dl[:, -1], -1)
+    lens = jnp.full((B,), L, jnp.int32)
+    for i in range(5):
+        dl, cache = dec(params, t_cur[:, None], cfg, cache, L + i)
+        pl, pages = decp(params, t_cur[:, None], cfg, pages, tables, lens)
+        np.testing.assert_array_equal(np.asarray(dl), np.asarray(pl))
+        t_cur = jnp.argmax(dl[:, -1], -1)
+        lens = lens + 1
+
+
+def test_paged_prefill_valid_mask_drops_pad_tail():
+    """A right-padded prefill (the engine's bucketed shape) must write
+    exactly the real tokens' pages: logits at real positions match an
+    unpadded prefill bit-for-bit, and a later decode step agrees too."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(1), cfg)
+    L, P, bs = 5, 8, 4
+    toks = _tokens(cfg.vocab_size, 1, L, seed=2)
+    padded = jnp.concatenate(
+        [toks, jnp.zeros((1, P - L), jnp.int32)], axis=1)
+
+    def pages():
+        return [{k: jnp.zeros((4, bs, cfg.n_head, cfg.head_dim),
+                              cfg.compute_dtype) for k in ("k", "v")}
+                for _ in range(cfg.n_layer)]
+
+    tables = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    zero = jnp.zeros((1,), jnp.int32)
+    ref, ref_pages = gpt2_decode_paged(params, toks, cfg, pages(), tables, zero)
+    valid = (jnp.arange(P) < L)[None, :]
+    got, got_pages = gpt2_decode_paged(params, padded, cfg, pages(), tables,
+                                       zero, valid)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got[:, :L]))
+    nxt = jnp.argmax(ref[:, L - 1], -1)[:, None]
+    lens = jnp.full((1,), L, jnp.int32)
+    a, _ = gpt2_decode_paged(params, nxt, cfg, ref_pages, tables, lens)
+    b, _ = gpt2_decode_paged(params, nxt, cfg, got_pages, tables, lens)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- host allocator
+def test_block_tables_alloc_free_invariants():
+    bt = BlockTables(num_blocks=8, block_size=4, max_seqs=3,
+                     max_blocks_per_seq=4)
+    assert bt.free_blocks == 8 and bt.max_tokens_per_seq == 16
+    assert bt.grow(0, 5)            # 2 pages
+    assert bt.owned[0] == 2 and bt.free_blocks == 6
+    assert bt.grow(0, 5)            # idempotent: no new pages
+    assert bt.free_blocks == 6
+    assert bt.grow(1, 16)           # 4 pages — slot 1 maxes its table
+    assert not bt.grow(1, 17)       # beyond the table width
+    assert bt.free_blocks == 2
+    # all-or-nothing: slot 2 wants 3 pages, pool has 2 — NOTHING allocates
+    assert not bt.grow(2, 12)
+    assert bt.owned[2] == 0 and bt.free_blocks == 2
+    assert bt.find_free_slot() == 2
+    freed = bt.free_slot(1)
+    assert freed == 4 and bt.free_blocks == 6
+    assert (bt.tables[1] == bt.sentinel).all()
+    assert bt.grow(2, 12)           # now it fits
+
+
+# ------------------------------------------- continuous batching == solo
+def _tiny_requests(cfg, n=5, seed=3, max_new=8):
+    rng = np.random.default_rng(seed)
+    lens = (3, 9, 5, 14, 2, 7, 11)[:n]
+    return [Request(req_id=f"r{i}",
+                    tokens=list(map(int, rng.integers(1, cfg.vocab_size, L))),
+                    max_new_tokens=max_new, seed=i)
+            for i, L in enumerate(lens)]
+
+
+def _engine(params, cfg, **kw):
+    base = dict(max_seqs=4, block_size=4, max_blocks_per_seq=8)
+    base.update(kw)
+    return ServingEngine(ServeModel.for_gpt2(params, cfg), ServeConfig(**base))
+
+
+@pytest.mark.parametrize("sampling", ["greedy", "stochastic"])
+def test_staggered_continuous_batching_matches_solo(sampling):
+    """The acceptance pin: a continuous-batching run with staggered
+    arrivals produces per-request outputs identical to solo runs — slots,
+    neighbors, and arrival order must not leak into any request (per-slot
+    PRNG keys are (request seed, token index), batch-independent)."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    samp = (dict(temperature=0.0) if sampling == "greedy"
+            else dict(temperature=0.9, top_k=40))
+    reqs = _tiny_requests(cfg)
+    batched = _engine(params, cfg, **samp).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs],
+        arrivals={"r0": 0, "r1": 1, "r2": 1, "r3": 3, "r4": 5})
+    for r in reqs:
+        solo = _engine(params, cfg, **samp).run(
+            [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)])
+        assert batched[r.req_id].tokens == solo[r.req_id].tokens, r.req_id
+        assert batched[r.req_id].reason == solo[r.req_id].reason
+
+
+def test_engine_greedy_matches_dense_generate():
+    """Greedy decode through the paged engine == the dense-KV generate at
+    MATCHED attended length (max_len == pages-per-seq * block_size):
+    bit-identical logits imply identical tokens."""
+    from functools import partial
+
+    from distributed_lion_tpu.models.generate import generate
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(4), cfg)
+    prompts = [list(map(int, r)) for r in np.asarray(
+        _tokens(cfg.vocab_size, 3, 6, seed=9))]
+    new = 8
+    dense = np.asarray(generate(
+        partial(lambda c, p, t, k, pos, off=None:
+                gpt2_decode(p, t, c, k, pos, off), cfg),
+        partial(gpt2_init_cache, cfg), params,
+        jnp.asarray(prompts, jnp.int32), new, max_len=4 * 8))
+    eng = _engine(params, cfg, block_size=4, max_blocks_per_seq=8)
+    done = eng.run([Request(req_id=i, tokens=t, max_new_tokens=new, seed=0)
+                    for i, t in enumerate(prompts)])
+    for i in range(len(prompts)):
+        assert list(dense[i]) == done[i].tokens, i
+
+
+def test_engine_eos_evicts_and_frees_pages():
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    reqs = _tiny_requests(cfg, n=2, max_new=16)
+    # learn each request's first greedy token, then declare it EOS
+    first = {r.req_id: _engine(params, cfg).run(
+        [Request(r.req_id, list(r.tokens), 1, 0)])[r.req_id].tokens[0]
+        for r in reqs}
+    eos = first[reqs[0].req_id]
+    eng = _engine(params, cfg, eos_id=eos)
+    done = eng.run([Request(r.req_id, list(r.tokens), 16, 0) for r in reqs])
+    assert done[reqs[0].req_id].reason == "eos"
+    assert done[reqs[0].req_id].tokens[-1] == eos
+    # every page returned to the pool after the workload drains
+    assert eng.tables.free_blocks == eng.cfg.resolved_num_blocks()
+    assert all(s is None for s in eng.slots)
+
+
+def test_engine_overflow_truncates_loudly():
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    eng = _engine(params, cfg, max_seqs=2, block_size=4, max_blocks_per_seq=2)
+    toks = list(map(int, np.asarray(_tokens(cfg.vocab_size, 1, 5, seed=1))[0]))
+    done = eng.run([Request("big", toks, 64, 0)])
+    assert done["big"].reason == "overflow"
+    # the cache holds 8 slots: 5 prompt + 3 decode writes → 4 generated
+    # tokens (the overflowing write is the one that could not fit)
+    assert len(done["big"].tokens) == 4
+    assert eng.tables.free_blocks == eng.cfg.resolved_num_blocks()
+
+
+def test_engine_refuses_geometry_past_position_budget():
+    """A page horizon beyond the model's trained position budget (gpt2's
+    learned wpe rows) must fail at build, not alias silently at slot 129."""
+    cfg = GPT2Config.tiny()  # n_ctx = 128
+    params = gpt2_init(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="position budget"):
+        _engine(params, cfg, block_size=16, max_blocks_per_seq=16)
+
+
+def test_engine_refuses_moe_checkpoints():
+    """A bucketed (right-padded) prefill would route pad tokens through
+    the experts' fixed-capacity buffers, displacing real tokens a solo
+    run keeps — MoE must refuse loudly, not break bit-identity silently."""
+    cfg = GPT2Config.tiny(moe_experts=2)
+    params = gpt2_init(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="MoE"):
+        ServeModel.for_gpt2(params, cfg)
+    pages = [{k: jnp.zeros((4, 4, cfg.n_head, cfg.head_dim),
+                           cfg.compute_dtype) for k in ("k", "v")}
+             for _ in range(cfg.n_layer)]
+    with pytest.raises(ValueError, match="paged decode"):
+        gpt2_decode_paged(params, jnp.ones((1, 4), jnp.int32), cfg, pages,
+                          jnp.asarray([[0, 1, 2, 3]], jnp.int32),
+                          jnp.zeros((1,), jnp.int32))
+    with pytest.raises(ValueError, match="left-padded"):
+        gpt2_decode(params, jnp.ones((2, 4), jnp.int32), cfg,
+                    gpt2_init_cache(cfg, 2, 8), 0,
+                    jnp.asarray([0, 1], jnp.int32))
+
+
+def test_engine_rejects_impossible_prompt():
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    eng = _engine(params, cfg, max_seqs=2, block_size=4, max_blocks_per_seq=2)
+    toks = list(map(int, np.asarray(_tokens(cfg.vocab_size, 1, 8, seed=1))[0]))
+    done = eng.run([Request("toolong", toks, 4, 0)])  # 8 == cap, no room
+    assert done["toolong"].reason == "rejected"
+    assert done["toolong"].tokens == []
+
+
+def test_prefill_fairness_cap():
+    """A small cap admits one prompt per tick (the decode batch keeps
+    moving); an uncapped engine admits the whole burst at tick 0 — and
+    the cap never changes WHAT is generated, only when."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    reqs = _tiny_requests(cfg, n=4, max_new=4)
+
+    def run(cap):
+        eng = _engine(params, cfg, max_seqs=4, prefill_cap_tokens=cap)
+        out = eng.run([Request(r.req_id, list(r.tokens), 4, r.seed)
+                       for r in reqs])
+        return eng.stats, out
+
+    s_small, out_small = run(4)        # one 4/8/16-token bucket per tick
+    s_big, out_big = run(1 << 30)
+    assert s_big["ticks"] < s_small["ticks"]
+    for r in reqs:
+        assert out_small[r.req_id].tokens == out_big[r.req_id].tokens
+
+
+def test_nf4_engine_serves_and_shrinks_weights():
+    """quant='nf4' serves from packed codes (ops/quant) — outputs stay
+    plausible (right count, in-vocab) and the weight tree actually
+    shrinks below a third of the bf16 bytes."""
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    eng = _engine(params, cfg, quant="nf4")
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    assert weight_bytes(eng.params) * 3 < 2 * n_params
+    done = eng.run([Request("q", [1, 2, 3, 4], 6, 0)])
+    assert len(done["q"].tokens) == 6
+    assert all(0 <= t < cfg.vocab_size for t in done["q"].tokens)
+
+
+def test_engine_journal_spans(tmp_path):
+    """serve/admit, serve/prefill, serve/decode_tick, serve/evict ride
+    the installed run journal (PR 7), schema-valid."""
+    import importlib.util
+
+    from distributed_lion_tpu.train import journal as journal_mod
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    jrnl = journal_mod.Journal(str(tmp_path))
+    journal_mod.install(jrnl)
+    try:
+        _engine(params, cfg).run(
+            [Request("a", [1, 2, 3], 3, 0), Request("b", [4, 5], 3, 0)])
+    finally:
+        journal_mod.uninstall(jrnl)
+        jrnl.close()
+    names = {r["name"] for r in jrnl.tail() if r["kind"] == "span"}
+    assert {"serve/admit", "serve/prefill", "serve/decode_tick",
+            "serve/evict"} <= names, names
+    spec = importlib.util.spec_from_file_location(
+        "vm_serve", os.path.join(REPO, "scripts", "validate_metrics.py"))
+    vm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(vm)
+    assert vm.validate_journal_file(
+        str(tmp_path / "journal_rank0.jsonl")) == []
+
+
+# ------------------------------------------------------------------ api
+def test_request_file_roundtrip(tmp_path):
+    from distributed_lion_tpu.serve import api
+
+    cfg = GPT2Config.tiny()
+    params = gpt2_init(jax.random.key(0), cfg)
+    reqs = [{"id": "a", "tokens": [1, 2, 3], "max_new_tokens": 4},
+            {"id": "b", "tokens": [9, 8], "max_new_tokens": 4,
+             "arrival_tick": 2, "seed": 5}]
+    inp = tmp_path / "requests.jsonl"
+    inp.write_text("".join(json.dumps(r) + "\n" for r in reqs))
+    out = tmp_path / "responses.jsonl"
+    records = api.serve_request_file(_engine(params, cfg), str(inp), str(out))
+    got = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert got == records
+    assert [r["id"] for r in got] == ["a", "b"]
+    assert all(r["n_generated"] == 4 for r in got)
+    # a request with neither tokens nor prompt+tokenizer fails LOUDLY
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"id": "x", "max_new_tokens": 2}\n')
+    with pytest.raises(ValueError, match="tokens"):
+        api.load_request_file(str(bad))
+
+
+def test_run_serve_cli_smoke(tmp_path, capsys):
+    from distributed_lion_tpu.cli.run_serve import main
+
+    reqs = tmp_path / "requests.jsonl"
+    reqs.write_text('{"id": "r1", "prompt": "ab", "max_new_tokens": 3}\n')
+    out = tmp_path / "responses.jsonl"
+    records = main(["--model_family", "gpt2", "--model_name", "tiny",
+                    "--requests", str(reqs), "--out", str(out),
+                    "--temperature", "0", "--max_seqs", "2",
+                    "--block_size", "4"])
+    assert len(records) == 1 and records[0]["n_generated"] == 3
+    assert json.loads(out.read_text())["id"] == "r1"
+
+
+# ------------------------------------------------- the evidence artifact
+def test_banked_serving_artifact_passes_stage():
+    """The committed CPU smoke artifact satisfies the serving evidence
+    stage (schema + bit-identity markers + tokens/s floor at every
+    required batch + the NF4 byte story) — the same gate the runbook's
+    on-chip recapture must clear."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ce_serve", os.path.join(REPO, "scripts", "check_evidence.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    assert os.path.exists(ce.SERVE_ARTIFACT), "banked artifact missing"
+    assert ce.serving_ok()
+    with open(ce.SERVE_ARTIFACT) as f:
+        doc = json.load(f)
+    assert {r["batch"] for r in doc["decode"]} >= set(ce.SERVE_BATCHES)
+
+
+def test_serving_stage_rejects_bad_artifacts(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ce_serve2", os.path.join(REPO, "scripts", "check_evidence.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    with open(ce.SERVE_ARTIFACT) as f:
+        good = json.load(f)
+    # flipped bit-identity marker
+    doc = json.loads(json.dumps(good))
+    doc["bit_identity"]["paged_vs_dense"] = False
+    p = tmp_path / "serving.json"
+    p.write_text(json.dumps(doc))
+    assert not ce.serving_ok(str(p))
+    # missing required batch row
+    doc = json.loads(json.dumps(good))
+    doc["decode"] = [r for r in doc["decode"] if r["batch"] != 128]
+    p.write_text(json.dumps(doc))
+    assert not ce.serving_ok(str(p))
+    # throughput floor
+    doc = json.loads(json.dumps(good))
+    doc["decode"][0]["tokens_per_sec_per_chip"] = 1.0
+    p.write_text(json.dumps(doc))
+    assert not ce.serving_ok(str(p))
+    # quantization story: nf4 bytes not actually small
+    doc = json.loads(json.dumps(good))
+    for r in doc["decode"]:
+        r["weight_bytes_nf4"] = r["weight_bytes_bf16"]
+    p.write_text(json.dumps(doc))
+    assert not ce.serving_ok(str(p))
+    # schema violation (NaN token) caught via validate_metrics delegation
+    p.write_text(json.dumps(good).replace(
+        str(good["decode"][0]["ms_per_tick"]), "NaN", 1))
+    assert not ce.serving_ok(str(p))
