@@ -273,6 +273,52 @@ def top_stalls(events: list, rank: Optional[int] = None, k: int = 8) -> list:
     return rows[:k]
 
 
+# membership events the control plane (train/control_plane.py) records:
+# the specific worker_left/worker_rejoined pair plus the generic
+# membership_transition stream (quarantine/readmit/probation transitions,
+# preemption). worker_left/worker_rejoined each ALSO emit a generic twin
+# (transition == their own name) so timeline consumers can subscribe to
+# one event name; the timeline below keeps the specific record and drops
+# the twin.
+MEMBERSHIP_EVENTS = ("worker_left", "worker_rejoined",
+                     "membership_transition")
+
+
+def membership_timeline(events: list,
+                        rank: Optional[int] = None) -> list:
+    """Chronological worker leave/join/quarantine timeline from the
+    control plane's journal events — surfaced alongside step attribution
+    so a step-time regression and the membership change that caused it
+    (a W−1 degraded phase votes on a smaller quorum; a rejoin heals
+    momentum at the boundary) read off one report. Every rank's trainer
+    runs its own plane and journals the same global transition, so with
+    ``rank=None`` identical rows from different ranks collapse to one
+    (like step_skew, membership is cross-rank-redundant by design)."""
+    rows, seen = [], set()
+    for r in events:
+        if r.get("kind") != "event" or r.get("name") not in MEMBERSHIP_EVENTS:
+            continue
+        if rank is not None and r.get("rank") != rank:
+            continue
+        if (r.get("name") == "membership_transition"
+                and r.get("transition") in ("worker_left",
+                                            "worker_rejoined")):
+            continue  # the specific record carries this transition
+        row = {"event": r["name"]}
+        for k in ("step", "worker", "cause", "transition", "alive",
+                  "world"):
+            if k in r:
+                row[k] = r[k]
+        key = tuple(sorted(row.items()))
+        if key in seen:
+            continue  # the same transition journaled by another rank
+        seen.add(key)
+        rows.append(row)
+    rows.sort(key=lambda r: (r.get("step", 0),
+                             0 if r["event"] == "worker_left" else 1))
+    return rows
+
+
 def step_skew(events: list) -> Optional[dict]:
     """Cross-host step-skew percentiles from the per-rank ``step_log``
     events on the merged wall timeline: for every step logged by more than
@@ -351,6 +397,7 @@ def analyze_dir(directory: str, rank: Optional[int] = None,
         "attribution": att,
         "top_stalls": top_stalls(loaded["events"], rank),
         "step_skew": step_skew(loaded["events"]),
+        "membership": membership_timeline(loaded["events"], rank),
     }
     if baseline:
         base_att = load_baseline_attribution(baseline)
@@ -393,6 +440,16 @@ def render(report: dict) -> str:
         for row in report["top_stalls"]:
             lines.append(f"  {row['name']:<22} {_fmt_s(row['s'])}  "
                          f"x{row['count']} (mean {row['mean_ms']:.2f} ms)")
+    if report.get("membership"):
+        lines.append("membership timeline:")
+        for r in report["membership"]:
+            what = r.get("transition") or r["event"]
+            who = (f"worker {r['worker']}" if "worker" in r else "process")
+            quorum = (f"  [alive {r['alive']}/{r['world']}]"
+                      if "alive" in r and "world" in r else "")
+            lines.append(f"  step {r.get('step', '?'):>6}  {who}: {what}"
+                         + (f" ({r['cause']})" if r.get("cause") else "")
+                         + quorum)
     skew = report.get("step_skew")
     if skew:
         lines.append(f"cross-host step skew over {skew['steps_compared']} "

@@ -99,6 +99,14 @@ def _safe_fields(fields: dict) -> dict:
             out[f"{k}_repr"] = repr(v)
         elif v is None or isinstance(v, (str, int, float, bool)):
             out[k] = v
+        elif isinstance(v, (list, tuple)) and all(
+                e is None or isinstance(e, (str, int, bool))
+                or (isinstance(e, float) and math.isfinite(e))
+                for e in v):
+            # flat scalar lists are valid strict JSON and survive as data
+            # (the control plane's mask_before/mask_after fields); anything
+            # nested or non-finite still falls through to repr
+            out[k] = list(v)
         else:
             out[k] = repr(v)
     return out

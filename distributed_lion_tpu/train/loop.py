@@ -60,7 +60,13 @@ from distributed_lion_tpu.parallel.mesh import (
     TENSOR_AXIS,
     data_axis_size,
 )
-from distributed_lion_tpu.train import journal, resilience, telemetry, vote_guard
+from distributed_lion_tpu.train import (
+    control_plane,
+    journal,
+    resilience,
+    telemetry,
+    vote_guard,
+)
 from distributed_lion_tpu.train.journal import emit
 from distributed_lion_tpu.train.checkpoint import Checkpointer
 from distributed_lion_tpu.train.metrics import MetricsLogger
@@ -292,6 +298,33 @@ class TrainConfig:
     # (train/resilience.parse_poison; baked into the step at trace time
     # through the resilience fault registry). Works with --vote_guard off
     # too — that is the degradation baseline the guard is measured against.
+    control_plane: bool = False  # unified membership control plane
+    # (train/control_plane.py): one host-side lifecycle per worker
+    # (healthy → suspect → quarantined → departed → rejoining → healthy)
+    # consuming the signals the NaN sentinel, the PreemptionGuard and the
+    # vote guard each held a slice of, whose single output is the alive
+    # mask the masked elections already accept. Live leave/join without a
+    # restart: a departing worker (injected worker_drop, repeated guard
+    # strikes, preemption) becomes a mask transition at the next dispatch
+    # boundary — training continues at W−1 — and a rejoining worker is
+    # re-absorbed in-run (momentum re-averaged from the healthy mean via
+    # heal_worker_momentum, ballot history reset, probation window). Auto-
+    # arms --vote_guard enforce when the guard is off (all-healthy enforce
+    # is pinned bit-identical to off); refuses 'observe' (it never touches
+    # the mask). Lion-only. In-run rejoin at --dcn_pipeline_depth > 0 is
+    # refused loudly, mirroring the elastic-resume rule.
+    rejoin_probe_steps: int = 0  # control plane: optimizer steps a
+    # rejoined worker stays on probation ('rejoining'). A rejoiner that
+    # re-strikes inside the window departs again (never the quarantine/
+    # readmit cycle a dead host would loop forever); a clean window
+    # promotes it to healthy. 0 = auto: --guard_cooldown.
+    inject_membership: str = ""  # membership fault injection for the
+    # control plane's evidence and tests: comma-separated
+    # 'worker_drop:<w>[:<start_step>]' / 'worker_rejoin:<w>:<step>' specs
+    # (train/resilience.parse_membership), consumed HOST-side at dispatch
+    # boundaries through the resilience fault registry — a drop/rejoin is
+    # a mask transition plus state surgery, never a trace change.
+    # Requires --control_plane (the plane is the only consumer).
 
     def schedule(self) -> Callable:
         if self.lr_scheduler_type == "cosine":
@@ -625,6 +658,30 @@ class Trainer:
             params_replicated=not _spec_sharded_axes(param_specs),
         )
         cfg = _resolve_row_block_auto(cfg, n_params, params)
+        cplane_auto_armed = False
+        if cfg.control_plane:
+            if not cfg.lion:
+                raise ValueError(
+                    "--control_plane drives the majority-vote election's "
+                    "membership mask; the AdamW path has no election — "
+                    "drop one of the two flags")
+            if cfg.vote_guard == "observe":
+                raise ValueError(
+                    "--control_plane needs masked elections to act on its "
+                    "membership decisions, but --vote_guard observe never "
+                    "touches the mask — use 'enforce' (or leave the guard "
+                    "off: the plane auto-arms enforce)")
+            if cfg.vote_guard == "off":
+                # all-healthy enforce is pinned bit-identical to off
+                # (tests/test_vote_guard.py), so arming the mask machinery
+                # never changes a healthy run's trajectory
+                cfg = dataclasses.replace(cfg, vote_guard="enforce")
+                cplane_auto_armed = True
+        if cfg.inject_membership and not cfg.control_plane:
+            raise ValueError(
+                "--inject_membership schedules live worker leave/join, "
+                "which only the control plane consumes — pass "
+                "--control_plane (or drop the injection)")
         self.cfg = cfg
         self.mesh = mesh
         self.world = data_axis_size(mesh)
@@ -739,6 +796,38 @@ class Trainer:
             cfg.guard_cooldown, cfg.min_quorum, journal=self.journal)
             if cfg.lion and cfg.vote_guard != "off" else None)
         self._guard_pending = None  # (step, obs-device-arrays, advanced)
+        self._cplane = (control_plane.make_control_plane(
+            self._guard, self.world, cfg.rejoin_probe_steps,
+            cfg.dcn_pipeline_depth, journal=self.journal)
+            if cfg.control_plane else None)
+        if cplane_auto_armed:
+            emit("[trainer] control plane: --vote_guard auto-armed to "
+                 "'enforce' (the plane's membership mask rides the guard's "
+                 "masked elections; all-healthy enforce is bit-identical "
+                 "to off)")
+        if cfg.inject_membership:
+            sched = resilience.parse_membership_specs(cfg.inject_membership)
+            bad = [(k, w) for k, w, _ in sched if w >= self.world]
+            if bad:
+                # fail at construction, not steps into the run
+                raise ValueError(
+                    f"--inject_membership names worker(s) "
+                    f"{sorted(set(w for _, w in bad))} outside world "
+                    f"{self.world}: {cfg.inject_membership!r}")
+            if cfg.dcn_pipeline_depth > 0 and any(
+                    k == "worker_rejoin" for k, _, _ in sched):
+                # fail at construction, not steps into the run: the in-run
+                # rejoin mirrors the elastic-resume depth rule (the DCN
+                # ring's in-flight slots are functions of the membership)
+                raise ValueError(
+                    "--inject_membership schedules a worker_rejoin but "
+                    f"--dcn_pipeline_depth {cfg.dcn_pipeline_depth} > 0: "
+                    "the in-flight DCN tally ring cannot re-absorb a "
+                    "worker mid-flight (the same reason --elastic_resume "
+                    "refuses depth > 0). Run the rejoin at depth 0")
+            resilience.inject_fault("membership", sched)
+            emit(f"[trainer] FAULT INJECTION armed: membership "
+                 f"{cfg.inject_membership!r}")
         if cfg.inject_poison:
             # route the spec through the resilience fault registry — the
             # same transport tests use directly; the step bakes it in at
@@ -982,36 +1071,36 @@ class Trainer:
         seen.add(sig)
         emit(f"[trainer] {msg}")
 
-    def _apply_guard(self, step: int, obs: dict, advanced: int) -> None:
-        """Drive the host quarantine machine with one dispatch's guard
-        observations (device arrays fetched HERE, one dispatch behind — the
-        values finished computing long ago, so the get is a cheap copy),
-        then act on its transitions: push the refreshed health mask to the
-        device state, heal readmitted momenta from the healthy mean, and
-        enforce the quorum floor."""
-        if not obs:
-            return
-        host = {k: np.asarray(jax.device_get(v)) for k, v in obs.items()}
-        events = self._guard.update(step, host, advanced)
-        for line in events.logs:
-            emit(f"[trainer] vote guard: {line}")
-        if self.cfg.vote_guard != "enforce":
-            return  # observe mode: bookkeeping + logs only
-        if events.readmitted:
-            # readmission healing: the healed worker's momentum restarts at
-            # the HEALTHY mean (the vote distribution's center — the same
+    def _enforce_events(self, step: int, heal: list, reset_ballot: list,
+                        mask_changed: bool) -> None:
+        """Act on guard/control-plane transitions against the device
+        state: heal momenta from the healthy mean, zero rejoiners' ballot
+        history, push the refreshed health mask, and enforce the quorum
+        floor. The one place optimizer-state surgery happens — the guard
+        and the plane only decide."""
+        if heal:
+            # healing: the healed worker's momentum restarts at the
+            # HEALTHY mean (the vote distribution's center — the same
             # quantity the elastic-resume remap preserves) instead of
-            # whatever it drifted or was poisoned to while quarantined
+            # whatever it drifted or was poisoned to while away
             source = np.array(self._guard.healthy, dtype=bool)
-            for w in events.readmitted:
+            for w in heal:
                 source[w] = False  # a healed worker is not its own source
-            exp_avg = heal_worker_momentum(self.state.exp_avg, source,
-                                           events.readmitted)
+            exp_avg = heal_worker_momentum(self.state.exp_avg, source, heal)
             exp_avg = jax.device_put(
                 exp_avg, jax.tree.map(lambda s: NamedSharding(self.mesh, s),
                                       self._exp_avg_specs))
             self.state = self.state._replace(exp_avg=exp_avg)
-        if events.mask_changed:
+        if reset_ballot and self.state.prev_ballot is not None:
+            # a rejoiner's frozen-ballot XOR base must not reference a
+            # vote it cast before it left; zeros read as 'no real previous
+            # election' to the flip detector (flip_valid gates on it)
+            prev = jnp.asarray(self.state.prev_ballot)
+            for w in reset_ballot:
+                prev = prev.at[w].set(0)
+            self.state = self.state._replace(prev_ballot=jax.device_put(
+                prev, NamedSharding(self.mesh, P(DATA_AXIS))))
+        if mask_changed:
             # same shape/dtype as before — no retrace; the next dispatch's
             # elections exclude (or re-include) the flipped workers
             self.state = self.state._replace(health=jax.device_put(
@@ -1021,6 +1110,8 @@ class Trainer:
             if self.checkpointer:
                 # the last good checkpoint must be durable before we refuse
                 self.checkpointer.finalize()
+            if self._cplane is not None:
+                raise RuntimeError(self._cplane.quorum_error(step))
             raise RuntimeError(
                 f"vote guard: healthy quorum {self._guard.healthy_count()}/"
                 f"{self.world} fell below --min_quorum "
@@ -1028,6 +1119,42 @@ class Trainer:
                 "election with a sick majority is noise, refusing to "
                 f"continue. Sick workers: {self._guard.sick_workers()} "
                 f"(counters: {self._guard.sick_report()['sick_workers']})")
+
+    def _apply_guard(self, step: int, obs: dict, advanced: int) -> None:
+        """Drive the host quarantine machine — or, under --control_plane,
+        the unified membership lifecycle — with one dispatch's guard
+        observations (device arrays fetched HERE, one dispatch behind — the
+        values finished computing long ago, so the get is a cheap copy),
+        then act on the transitions via :meth:`_enforce_events`."""
+        if not obs:
+            return
+        host = {k: np.asarray(jax.device_get(v)) for k, v in obs.items()}
+        if self._cplane is not None:
+            events = self._cplane.observe(step, host, advanced)
+            heal, reset_ballot = events.heal, events.reset_ballot
+            tag = "control plane"
+        else:
+            events = self._guard.update(step, host, advanced)
+            heal, reset_ballot = events.readmitted, []
+            tag = "vote guard"
+        for line in events.logs:
+            emit(f"[trainer] {tag}: {line}")
+        if self.cfg.vote_guard != "enforce":
+            return  # observe mode: bookkeeping + logs only
+        self._enforce_events(step, heal, reset_ballot, events.mask_changed)
+
+    def _apply_membership(self, step: int) -> None:
+        """Consume due membership transitions (injected worker_drop /
+        worker_rejoin) at a dispatch boundary, BEFORE the dispatch — so a
+        drop scheduled for step s is already masked out of step s+1's
+        election (and a step-0 drop out of the very first), and a
+        rejoiner's healed momentum enters the very next vote."""
+        events = self._cplane.membership_due(step)
+        for line in events.logs:
+            emit(f"[trainer] control plane: {line}")
+        if events.left or events.rejoined or events.mask_changed:
+            self._enforce_events(step, events.heal, events.reset_ballot,
+                                 events.mask_changed)
 
     def _check_sentinel(self, step: int, metrics,
                         force_raise: bool = False) -> None:
@@ -1065,7 +1192,8 @@ class Trainer:
                 self.cfg.output_dir, step, reason,
                 dataclasses.asdict(self.cfg), self.params, self.state,
                 window,
-                guard=(self._guard.sick_report()
+                guard=(self._cplane.report() if self._cplane is not None
+                       else self._guard.sick_report()
                        if self._guard is not None else None),
                 journal_tail=self.journal.tail())
             emit(f"[trainer] crash bundle written to {crash_dir}")
@@ -1365,6 +1493,11 @@ class Trainer:
         jr.event("train_start", step=self.step_count, total=int(total))
 
         while self.step_count < total:
+            if self._cplane is not None:
+                # membership transitions land at dispatch boundaries: a
+                # due drop is masked out of the NEXT election, a due
+                # rejoin is healed before it votes again
+                self._apply_membership(self.step_count)
             self.profiler.maybe_start(self.step_count)
             k = min(self.cfg.steps_per_call, total - self.step_count)
             advanced = k
@@ -1535,6 +1668,8 @@ class Trainer:
                     # scalar guard health for the record stream (the [W]
                     # observation vectors were popped above)
                     m.update(self._guard.summary())
+                if self._cplane is not None:
+                    m.update(self._cplane.summary())
                 if hasattr(train_iter, "health_metrics"):
                     # input-pipeline health (e.g. the native loader's
                     # skipped_shards / shard_read_retries counters) rides
@@ -1578,6 +1713,10 @@ class Trainer:
                 # boundary. Drain the in-flight async save, make the
                 # emergency checkpoint durable, and return cleanly — the
                 # caller exits 0 and the watcher restarts into a resume.
+                if self._cplane is not None:
+                    # the one membership stream records the departure too:
+                    # a preempted process is every local worker leaving
+                    self._cplane.note_preempt(self.step_count)
                 if self.checkpointer:
                     emit(f"[trainer] preemption at step {self.step_count}:"
                           " draining in-flight save, writing emergency "
@@ -1689,16 +1828,33 @@ class Trainer:
         assert self.checkpointer is not None
         if self.checkpointer.latest_step() == self.step_count:
             return  # already saved at this step (e.g. final save on a save_steps boundary)
-        self.checkpointer.save(
-            self.step_count, self._payload(),
-            meta={"world": self.world, "tag": tag,
-                  "step": self.step_count,
-                  "batches_consumed": self.step_count,
-                  "has_vote_health": self._telemetry_on,
-                  "has_guard": self._guard is not None,
-                  "wire": self.cfg.wire, "vote_every": self.cfg.vote_every,
-                  "dcn_pipeline_depth": self.cfg.dcn_pipeline_depth,
-                  **self.data_meta})
+        meta = {"world": self.world, "tag": tag,
+                "step": self.step_count,
+                "batches_consumed": self.step_count,
+                "has_vote_health": self._telemetry_on,
+                "has_guard": self._guard is not None,
+                "wire": self.cfg.wire, "vote_every": self.cfg.vote_every,
+                "dcn_pipeline_depth": self.cfg.dcn_pipeline_depth,
+                "control_plane": self._cplane is not None,
+                **self.data_meta}
+        if self._cplane is not None:
+            # mid-run membership survives the restart: the mask itself
+            # rides LionState.health, but departed-vs-quarantined is plane
+            # state — without this stamp a resume would auto-readmit a
+            # worker the run knew was GONE
+            meta["cp_departed"] = sorted(
+                int(w) for w in self._cplane.departed)
+            # the consumed-schedule watermark: a resume must not replay
+            # drop/rejoin entries this run already acted on
+            meta["cp_sched_through"] = int(self._cplane.sched_through)
+            # probation windows + quarantine history: a crash mid-probation
+            # must resume the probe-fail rule (a still-sick rejoiner
+            # departs again), not fall back to the cooldown cycle
+            meta["cp_rejoining_until"] = [
+                int(x) for x in self._cplane.rejoining_until]
+            meta["cp_quarantine_counts"] = [
+                int(x) for x in self._cplane.quarantine_counts]
+        self.checkpointer.save(self.step_count, self._payload(), meta=meta)
 
     def _with_guard_fields(self, tpl: dict, on: bool,
                            world: Optional[int] = None) -> dict:
@@ -1800,12 +1956,17 @@ class Trainer:
                                       world=ckpt_world)
         return tpl
 
-    def _adopt_guard_state(self, step: int) -> None:
+    def _adopt_guard_state(self, step: int, meta: Optional[dict] = None) -> None:
         """Reconcile the restored state's guard fields with THIS run's
         guard flag: adopt a checkpointed health mask exactly (quarantined
         workers resume quarantined, cooldown restarting at the resumed
         step), attach fresh guard state when the checkpoint predates the
-        guard, strip it when the guard is off now."""
+        guard, strip it when the guard is off now. Under --control_plane
+        the manifest meta's ``cp_departed`` stamp restores the
+        departed-vs-quarantined distinction (a control-plane toggle in
+        either direction is tolerated like the guard toggle: a plane-off
+        resume degrades departed workers to plain quarantine, a plane-on
+        resume of a plane-off checkpoint starts with nobody departed)."""
         st = self.state
         if self._guard is not None:
             if st.health is None or st.prev_ballot is None:
@@ -1813,11 +1974,27 @@ class Trainer:
                 self.state = st._replace(health=health, prev_ballot=prev)
             else:
                 mask = np.asarray(jax.device_get(st.health), dtype=bool)
-                self._guard.adopt_mask(mask, step)
-                if not mask.all():
-                    emit("[trainer] vote guard: resumed with quarantined "
-                          f"workers {[int(w) for w in np.nonzero(~mask)[0]]}"
-                          f" (cooldown restarts at step {step})")
+                if self._cplane is not None:
+                    m = meta or {}
+                    self._cplane.adopt(
+                        mask, step,
+                        departed=m.get("cp_departed"),
+                        sched_through=m.get("cp_sched_through"),
+                        rejoining_until=m.get("cp_rejoining_until"),
+                        quarantine_counts=m.get("cp_quarantine_counts"))
+                    lc = self._cplane.lifecycle()
+                    if not mask.all():
+                        emit("[trainer] control plane: resumed with "
+                             "lifecycle "
+                             f"{dict((w, s) for w, s in enumerate(lc) if s != 'healthy')}"
+                             f" at step {step}")
+                else:
+                    self._guard.adopt_mask(mask, step)
+                    if not mask.all():
+                        emit("[trainer] vote guard: resumed with "
+                             "quarantined workers "
+                             f"{[int(w) for w in np.nonzero(~mask)[0]]}"
+                             f" (cooldown restarts at step {step})")
         elif st.health is not None or st.prev_ballot is not None:
             self.state = st._replace(health=None, prev_ballot=None)
 
@@ -1882,7 +2059,7 @@ class Trainer:
             self.params = restored["params"]
             self.state = self._unpack_state_rng(restored["opt_state"])
             if self.cfg.lion:
-                self._adopt_guard_state(step)
+                self._adopt_guard_state(step, meta)
             if ("vote_health" in restored and self._telemetry_on
                     and ckpt_ve == (self.cfg.vote_every or 1)):
                 # adopt the accumulator only when its packing still matches
@@ -2047,6 +2224,10 @@ class Trainer:
             # disarm the poison this trainer injected so a later Trainer in
             # the same process does not inherit a sick worker
             resilience.inject_fault("ballot_poison", None)
+        if self.cfg.inject_membership:
+            # same hygiene for the membership schedule (unconsumed entries
+            # must not fire inside a later Trainer's run)
+            resilience.inject_fault("membership", None)
         if self._preempt_guard is not None:
             self._preempt_guard.close()
         try:

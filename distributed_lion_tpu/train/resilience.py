@@ -71,6 +71,16 @@ from typing import Any, Iterable, Optional
 #                         Inject BEFORE the first dispatch; start_step gates
 #                         the onset against the traced optimizer count, so
 #                         mid-run onset needs no retrace.)
+#   membership           (list of (kind, worker, step) from
+#                         parse_membership_specs(): live leave/join
+#                         schedule the control plane
+#                         (train/control_plane.py) consumes at dispatch
+#                         boundaries — worker_drop masks the worker out of
+#                         the election (departed, no restart), worker_rejoin
+#                         re-absorbs it in-run (momentum healed from the
+#                         healthy mean, ballot history reset, probation
+#                         window). Host-side only: membership transitions
+#                         are mask flips between dispatches, never traced.)
 _FAULTS: dict[str, Any] = {}
 _FAULTS_LOCK = threading.Lock()
 
@@ -91,6 +101,49 @@ def fault(name: str, default: Any = None) -> Any:
 
 
 POISON_KINDS = ("nan_grads", "frozen_ballot", "flipped_ballot")
+
+MEMBERSHIP_KINDS = ("worker_drop", "worker_rejoin")
+
+
+def parse_membership(spec: str) -> tuple[str, int, int]:
+    """Parse one membership-fault spec — ``worker_drop:<w>[:<start_step>]``
+    or ``worker_rejoin:<w>:<step>`` — into ``(kind, worker, step)``. The
+    control plane (train/control_plane.py) consumes these at dispatch
+    boundaries: a drop masks the worker out of the election at the first
+    boundary at or after ``step`` (default 0 — departed from the very
+    first dispatch), a rejoin re-absorbs it in-run (momentum healed from
+    the healthy mean, ballot history reset). A rejoin REQUIRES an explicit
+    step: rejoining a worker that never left is undefined, so the schedule
+    must be stated. Single source of truth for the --inject_membership CLI
+    flag and direct registry injection in tests/the runbook."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3) or parts[0] not in MEMBERSHIP_KINDS:
+        raise ValueError(
+            f"bad membership spec {spec!r}: expected '<kind>:<worker>"
+            f"[:<step>]' with kind in {MEMBERSHIP_KINDS}")
+    if parts[0] == "worker_rejoin" and len(parts) != 3:
+        raise ValueError(
+            f"bad membership spec {spec!r}: worker_rejoin requires an "
+            "explicit step ('worker_rejoin:<worker>:<step>')")
+    try:
+        worker = int(parts[1])
+        step = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        raise ValueError(f"bad membership spec {spec!r}: worker/step must "
+                         "be integers")
+    if worker < 0 or step < 0:
+        raise ValueError(f"bad membership spec {spec!r}: worker/step must "
+                         "be >= 0")
+    return parts[0], worker, step
+
+
+def parse_membership_specs(specs: str) -> list:
+    """Comma-separated membership specs (the --inject_membership flag) →
+    the ``membership`` fault registry value: a list of (kind, worker, step)
+    tuples, consumed in order by the control plane as their steps come
+    due."""
+    return [parse_membership(s.strip())
+            for s in specs.split(",") if s.strip()]
 
 
 def parse_poison(spec: str) -> tuple[str, int, int]:

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Live-elasticity evidence (ISSUE 10): the control plane's worker
+leave/join without a restart, exercised end-to-end on a W=4 mesh.
+
+Writes ONE strict-JSON artifact, ``<out>/elasticity.json`` (schema in
+scripts/validate_metrics.py; judged by check_evidence's ``elasticity``
+stage):
+
+- ``survive`` — the headline scenario: a run that drops worker 2 at step
+  k (``--drop_step``) and re-absorbs it at step k+m (``--rejoin_step``)
+  completes every step without restart or stall, keeps every loss and
+  momentum finite, counts exactly one leave and one rejoin, and ends
+  all-healthy.
+- ``bit_identity`` — the degraded-phase pin: a run whose worker departed
+  BEFORE the first dispatch (``worker_drop:2:0``) is byte-identical —
+  loss curve — to a from-scratch W−1 masked run (the PR 5 masked-election
+  machinery driven by hand, an independent path to the same mask). While
+  degraded, "worker left" is a mask transition and nothing more. Plus
+  determinism: two identical drop/rejoin runs produce identical curves.
+- ``timeline`` — the drop/rejoin leg's membership events as
+  ``cli/run_analyze.membership_timeline`` reads them back from the run
+  journal (the artifact proves the journal/analyzer leg too).
+- ``parity`` — the post-rejoin bound, pre-registered BEFORE capture: the
+  drop/rejoin run's tail-mean loss vs the always-healthy clean run's.
+  Full scale (>= PARITY_FULL_MIN_PARAMS): the absolute
+  ``ELASTIC_PARITY_EPS_NATS``. Reduced CPU scale (this script's default
+  tiny shape): tiny-scale tails move by O(0.1) nats under ANY change to
+  the election sequence, so the criterion is RELATIVE — the transient
+  degradation must cost no more than
+  max(ELASTIC_PARITY_EPS_NATS_REDUCED, RELATIVE_FACTOR x the benign gap),
+  where the benign gap is the tail gap of a PERMANENTLY degraded
+  (never-rejoined) run vs clean: a drop that heals must not cost more
+  than 1.5x a drop that never does. Both gaps are recorded so the
+  judgement is inspectable.
+
+CPU is first-class here, like bench_dcn: membership transitions are
+host-side mask flips on every backend (the point is the control-plane
+mechanism, not chip throughput); ``meta.backend`` records what measured
+it. The runbook re-captures on chip (stage 5i).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import sys
+
+# W=4 needs 4 devices; on a bare CPU host jax exposes 1 — fork BEFORE jax
+# loads (the conftest trick). TPU/GPU backends are left untouched.
+if os.environ.get("JAX_PLATFORMS", "") == "cpu" or not os.environ.get(
+        "JAX_PLATFORMS"):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=4").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# ---- pre-registered criteria (fixed BEFORE the data lands) ----
+ELASTIC_PARITY_EPS_NATS = 0.05          # full-scale absolute bound
+ELASTIC_PARITY_EPS_NATS_REDUCED = 0.10  # reduced-scale floor
+ELASTIC_PARITY_RELATIVE_FACTOR = 1.5    # x the permanent-degradation gap
+PARITY_FULL_MIN_PARAMS = 10_000_000
+PARITY_TAIL_FRAC = 0.75                 # tail window start
+
+WORLD = 4
+DROP_WORKER = 2
+
+
+def _mesh():
+    import jax
+
+    from distributed_lion_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < WORLD:
+        raise SystemExit(f"bench_elasticity needs >= {WORLD} devices, "
+                         f"have {len(jax.devices())}")
+    return make_mesh(data=WORLD, devices=jax.devices()[:WORLD])
+
+
+def _model_cfg():
+    from distributed_lion_tpu.models.gpt2 import GPT2Config
+
+    return GPT2Config.tiny(vocab_size=512, n_layer=2, n_head=4,
+                           d_model=128, n_ctx=64)
+
+
+def _train_cfg(steps, **kw):
+    from distributed_lion_tpu.train.loop import TrainConfig
+
+    base = dict(
+        lion=True, async_grad=True, wire="sign_psum", vote_every=1,
+        vote_buckets=1, learning_rate=1e-3, lr_scheduler_type="constant",
+        warmup_steps=2, max_steps=steps, per_device_train_batch_size=2,
+        gradient_accumulation_steps=1, block_size=64, logging_steps=1,
+        eval_steps=10**9, save_steps=10**9, output_dir=None,
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _run_leg(steps, *, membership="", control_plane=None, mask=None,
+             journal_dir=""):
+    """One training leg → (curve {step: loss}, trainer facts dict).
+    ``membership`` arms the control plane's drop/rejoin schedule;
+    ``mask`` runs the PR 5 masked-from-scratch reference instead (guard
+    enforce, mask set by hand — an independent path to the same masked
+    election)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.data.sources import (
+        batch_iterator,
+        synthetic_lm_dataset,
+    )
+    from distributed_lion_tpu.train import resilience
+    from distributed_lion_tpu.train.loop import Trainer
+
+    model = _model_cfg()
+    mesh = _mesh()
+    if control_plane is None:
+        control_plane = not mask
+    cfg = _train_cfg(
+        steps, control_plane=control_plane,
+        inject_membership=membership,
+        vote_guard="enforce" if mask is not None else "off",
+        # the masked-from-scratch reference runs the plain guard, whose
+        # default cooldown would READMIT the hand-masked worker at step
+        # ~50 (heal + mask flip) while the compared departed leg never
+        # readmits by plane authority — pin readmission off so the
+        # bit-identity comparison holds at any --identity_steps
+        guard_cooldown=10**9 if mask is not None else 50,
+        journal=bool(journal_dir), journal_dir=journal_dir)
+    resilience.clear_faults()
+    tr = Trainer.for_gpt2(cfg, mesh, model, seed=3)
+    if mask is not None:
+        tr.state = tr.state._replace(health=jnp.asarray(mask))
+        tr._guard.adopt_mask(mask, step=0)
+    blocks = synthetic_lm_dataset(
+        max(64, tr.global_train_batch()), 64, model.vocab_size, seed=1)
+    it = batch_iterator(blocks, tr.global_train_batch(), seed=5)
+    hist = tr.train(it, max_steps=steps)
+    losses = [h["loss"] for h in hist if "loss" in h]
+    facts = {
+        "completed_steps": int(tr.step_count),
+        "finite": bool(np.all(np.isfinite(losses))) and all(
+            bool(np.isfinite(np.asarray(m)).all())
+            for m in jax.tree.leaves(tr.state.exp_avg)),
+        "final_alive": int(np.asarray(tr.state.health).sum())
+        if tr.state.health is not None else WORLD,
+        "left_events": (tr._cplane.left_events if tr._cplane else 0),
+        "rejoin_events": (tr._cplane.rejoin_events if tr._cplane else 0),
+        "lifecycle": (tr._cplane.lifecycle() if tr._cplane
+                      else ["healthy"] * WORLD),
+    }
+    tr.close()
+    resilience.clear_faults()
+    return {h["step"]: h["loss"] for h in hist if "loss" in h}, facts
+
+
+def _tail_gap(a: dict, b: dict, steps: int) -> float:
+    common = [s for s in sorted(set(a) & set(b))
+              if s >= PARITY_TAIL_FRAC * steps]
+    return sum(abs(a[s] - b[s]) for s in common) / max(len(common), 1)
+
+
+def _run_analyze_module():
+    spec = importlib.util.spec_from_file_location(
+        "dlt_run_analyze_elastic",
+        os.path.join(REPO, "distributed_lion_tpu", "cli", "run_analyze.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO, "runs",
+                                                  "elasticity"))
+    ap.add_argument("--steps", type=int, default=80,
+                    help="scenario leg length (optimizer steps)")
+    ap.add_argument("--drop_step", type=int, default=10)
+    ap.add_argument("--rejoin_step", type=int, default=30)
+    ap.add_argument("--identity_steps", type=int, default=16,
+                    help="length of the degraded bit-identity legs")
+    args = ap.parse_args()
+    if not 0 < args.drop_step < args.rejoin_step < args.steps:
+        raise SystemExit("need 0 < drop_step < rejoin_step < steps")
+
+    import jax
+
+    backend = jax.devices()[0].platform
+    from distributed_lion_tpu.models.gpt2 import count_params, gpt2_init
+
+    n_params = count_params(gpt2_init(jax.random.key(0), _model_cfg()))
+    os.makedirs(args.out, exist_ok=True)
+    spec = (f"worker_drop:{DROP_WORKER}:{args.drop_step},"
+            f"worker_rejoin:{DROP_WORKER}:{args.rejoin_step}")
+
+    # ---- the headline scenario: drop at k, rejoin at k+m, journaled
+    print(f"[bench_elasticity] drop/rejoin leg ({spec})", flush=True)
+    jdir = os.path.join(args.out, "journal")
+    # the journal sink appends: a re-capture over a previous artifact
+    # (runbook stage 5i re-runs into the committed runs/elasticity) must
+    # not merge the stale run's events into the fresh timeline
+    shutil.rmtree(jdir, ignore_errors=True)
+    c_scenario, facts = _run_leg(args.steps, membership=spec,
+                                 journal_dir=jdir)
+    survive = {
+        "completed": facts["completed_steps"] == args.steps,
+        "steps": facts["completed_steps"],
+        "finite": facts["finite"],
+        "left_events": facts["left_events"],
+        "rejoin_events": facts["rejoin_events"],
+        "final_alive": facts["final_alive"],
+        "final_lifecycle": facts["lifecycle"],
+    }
+
+    # ---- determinism: the same schedule reproduces the same curve
+    print("[bench_elasticity] drop/rejoin determinism leg", flush=True)
+    c_scenario2, _ = _run_leg(args.steps, membership=spec)
+
+    # ---- degraded bit-identity: departed-from-step-0 == masked-from-
+    # scratch (the independent PR 5 path to the same masked election)
+    print("[bench_elasticity] degraded bit-identity legs", flush=True)
+    c_drop0, _ = _run_leg(args.identity_steps,
+                          membership=f"worker_drop:{DROP_WORKER}:0")
+    mask = [w != DROP_WORKER for w in range(WORLD)]
+    c_masked, _ = _run_leg(args.identity_steps, mask=mask)
+    bit_identity = {
+        "degraded_vs_masked": c_drop0 == c_masked,
+        "drop_deterministic": c_scenario == c_scenario2,
+    }
+
+    # ---- parity: clean + permanently-degraded comparators
+    print("[bench_elasticity] clean + permanent-degradation legs",
+          flush=True)
+    c_clean, _ = _run_leg(args.steps)
+    c_perm, _ = _run_leg(args.steps,
+                         membership=f"worker_drop:{DROP_WORKER}:"
+                                    f"{args.drop_step}")
+    rejoin_gap = _tail_gap(c_scenario, c_clean, args.steps)
+    benign = _tail_gap(c_perm, c_clean, args.steps)
+    full_scale = n_params >= PARITY_FULL_MIN_PARAMS
+    bound = (ELASTIC_PARITY_EPS_NATS if full_scale
+             else max(ELASTIC_PARITY_EPS_NATS_REDUCED,
+                      ELASTIC_PARITY_RELATIVE_FACTOR * benign))
+    parity = {
+        "bound_nats": round(bound, 6),
+        "scale": "full" if full_scale else "reduced",
+        "benign_permanent_gap_nats": round(benign, 6),
+        "relative_factor": (None if full_scale
+                            else ELASTIC_PARITY_RELATIVE_FACTOR),
+        "tail_frac": PARITY_TAIL_FRAC,
+        "rejoin_gap_nats": round(rejoin_gap, 6),
+        "pass": rejoin_gap <= bound,
+    }
+
+    # ---- the journal's view of the scenario, read back through the
+    # analyzer (proves the membership-timeline leg end to end)
+    try:
+        report = _run_analyze_module().analyze_dir(jdir)
+        timeline = (report or {}).get("membership") or []
+    except Exception as e:
+        print(f"[bench_elasticity] run_analyze failed: {e}", flush=True)
+        timeline = []
+
+    doc = {
+        "meta": {
+            "backend": backend, "world": WORLD, "wire": "sign_psum",
+            "n_params": int(n_params), "steps": args.steps,
+            "drop_worker": DROP_WORKER, "drop_step": args.drop_step,
+            "rejoin_step": args.rejoin_step,
+            "note": "CPU-produced artifacts are first-class here: "
+                    "membership transitions are host-side mask flips on "
+                    "every backend (see module doc)",
+        },
+        "survive": survive,
+        "bit_identity": bit_identity,
+        "timeline": timeline,
+        "parity": parity,
+    }
+    path = os.path.join(args.out, "elasticity.json")
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, allow_nan=False)
+        f.write("\n")
+    ok = (survive["completed"] and survive["finite"]
+          and survive["left_events"] == 1 and survive["rejoin_events"] == 1
+          and survive["final_alive"] == WORLD
+          and bit_identity["degraded_vs_masked"]
+          and bit_identity["drop_deterministic"] and parity["pass"])
+    print(json.dumps({"artifact": path, "survive": survive["completed"],
+                      "bit_identity": bit_identity,
+                      "parity_pass": parity["pass"],
+                      "rejoin_gap_nats": parity["rejoin_gap_nats"],
+                      "bound_nats": parity["bound_nats"]},
+                     allow_nan=False), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py journal        # run-journal attribution
     python scripts/check_evidence.py dcn_overlap    # pipelined hier DCN leg
     python scripts/check_evidence.py serving        # paged-KV decode bench
+    python scripts/check_evidence.py elasticity     # live worker leave/join
     python scripts/check_evidence.py all
 
 parity:vote / parity:lazy are STRICT since ISSUE 6: a leg counts as
@@ -619,6 +620,53 @@ def serving_ok(path: str = SERVE_ARTIFACT) -> bool:
     return True
 
 
+# the live-elasticity stage (ISSUE 10): scripts/bench_elasticity.py's
+# artifact under runs/elasticity — (a) passes the strict elasticity.json
+# schema (validate_metrics, loaded by FILE PATH so this script stays
+# jax-free), (b) the headline drop/rejoin scenario SURVIVED: every step
+# completed without restart, losses/momenta finite, exactly one leave and
+# one rejoin, ending all-healthy at full W, (c) both degraded-phase
+# bit-identity markers hold (departed-from-step-0 == masked-from-scratch
+# W−1; the drop/rejoin schedule is deterministic), (d) the journal-read
+# membership timeline carries the worker_left AND worker_rejoined events
+# (the run_analyze leg actually closed), and (e) the pre-registered
+# post-rejoin parity bound PASSED. A CPU-produced artifact is first-class
+# here: membership transitions are host-side mask flips on every backend
+# (the point is the control-plane mechanism, not chip throughput);
+# meta.backend records what measured it and the runbook re-captures on
+# chip (stage 5i).
+ELASTICITY_ARTIFACT = os.path.join(REPO, "runs", "elasticity",
+                                   "elasticity.json")
+
+
+def elasticity_ok(path: str = ELASTICITY_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    sv = doc.get("survive", {})
+    world = doc.get("meta", {}).get("world")
+    if not (sv.get("completed") is True and sv.get("finite") is True
+            and sv.get("left_events") == 1 and sv.get("rejoin_events") == 1
+            and sv.get("final_alive") == world):
+        return False
+    bits = doc.get("bit_identity", {})
+    if not (bits.get("degraded_vs_masked") is True
+            and bits.get("drop_deterministic") is True):
+        return False
+    names = [r.get("event") for r in doc.get("timeline", [])]
+    if not ("worker_left" in names and "worker_rejoined" in names):
+        return False
+    return doc.get("parity", {}).get("pass") is True
+
+
 def journal_ok(dirname: str = "journal") -> bool:
     base = (dirname if os.path.isabs(dirname)
             else os.path.join(REPO, "runs", dirname))
@@ -657,6 +705,7 @@ STAGES = [
     ("journal", journal_ok),
     ("dcn_overlap", dcn_overlap_ok),
     ("serving", serving_ok),
+    ("elasticity", elasticity_ok),
 ]
 
 # automation (the watcher exit condition) judges the parity legs on
@@ -725,6 +774,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return dcn_overlap_ok(arg or DCN_ARTIFACT)
     if what == "serving":
         return serving_ok(arg or SERVE_ARTIFACT)
+    if what == "elasticity":
+        return elasticity_ok(arg or ELASTICITY_ARTIFACT)
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -36,9 +36,12 @@ tuning cache (``tuning_cache.json`` — full check delegated to
 ops/autotune.validate_cache_doc, the cache's single schema authority),
 the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
-rows are strict-validated per row), and the serving-bench artifact
+rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
-bit-identity document, per-row validated the same way).
+bit-identity document, per-row validated the same way), and the
+live-elasticity artifact (``elasticity.json`` —
+scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
+document; timeline rows are strict-validated per row).
 The same NaN-token rejection applies: all the writers pass
 ``allow_nan=False`` and this script is the CI check that they keep
 doing so.
@@ -175,9 +178,9 @@ def validate_journal_file(path: str) -> list[str]:
 # (tuning_cache.json is NOT listed here: it dispatches below on its
 # embedded format stamp — any filename, e.g. a $DLT_TUNE_CACHE override —
 # and delegates wholesale to ops/autotune.validate_cache_doc.
-# dcn_overlap.json and serving.json have their own branches too: their
-# rows carry per-row schemas the generic required-keys check can't
-# express.)
+# dcn_overlap.json, serving.json and elasticity.json have their own
+# branches too: their rows carry per-row schemas the generic
+# required-keys check can't express.)
 _DOC_SCHEMAS = {
     "bundle.json": ("step", "reason", "config"),
     "manifest.json": ("format", "step", "files"),
@@ -271,6 +274,78 @@ def _dcn_overlap_errors(path: str, doc: dict) -> list[str]:
         if isinstance(sec, dict) and not isinstance(sec.get(key), bool):
             errors.append(f"{path}: {section}.{key} must be a bool")
     return errors
+
+
+def _elasticity_errors(path: str, doc: dict) -> list[str]:
+    """Strict schema of the live-elasticity evidence artifact
+    (scripts/bench_elasticity.py; judged by check_evidence's
+    ``elasticity`` stage): the headline drop/rejoin scenario's survival
+    facts, the two degraded-phase bit-identity markers, the journal-read
+    membership timeline (per-row validated — every row one control-plane
+    event with step/cause/quorum), and the pre-registered post-rejoin
+    parity judgement."""
+    errors = []
+    for key in ("meta", "survive", "bit_identity", "timeline", "parity"):
+        if key not in doc:
+            errors.append(f"{path}: missing required key {key!r}")
+        elif key != "timeline" and not isinstance(doc[key], dict):
+            # a present-but-wrong-type section must fail the strict
+            # schema, not slip past the per-field checks (which would let
+            # check_evidence's judgement crash on it downstream)
+            errors.append(f"{path}: {key!r} must be an object")
+    meta = doc.get("meta")
+    if isinstance(meta, dict):
+        if not isinstance(meta.get("backend"), str):
+            errors.append(f"{path}: meta.backend must be a string")
+        for k in ("world", "steps", "drop_worker", "drop_step",
+                  "rejoin_step"):
+            if not isinstance(meta.get(k), int):
+                errors.append(f"{path}: meta.{k} must be an integer")
+    sv = doc.get("survive")
+    if isinstance(sv, dict):
+        for k in ("completed", "finite"):
+            if not isinstance(sv.get(k), bool):
+                errors.append(f"{path}: survive.{k} must be a bool")
+        for k in ("steps", "left_events", "rejoin_events", "final_alive"):
+            if not isinstance(sv.get(k), int):
+                errors.append(f"{path}: survive.{k} must be an integer")
+        lc = sv.get("final_lifecycle")
+        if not (isinstance(lc, list) and lc
+                and all(isinstance(s, str) for s in lc)):
+            errors.append(f"{path}: survive.final_lifecycle must be a "
+                          "non-empty list of state names")
+    bits = doc.get("bit_identity")
+    if isinstance(bits, dict):
+        for k in ("degraded_vs_masked", "drop_deterministic"):
+            if not isinstance(bits.get(k), bool):
+                errors.append(f"{path}: bit_identity.{k} must be a bool")
+    rows = doc.get("timeline")
+    if not isinstance(rows, list) or not rows:
+        errors.append(f"{path}: 'timeline' must be a non-empty list")
+    else:
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                errors.append(f"{path}: timeline[{i}] is not an object")
+                continue
+            if not isinstance(row.get("event"), str):
+                errors.append(f"{path}: timeline[{i}].event must be a "
+                              "string")
+            for k in ("step", "alive", "world"):
+                if not isinstance(row.get(k), int):
+                    errors.append(f"{path}: timeline[{i}].{k} must be an "
+                                  "integer")
+    par = doc.get("parity")
+    if isinstance(par, dict):
+        if not isinstance(par.get("pass"), bool):
+            errors.append(f"{path}: parity.pass must be a bool")
+        if not isinstance(par.get("scale"), str):
+            errors.append(f"{path}: parity.scale must be a string")
+        for k in ("bound_nats", "rejoin_gap_nats", "tail_frac"):
+            if not _finite_number(par.get(k)):
+                errors.append(f"{path}: parity.{k} is not finite")
+    return errors
+
+
 _SHA256 = re.compile(r"^[0-9a-f]{64}$")
 _TUNE_CACHE_FORMAT = "dlt-tune-cache-v1"  # == ops/autotune.CACHE_FORMAT
 
@@ -318,6 +393,8 @@ def validate_json_doc(path: str) -> list[str]:
         return _dcn_overlap_errors(path, doc)
     if name == "serving.json":
         return _serving_errors(path, doc)
+    if name == "elasticity.json":
+        return _elasticity_errors(path, doc)
     if name == "tuning_cache.json" or doc.get("format") == _TUNE_CACHE_FORMAT:
         # dispatch on the embedded format stamp as well as the canonical
         # name: a cache at any $DLT_TUNE_CACHE path (the documented drive)

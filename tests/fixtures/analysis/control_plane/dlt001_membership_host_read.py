@@ -1,0 +1,31 @@
+"""Fixture: the control plane's one forbidden shortcut — deciding
+membership INSIDE the jitted step. A worker-drop/rejoin is a host-side
+mask transition between dispatches (train/control_plane.py consumes the
+fault registry at the boundary); host-reading the alive mask or the
+membership schedule inside the compiled step would stall the device
+pipeline every step to ask a question whose answer only changes at
+boundaries. Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def membership_step(params, grads, alive, schedule_step):
+    widx = lax.axis_index("data")  # graft: disable=DLT005
+    ballot = sum(jnp.sum(jnp.sign(g)) for g in jax.tree.leaves(grads))
+    tally = lax.psum(jnp.where(alive[widx], ballot, 0), "data")  # graft: disable=DLT005
+    if int(schedule_step) >= 0:     # DLT001: host sync — the membership
+        # schedule is host state; consult it at the dispatch boundary
+        alive = alive.at[2].set(False)
+    mask = np.asarray(alive)        # DLT001: device→host copy per step
+    return jax.tree.map(lambda p: p * (tally * mask.mean()), params)
+
+
+def boundary_membership(plane, step):
+    # NOT traced scope: membership transitions belong here — the control
+    # plane consumes the fault registry between dispatches and the mask
+    # is pushed as device state the NEXT step consumes
+    return plane.membership_due(step)

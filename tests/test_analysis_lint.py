@@ -211,6 +211,24 @@ def test_guard_fixture_and_guard_modules_clean():
         assert lint.lint_file(path) == [], rel
 
 
+def test_control_plane_fixture_and_modules_clean():
+    """ISSUE 10 satellite: membership is a host-side decision — the
+    control plane consumes drop/rejoin signals at dispatch boundaries and
+    the step only consumes the pushed mask. The path-scoped fixture under
+    fixtures/analysis/control_plane/ shows the forbidden shape (DLT001
+    fires twice on a step that host-reads the membership schedule / alive
+    mask); the real control-plane modules lint zero-finding by file
+    path."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "control_plane", "dlt001_membership_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for rel in ("train/control_plane.py", "train/vote_guard.py",
+                "train/resilience.py", "train/loop.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel
+
+
 def test_serve_fixture_and_serve_modules_clean():
     """ISSUE 9 satellite: the serving engine's decode tick must never
     host-read per token — the classic serving pitfall (an `int(token)` /
