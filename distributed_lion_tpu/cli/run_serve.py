@@ -35,6 +35,16 @@ class ServeArguments:
     num_blocks: int = 0              # 0 = auto (max_seqs * max_blocks_per_seq)
     prefill_cap_tokens: int = 512
     quant: str = "none"              # none | nf4 | int8 (ops/quant)
+    speculate: str = ""              # '<drafter>:<k>' — speculative decode
+    # (serve/speculate.py): 'ngram:4' self-drafts from each request's own
+    # history (zero extra device memory); 'draft:2' proposes with a small
+    # draft model (--draft_model_path/--draft_model_name, same family and
+    # vocab as the target). Outputs are pinned identical to non-speculative
+    # serving; the knob only changes tokens per dispatch.
+    draft_model_path: Optional[str] = None   # draft checkpoint for
+    # --speculate draft:<k> (same loaders as --model_path)
+    draft_model_name: Optional[str] = None   # draft architecture (default:
+    # the target's model_name — self-drafting smoke mode)
     journal_dir: Optional[str] = None
 
 
@@ -48,10 +58,35 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
         ServingEngine,
     )
 
+    def as_serve_model(p, c):
+        return (ServeModel.for_gpt2(p, c) if gen_args.model_family == "gpt2"
+                else ServeModel.for_llama(p, c))
+
+    if serve_args.speculate:
+        # pure-config refusals BEFORE any checkpoint loads — a spec error
+        # must cost milliseconds, not minutes of target-weight loading
+        from distributed_lion_tpu.serve.speculate import parse_speculate
+
+        name, _ = parse_speculate(serve_args.speculate)
+        if name == "draft" and not serve_args.draft_model_path:
+            raise ValueError(
+                "--speculate draft:<k> needs --draft_model_path (a TRAINED "
+                "draft checkpoint; without it the loader would random-init "
+                "the drafter, whose proposals all reject — every tick then "
+                "pays the draft dispatch plus the k+1-wide verify for "
+                "nothing, silently slower than plain decode)")
     tok, cfg, params, _, _ = build(gen_args)
-    model = (ServeModel.for_gpt2(params, cfg)
-             if gen_args.model_family == "gpt2"
-             else ServeModel.for_llama(params, cfg))
+    model = as_serve_model(params, cfg)
+    draft_model = None
+    if serve_args.speculate.startswith("draft"):
+        # the draft checkpoint rides the same loader surface as the target
+        # (npz / training output dir / HF dir); family must match — the
+        # vocab check in serve/speculate.build_speculator is the loud gate
+        d_args = dataclasses.replace(
+            gen_args, model_path=serve_args.draft_model_path,
+            model_name=serve_args.draft_model_name or gen_args.model_name)
+        _, dcfg, dparams, _, _ = build(d_args)
+        draft_model = as_serve_model(dparams, dcfg)
     engine = ServingEngine(model, ServeConfig(
         max_seqs=serve_args.max_seqs, block_size=serve_args.block_size,
         max_blocks_per_seq=serve_args.max_blocks_per_seq,
@@ -60,7 +95,8 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
         max_new_tokens=gen_args.max_new_tokens,
         temperature=gen_args.temperature, top_k=gen_args.top_k,
         top_p=gen_args.top_p, quant=serve_args.quant,
-        eos_id=getattr(tok, "eos_id", None)))
+        speculate=serve_args.speculate,
+        eos_id=getattr(tok, "eos_id", None)), draft_model=draft_model)
     return tok, engine
 
 

@@ -174,6 +174,14 @@ def paged_scatter_kv(pages: jnp.ndarray, tables: jnp.ndarray,
     offset ``(pos[b]+s) % block_size``. Rows whose table entry is the
     sentinel (never allocated — e.g. an inactive decode slot) scatter out
     of range and are dropped by XLA's scatter mode, not branched on.
+
+    A multi-token window commit ([B, S] with S > 1 — the bucketed prefill
+    and the speculative verify window, serve/speculate.py) is bit-identical
+    to S sequential single-token scatters: the writes land in the same
+    (page, offset) cells with the same values, and masked/sentinel writes
+    drop identically (pinned by tests/test_serve.py). Per-row VALID COUNTS
+    ride ``valid`` as ``arange(S) < counts[:, None]`` — the rejected/padded
+    tail never touches a page.
     """
     B, S = new.shape[:2]
     bs = pages.shape[1]
@@ -215,6 +223,13 @@ def paged_decode_attention(q, k_pages, v_pages, tables, pos,
     bit-identical to the dense path whenever T matches (pinned by
     tests/test_serve.py). GQA kv heads are repeated at attend time, exactly
     like the dense caches store them un-repeated.
+
+    S > 1 is the multi-token window (bucketed prefill; speculative verify,
+    serve/speculate.py): query s attends causally INSIDE the window
+    (``t_idx <= pos + s``), so a window whose first v entries are valid is
+    safe without extra masking — a valid query s < v only ever sees
+    history plus window tokens 0..s, all freshly scattered this dispatch;
+    queries at invalid positions produce garbage rows the caller discards.
     """
     B, H, S, hd = q.shape
     KV = k_pages.shape[2]

@@ -7,6 +7,8 @@ checkpoints at production batch sizes on the same stack that trained them.
 - ``kv_cache``  — host-side page allocator + block tables (pure table
   math; the device pool lives in ``ops.attention``'s paged primitives)
 - ``engine``    — admission scheduler + prefill/decode tick loop
+- ``speculate`` — speculative decode: draft/verify/commit on the paged
+  cache, outputs pinned identical to the one-token tick
 - ``api``       — request-file front end (offline mode for CI)
 """
 

@@ -29,6 +29,13 @@ Scheduling (the vLLM recipe, simplified to two tick kinds):
   pages; the block table row goes back to sentinel, so the next decode
   tick simply ignores the slot (no recompile, the shapes never changed).
 
+With ``ServeConfig.speculate`` set, the decode tick is replaced by the
+speculative draft/verify/commit round (serve/speculate.py): up to k
+drafted tokens per slot ride ONE batched verify dispatch and the accepted
+prefix commits to the block tables — outputs pinned identical to this
+one-token tick (greedy bit-identical, sampled token-identical to the same
+per-request stream), only the tokens-per-dispatch ratio changes.
+
 NF4/int8 frozen-weight serving: ``quant='nf4'`` re-packs the dense
 checkpoint through ``ops.quant.quantize_tree`` once at engine build; the
 decode paths dequantize inside each matmul's producer fusion
@@ -48,7 +55,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from distributed_lion_tpu.serve.kv_cache import BlockTables, init_pages
+from distributed_lion_tpu.serve.kv_cache import (
+    BlockTables,
+    bucket_tokens,
+    init_pages,
+)
 from distributed_lion_tpu.train import journal
 
 
@@ -72,6 +83,16 @@ class ServeConfig:
     top_p: Optional[float] = None  # per-request
     quant: str = "none"          # none | nf4 | int8 frozen-weight serving
     eos_id: Optional[int] = None
+    speculate: str = ""          # '' = one token per decode tick;
+    # '<drafter>:<k>' (ngram:4 | draft:2 ...) arms speculative decode
+    # (serve/speculate.py): the drafter proposes up to k tokens per slot,
+    # one batched verify dispatch scores them against this engine's model
+    # on the paged cache, and the accepted prefix commits to the block
+    # tables (rejected-tail pages roll back exactly). Outputs are pinned
+    # identical to the non-speculative engine — greedy bit-identical,
+    # sampled token-identical to the same per-request PRNG stream — the
+    # knob only changes tokens per dispatch. 'draft:<k>' additionally
+    # needs ServingEngine(draft_model=...).
 
     def resolved_num_blocks(self) -> int:
         return self.num_blocks or self.max_seqs * self.max_blocks_per_seq
@@ -203,7 +224,8 @@ class ServingEngine:
     ``step()`` per tick (or ``run()`` to drain a workload), collect
     :class:`Completion`s."""
 
-    def __init__(self, model: ServeModel, cfg: ServeConfig):
+    def __init__(self, model: ServeModel, cfg: ServeConfig,
+                 draft_model: Optional[ServeModel] = None):
         import jax
         import jax.numpy as jnp
 
@@ -258,6 +280,13 @@ class ServingEngine:
         self._decode_tick = jax.jit(decode_tick, donate_argnums=donate)
         self._prefill = jax.jit(prefill, donate_argnums=donate)
 
+        self._speculator = None
+        if cfg.speculate:
+            from distributed_lion_tpu.serve.speculate import build_speculator
+
+            self._speculator = build_speculator(self, cfg.speculate,
+                                                draft_model)
+
     # ------------------------------------------------------------- intake
     def submit(self, req: Request) -> None:
         self.pending.append(req)
@@ -266,13 +295,8 @@ class ServingEngine:
         return bool(self.pending) or any(s is not None for s in self.slots)
 
     def _bucket(self, n: int) -> int:
-        """Padded prefill length: power-of-two pages, so prompt-length
-        variety costs O(log(max)) compiles, not one per length."""
-        bs = self.cfg.block_size
-        blocks = 1
-        while blocks * bs < n:
-            blocks *= 2
-        return min(blocks, self.cfg.max_blocks_per_seq) * bs
+        return bucket_tokens(n, self.cfg.block_size,
+                             self.cfg.max_blocks_per_seq)
 
     # -------------------------------------------------------------- ticks
     def _admit(self, completions: List[Completion]) -> None:
@@ -316,6 +340,8 @@ class ServingEngine:
                                        or self.cfg.max_new_tokens))
             slot_state.gen.append(first)
             self.slots[slot] = slot_state
+            if self._speculator is not None:
+                self._speculator.on_admit(slot, list(req.tokens))
             self._maybe_finish(slot, completions)
 
     def _maybe_finish(self, slot: int, completions: List[Completion],
@@ -337,6 +363,8 @@ class ServingEngine:
             self.tables.free_slot(slot)
             self.slots[slot] = None
             self.stats["evictions"] += 1
+            if self._speculator is not None:
+                self._speculator.on_evict(slot)
         completions.append(
             Completion(s.req.req_id, len(s.req.tokens), list(s.gen), reason))
 
@@ -390,7 +418,10 @@ class ServingEngine:
         with journal.active().span("serve/admit",
                                    pending=len(self.pending)):
             self._admit(completions)
-        self._decode(completions)
+        if self._speculator is not None:
+            self._speculator.decode_tick(completions)
+        else:
+            self._decode(completions)
         return completions
 
     # ---------------------------------------------------------- the driver

@@ -39,6 +39,18 @@ def init_pages(n_layer: int, num_blocks: int, block_size: int,
     ]
 
 
+def bucket_tokens(n: int, block_size: int, max_blocks_per_seq: int) -> int:
+    """Padded prefill length for an ``n``-token prompt: power-of-two
+    pages, so prompt-length variety costs O(log(max)) compiles, not one
+    per length. The ONE bucketing rule — the serving engine's prefill and
+    the draft-model mirror's prefill (serve/speculate.py) must pad
+    identically or the mirror desyncs."""
+    blocks = 1
+    while blocks * block_size < n:
+        blocks *= 2
+    return min(blocks, max_blocks_per_seq) * block_size
+
+
 class BlockTables:
     """Host-side page allocator + per-slot block tables.
 
@@ -102,6 +114,28 @@ class BlockTables:
             self.tables[slot, i] = self._free.pop()
         self.owned[slot] = need
         return True
+
+    def shrink(self, slot: int, n_tokens: int) -> int:
+        """Free ``slot``'s pages beyond those ``n_tokens`` total cache
+        entries need — the EXACT inverse of :meth:`grow`: pages return to
+        the LIFO free list in reverse allocation order, so
+        ``grow(slot, a); shrink(slot, b)`` leaves the allocator (tables,
+        owned, free-list order) bit-identical to ``grow(slot, b)`` for any
+        ``b <= a``. This is the speculative-decode rollback primitive
+        (serve/speculate.py): a verify window optimistically grows the
+        table for k draft tokens and the rejected tail's pages are handed
+        back as if they were never allocated, so the post-commit state
+        matches what a token-by-token run would hold (tests/test_serve.py
+        pins it). Returns the page count freed."""
+        need = self.blocks_for(n_tokens)
+        have = int(self.owned[slot])
+        if need >= have:
+            return 0
+        for i in range(have - 1, need - 1, -1):
+            self._free.append(int(self.tables[slot, i]))
+            self.tables[slot, i] = self.sentinel
+        self.owned[slot] = need
+        return have - need
 
     def free_slot(self, slot: int) -> int:
         """Return all of ``slot``'s pages to the pool; the table row goes

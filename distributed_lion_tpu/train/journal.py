@@ -128,6 +128,10 @@ class _SpanCtx:
         self._t0 = time.monotonic()
         return self
 
+    def set(self, **fields) -> None:
+        """Attach fields computed INSIDE the span; recorded at exit."""
+        self._fields.update(fields)
+
     def __exit__(self, exc_type, exc, tb) -> bool:
         dur = time.monotonic() - self._t0
         fields = self._fields
@@ -333,6 +337,9 @@ class _NullJournal:
 class _NullSpan:
     def __enter__(self):
         return self
+
+    def set(self, **fields):
+        pass
 
     def __exit__(self, *exc):
         return False

@@ -145,6 +145,20 @@ class TrainConfig:
     # path is live on TPU, else pallas_lion.ROW_BLOCK. Pure tiling — the
     # elections/params are bit-identical at any value
     # (tests/test_autotune.py); only VMEM residency changes.
+    remat_policy: str = dataclasses.field(
+        default="", metadata={"cli": False})  # '' = honor the model
+    # config's own remat/remat_policy; 'full' | 'dots' overrides it at
+    # Trainer build. Programmatic only (no CLI flag — run_clm's
+    # model-level --remat_policy drives the model config directly; this
+    # field is the override bench.py and tests hand the Trainer builders).
+    # (models/gpt2._remat_policy: 'dots' keeps matmul outputs and
+    # recomputes elementwise — the cheaper backward the sweep's dots leg
+    # measures). A perf knob under the vote, not a semantics knob: at f32
+    # compute the Lion trajectory AND the lazy elected-sign cache are
+    # bit-identical across policies; at bf16 compute jax.checkpoint's
+    # fusion barriers shift a few ULPs so elections may flip only on
+    # near-tie coordinates (tests/test_train.py pins both halves, the
+    # PR 6 remat-equivalence precedent).
     mom_dtype: str = ""  # Lion momentum dtype override ('bfloat16' halves
     # the per-worker optimizer state and its read/write traffic — at 7B
     # full-param scale that is ~14 GB of HBM; '' = the param dtype, the
@@ -332,6 +346,25 @@ class TrainConfig:
         if self.lr_scheduler_type == "linear":
             return linear_schedule_with_warmup(self.learning_rate, self.warmup_steps, self.max_steps)
         return constant_schedule(self.learning_rate)
+
+
+def apply_remat_policy(cfg: "TrainConfig", model_cfg):
+    """Thread ``TrainConfig.remat_policy`` through the Trainer builders:
+    ``''`` honors the model config's own setting; ``'full' | 'dots'``
+    replaces it (models/gpt2._remat_policy). Loud on an unknown policy
+    and on an override with remat disabled — a policy that silently
+    never applies is the kind of no-op a sweep leg would then measure."""
+    if not cfg.remat_policy:
+        return model_cfg
+    if cfg.remat_policy not in ("full", "dots"):
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r} (full | dots)")
+    if not model_cfg.remat:
+        raise ValueError(
+            "TrainConfig.remat_policy set but the model config has "
+            "remat=False — the policy would silently never apply; drop "
+            "the override or enable remat")
+    return dataclasses.replace(model_cfg, remat_policy=cfg.remat_policy)
 
 
 def validate_seq_block(cfg: "TrainConfig", model_cfg, sp: int) -> None:
@@ -2256,6 +2289,7 @@ class Trainer:
             validate_tp,
         )
 
+        model_cfg = apply_remat_policy(cfg, model_cfg)
         params = (initial_params if initial_params is not None else
                   gpt2_init(jax.random.key(seed if seed is not None else cfg.seed), model_cfg))
         n = count_params(params)
@@ -2537,6 +2571,7 @@ class Trainer:
                 "an 'expert' mesh axis is wired for GPT-2-MoE only; Llama "
                 "composes with dp x tp x sp x pp"
             )
+        model_cfg = apply_remat_policy(cfg, model_cfg)
         params = (initial_params if initial_params is not None else
                   llama_init(jax.random.key(seed if seed is not None else cfg.seed),
                              model_cfg))

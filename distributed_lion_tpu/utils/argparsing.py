@@ -46,6 +46,14 @@ def build_parser(dataclass_types: Sequence[Type]) -> argparse.ArgumentParser:
     for dc in dataclass_types:
         group = parser.add_argument_group(dc.__name__)
         for f in dataclasses.fields(dc):
+            if not f.metadata.get("cli", True):
+                # programmatic-only field (metadata {'cli': False}): no
+                # flag, and it may shadow a same-named flag owned by
+                # another group (e.g. TrainConfig.remat_policy vs
+                # run_clm ModelArguments.remat_policy — the CLI flag
+                # drives the model config; the TrainConfig field is the
+                # Trainer-builder override bench/tests use)
+                continue
             if f.name in seen:
                 raise ValueError(f"duplicate field {f.name!r} across dataclasses")
             seen.add(f.name)
@@ -82,6 +90,11 @@ def parse_dataclasses(
 
     out = []
     for dc in dataclass_types:
-        kwargs = {f.name: values[f.name] for f in dataclasses.fields(dc) if f.name in values}
+        # cli:False fields never populate from parsed flags or JSON —
+        # without this, a same-named FLAG owned by another group leaks in
+        # (e.g. ModelArguments.remat_policy default 'full' would land in
+        # TrainConfig.remat_policy and break `--remat false`)
+        kwargs = {f.name: values[f.name] for f in dataclasses.fields(dc)
+                  if f.name in values and f.metadata.get("cli", True)}
         out.append(dc(**kwargs))
     return tuple(out)

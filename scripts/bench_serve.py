@@ -20,6 +20,12 @@ ROADMAP item 4):
   (b) a staggered continuous-batching run vs solo runs of each request.
   Both recomputed live at artifact-capture time; check_evidence requires
   them true.
+- **speculative frontier** (ISSUE 11) — accept-rate × tokens/s/chip over
+  drafter (``ngram`` prompt-lookup, ``draft`` self-draft smoke) × k on a
+  repetitive and a random workload, plus the speculative identity
+  markers recomputed live (greedy speculative == plain paged decode;
+  sampled speculative == the same per-request PRNG stream). Judged by
+  check_evidence's ``speculative`` stage (runbook stage 5j).
 
 CPU-produced artifacts are first-class smoke evidence (tiny model — the
 engine mechanism, not chip throughput); ``meta.backend`` records what
@@ -46,35 +52,50 @@ PROMPT_LEN = 16          # decode-row prompt length (uniform: the decode
 DEFAULT_BATCHES = (32, 128, 256)
 
 
+_MODEL_CACHE = {}
+
+
+def _serve_model(model_name: str, family: str):
+    # one init per (model, family) for the whole bench: the speculative
+    # legs build many engines over the same weights, and a draft:<k> leg
+    # needs the target twice (self-draft smoke — measures the mechanism)
+    key = (model_name, family)
+    if key not in _MODEL_CACHE:
+        import jax
+
+        from distributed_lion_tpu.serve.engine import ServeModel
+
+        if family == "gpt2":
+            from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+
+            cfg = (GPT2Config.tiny() if model_name == "tiny"
+                   else GPT2Config.gpt2_124m())
+            params = gpt2_init(jax.random.key(0), cfg)
+            model = ServeModel.for_gpt2(params, cfg)
+        else:
+            from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+
+            cfg = LlamaConfig.named(model_name)
+            params = llama_init(jax.random.key(0), cfg)
+            model = ServeModel.for_llama(params, cfg)
+        _MODEL_CACHE[key] = (model, params, cfg)
+    return _MODEL_CACHE[key]
+
+
 def _build(model_name: str, family: str, quant: str, max_seqs: int,
            block_size: int, max_blocks_per_seq: int,
-           prefill_cap: int = 1 << 30, temperature: float = 0.0):
-    import jax
+           prefill_cap: int = 1 << 30, temperature: float = 0.0,
+           top_k=None, speculate: str = ""):
+    from distributed_lion_tpu.serve.engine import ServeConfig, ServingEngine
 
-    from distributed_lion_tpu.serve.engine import (
-        ServeConfig,
-        ServeModel,
-        ServingEngine,
-    )
-
-    if family == "gpt2":
-        from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
-
-        cfg = (GPT2Config.tiny() if model_name == "tiny"
-               else GPT2Config.gpt2_124m())
-        params = gpt2_init(jax.random.key(0), cfg)
-        model = ServeModel.for_gpt2(params, cfg)
-    else:
-        from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
-
-        cfg = LlamaConfig.named(model_name)
-        params = llama_init(jax.random.key(0), cfg)
-        model = ServeModel.for_llama(params, cfg)
+    model, params, cfg = _serve_model(model_name, family)
     scfg = ServeConfig(max_seqs=max_seqs, block_size=block_size,
                        max_blocks_per_seq=max_blocks_per_seq,
                        prefill_cap_tokens=prefill_cap,
-                       temperature=temperature, quant=quant)
-    return ServingEngine(model, scfg), params, cfg
+                       temperature=temperature, top_k=top_k, quant=quant,
+                       speculate=speculate)
+    draft = model if speculate.startswith("draft") else None
+    return ServingEngine(model, scfg, draft_model=draft), params, cfg
 
 
 def _prompts(n: int, vocab: int, length: int = PROMPT_LEN, seed: int = 0):
@@ -144,6 +165,136 @@ def bench_prefill_share(model_name: str, family: str, quant: str,
                 4),
         })
     return rows
+
+
+def _spec_prompts(n: int, vocab: int, kind: str, seed: int = 21):
+    """Frontier workloads: ``repetitive`` prompts are repeated short
+    motifs (the traffic prompt-lookup drafting exists for — system
+    prompts, templated requests), ``random`` prompts carry no n-gram
+    signal (the drafter must cost nothing when it can't help)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    if kind == "repetitive":
+        out = []
+        for _ in range(n):
+            motif = list(map(int, rng.integers(1, vocab, 4)))
+            out.append(motif * 4)
+        return out
+    return _prompts(n, vocab, length=PROMPT_LEN, seed=seed)
+
+
+def bench_speculative(model_name: str, family: str, quant: str,
+                      block_size: int, ticks: int, warmup: int,
+                      batch: int, ks=(2, 4)) -> dict:
+    """The ISSUE 11 evidence: the accept-rate × tokens/s/chip frontier
+    over drafter × k on two workloads, plus live-recomputed identity
+    markers (greedy speculative == plain paged decode; sampled
+    speculative == the same per-request PRNG stream). Speculation never
+    changes an output — the frontier shows what each drafter's accept
+    rate buys in committed tokens per second."""
+    from distributed_lion_tpu.serve.engine import Request
+
+    model, _, cfg = _serve_model(model_name, family)
+
+    def timed_leg(speculate: str, kind: str) -> dict:
+        # full-occupancy timed ticks, the decode-row recipe: budgets are
+        # sized so no slot finishes inside the window (plain ticks commit
+        # 1 token; a speculative tick commits up to k+1). The window is
+        # capped by the model's position budget (tiny n_ctx=128 bounds
+        # the CPU smoke; gpt2_124m's 1024 fits the full default window).
+        k = int(speculate.split(":")[1]) if speculate else 0
+        # the random leg decodes SAMPLED: greedy decode from a tiny model
+        # degenerates into repeated motifs within a few tokens, handing
+        # the self-drafter the very signal the leg exists to withhold —
+        # a sampled stream keeps the workload genuinely n-gram-free
+        # (identity markers below still pin sampled == the plain stream)
+        samp = dict(temperature=0.9, top_k=40) if kind == "random" else {}
+        # budget from the PAGE-ROUNDED position budget: pages quantize the
+        # horizon, so a non-divisor --block_size must round DOWN here or
+        # nblocks*block_size overshoots max_positions and the engine
+        # refuses the geometry (e.g. n_ctx=128 at block_size 12)
+        cap = model.max_positions or 1 << 30
+        cap = (cap // block_size) * block_size
+        assert cap > PROMPT_LEN + 2, \
+            f"--block_size {block_size} leaves no room under the model's " \
+            f"position budget {model.max_positions}"
+        # admission steps ALSO run a decode tick (engine.step admits then
+        # decodes), so budget FILL_TICKS extra ticks of commits — without
+        # them slots exhaust max_new_tokens inside the timed window and
+        # the speculative rows read biased-low vs the k=0 baseline
+        FILL_TICKS = 2
+        total = min(warmup + ticks,
+                    (cap - PROMPT_LEN - 2) // (k + 1) - FILL_TICKS)
+        w = min(warmup, max(total - 1, 0))
+        t = total - w
+        need = (total + FILL_TICKS) * (k + 1) + 2
+        nblocks = -(-(PROMPT_LEN + need) // block_size)
+        eng, _, _ = _build(model_name, family, quant, batch, block_size,
+                           nblocks, speculate=speculate, **samp)
+        for i, toks in enumerate(_spec_prompts(batch, cfg.vocab_size, kind)):
+            eng.submit(Request(req_id=i, tokens=toks, max_new_tokens=need,
+                               seed=i))
+        while eng.pending:
+            eng.step()
+        assert all(s is not None for s in eng.slots), "slots did not fill"
+        for _ in range(w):
+            eng.step()
+        t0 = time.perf_counter()
+        tok0 = eng.stats["decode_tokens"]
+        prop0 = eng.stats.get("spec_proposed", 0)
+        acc0 = eng.stats.get("spec_accepted", 0)
+        for _ in range(t):
+            eng.step()
+        dt = time.perf_counter() - t0
+        assert all(s is not None for s in eng.slots), \
+            "a slot finished inside the timed window — budget miscount"
+        committed = eng.stats["decode_tokens"] - tok0
+        proposed = eng.stats.get("spec_proposed", 0) - prop0
+        accepted = eng.stats.get("spec_accepted", 0) - acc0
+        name = speculate.split(":")[0] if speculate else "none"
+        return {
+            "drafter": name, "k": k, "workload": kind,
+            "proposed": int(proposed), "accepted": int(accepted),
+            "accept_rate": round(accepted / proposed, 4) if proposed
+            else 0.0,
+            "ticks": t,
+            "ms_per_tick": round(dt / t * 1e3, 4),
+            "tokens_per_tick": round(committed / t, 3),
+            "tokens_per_sec_per_chip": round(committed / dt, 2),
+        }
+
+    frontier = []
+    for kind in ("repetitive", "random"):
+        legs = [""] + [f"{d}:{k}" for d in ("ngram", "draft") for k in ks]
+        for leg in legs:
+            frontier.append(timed_leg(leg, kind))
+            print(json.dumps(frontier[-1], allow_nan=False), flush=True)
+
+    # live-recomputed identity markers on the measured model: speculation
+    # must EARN its "outputs unchanged" claim at capture time. Greedy:
+    # both drafters; sampled: the per-request stream replay (ngram leg —
+    # one drafter suffices, the acceptance rule is drafter-independent).
+    def outputs(speculate: str, **samp):
+        eng, _, _ = _build(model_name, family, quant, 8, block_size, 8,
+                           speculate=speculate, **samp)
+        reqs = [Request(req_id=i, tokens=toks, max_new_tokens=12, seed=i)
+                for i, toks in enumerate(
+                    _spec_prompts(4, cfg.vocab_size, "repetitive")
+                    + _spec_prompts(4, cfg.vocab_size, "random"))]
+        done = eng.run(reqs)
+        return {r: c.tokens for r, c in done.items()}
+
+    plain_greedy = outputs("")
+    greedy_ok = all(outputs(s) == plain_greedy
+                    for s in ("ngram:4", "draft:2"))
+    sampled = dict(temperature=0.9, top_k=40)
+    sampled_ok = outputs("ngram:4", **sampled) == outputs("", **sampled)
+    return {
+        "markers": {"greedy_vs_plain": bool(greedy_ok),
+                    "sampled_vs_stream": bool(sampled_ok)},
+        "frontier": frontier,
+    }
 
 
 def bit_identity_markers(family: str) -> dict:
@@ -220,6 +371,12 @@ def main() -> int:
     ap.add_argument("--block_size", type=int, default=16)
     ap.add_argument("--ticks", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--spec_batch", type=int, default=8,
+                    help="decode batch of the speculative frontier legs "
+                         "(smaller than the decode rows: each leg runs "
+                         "drafter x k x workload engines)")
+    ap.add_argument("--spec_ks", default="2,4",
+                    help="draft lengths measured per drafter")
     args = ap.parse_args()
 
     import jax
@@ -254,6 +411,11 @@ def main() -> int:
                                      [args.block_size, 4 * args.block_size,
                                       1 << 30], args.block_size)
     bits = bit_identity_markers(args.family)
+    spec = bench_speculative(model_name, args.family, args.quant,
+                             args.block_size, args.ticks, args.warmup,
+                             args.spec_batch,
+                             tuple(int(k) for k in args.spec_ks.split(",")
+                                   if k))
 
     doc = {
         "meta": {
@@ -271,6 +433,7 @@ def main() -> int:
         "decode": decode_rows,
         "prefill_share": share_rows,
         "bit_identity": bits,
+        "speculative": spec,
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "serving.json")
@@ -280,10 +443,12 @@ def main() -> int:
         f.write("\n")
     os.replace(tmp, path)
     print(json.dumps({"artifact": path, **bits,
+                      **{f"spec_{k}": v
+                         for k, v in spec["markers"].items()},
                       "best_tokens_per_sec_per_chip": max(
                           r["tokens_per_sec_per_chip"] for r in decode_rows)},
                      allow_nan=False), flush=True)
-    return 0 if all(bits.values()) else 1
+    return 0 if all(bits.values()) and all(spec["markers"].values()) else 1
 
 
 if __name__ == "__main__":

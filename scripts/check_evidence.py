@@ -15,6 +15,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py journal        # run-journal attribution
     python scripts/check_evidence.py dcn_overlap    # pipelined hier DCN leg
     python scripts/check_evidence.py serving        # paged-KV decode bench
+    python scripts/check_evidence.py speculative    # draft/verify/commit
     python scripts/check_evidence.py elasticity     # live worker leave/join
     python scripts/check_evidence.py all
 
@@ -620,6 +621,50 @@ def serving_ok(path: str = SERVE_ARTIFACT) -> bool:
     return True
 
 
+# the speculative stage (ISSUE 11): the speculative-decode section of
+# the SAME serving.json artifact (bench_serve writes both; stage 5j
+# re-captures on chip) — (a) the whole artifact passes the strict schema
+# (which pins accept_rate ∈ [0,1], drafter/k/tokens-per-sec columns on
+# every frontier row), (b) both live-recomputed speculative identity
+# markers hold (greedy speculative == plain paged decode; sampled
+# speculative == the same per-request PRNG stream — speculation may only
+# change SPEED, never an output), (c) the frontier actually covers the
+# claim: a non-speculative baseline row plus both drafters measured on
+# the repetitive AND random workloads, and (d) the n-gram drafter EARNS
+# accept_rate > 0 on the repetitive workload (prompt-lookup drafting
+# must work where its traffic exists, not just ride the schema).
+def speculative_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations (incl. accept_rate range)
+    except Exception:
+        return False
+    spec = doc.get("speculative")
+    if not isinstance(spec, dict):
+        return False
+    marks = spec.get("markers", {})
+    if not (marks.get("greedy_vs_plain") is True
+            and marks.get("sampled_vs_stream") is True):
+        return False
+    rows = spec.get("frontier", [])
+    for workload in ("repetitive", "random"):
+        here = [r for r in rows if r.get("workload") == workload]
+        if not any(r.get("drafter") == "none" for r in here):
+            return False  # no baseline to read the frontier against
+        for drafter in ("ngram", "draft"):
+            if not any(r.get("drafter") == drafter for r in here):
+                return False
+    return any(r.get("drafter") == "ngram"
+               and r.get("workload") == "repetitive"
+               and r.get("accept_rate", 0) > 0 for r in rows)
+
+
 # the live-elasticity stage (ISSUE 10): scripts/bench_elasticity.py's
 # artifact under runs/elasticity — (a) passes the strict elasticity.json
 # schema (validate_metrics, loaded by FILE PATH so this script stays
@@ -705,6 +750,7 @@ STAGES = [
     ("journal", journal_ok),
     ("dcn_overlap", dcn_overlap_ok),
     ("serving", serving_ok),
+    ("speculative", speculative_ok),
     ("elasticity", elasticity_ok),
 ]
 
@@ -774,6 +820,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return dcn_overlap_ok(arg or DCN_ARTIFACT)
     if what == "serving":
         return serving_ok(arg or SERVE_ARTIFACT)
+    if what == "speculative":
+        return speculative_ok(arg or SERVE_ARTIFACT)
     if what == "elasticity":
         return elasticity_ok(arg or ELASTICITY_ARTIFACT)
     if what == "all":

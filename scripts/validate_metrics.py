@@ -38,7 +38,8 @@ the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
 rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
-bit-identity document, per-row validated the same way), and the
+bit-identity/speculative-frontier document, per-row validated the same
+way incl. accept_rate ∈ [0,1] on every frontier row), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
 document; timeline rows are strict-validated per row).
@@ -189,12 +190,17 @@ _DOC_SCHEMAS = {
 
 def _serving_errors(path: str, doc: dict) -> list[str]:
     """Strict schema of the serving-bench evidence artifact
-    (scripts/bench_serve.py; judged by check_evidence's ``serving``
-    stage): decode rows each a tokens/s/chip measurement at one batch
-    size carrying the NF4-vs-bf16 weight-bytes column, the prefill-share
-    ablation rows, and the two live-recomputed bit-identity markers."""
+    (scripts/bench_serve.py; judged by check_evidence's ``serving`` and
+    ``speculative`` stages): decode rows each a tokens/s/chip measurement
+    at one batch size carrying the NF4-vs-bf16 weight-bytes column, the
+    prefill-share ablation rows, the two live-recomputed bit-identity
+    markers, and the speculative-decode section (ISSUE 11) — an
+    accept-rate × tokens/s/chip frontier over drafter × k plus its own
+    live-recomputed identity markers (greedy speculative == plain paged
+    decode; sampled speculative == the same per-request PRNG stream)."""
     errors = []
-    for key in ("meta", "decode", "prefill_share", "bit_identity"):
+    for key in ("meta", "decode", "prefill_share", "bit_identity",
+                "speculative"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -230,6 +236,43 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
         for k in ("paged_vs_dense", "batched_vs_solo"):
             if not isinstance(bits.get(k), bool):
                 errors.append(f"{path}: bit_identity.{k} must be a bool")
+    spec = doc.get("speculative")
+    if spec is not None and not isinstance(spec, dict):
+        errors.append(f"{path}: 'speculative' must be an object")
+    elif isinstance(spec, dict):
+        marks = spec.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: speculative.markers must be an object")
+        else:
+            for k in ("greedy_vs_plain", "sampled_vs_stream"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(
+                        f"{path}: speculative.markers.{k} must be a bool")
+        rows = spec.get("frontier")
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: speculative.frontier must be a "
+                          "non-empty list")
+            rows = []
+        for i, row in enumerate(rows):
+            where = f"{path}: speculative.frontier[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where} is not an object")
+                continue
+            for k in ("drafter", "workload"):
+                if not isinstance(row.get(k), str):
+                    errors.append(f"{where}.{k} must be a string")
+            if not (isinstance(row.get("k"), int)
+                    and not isinstance(row.get("k"), bool)
+                    and row["k"] >= 0):
+                errors.append(f"{where}.k must be a non-negative int")
+            for k in ("ms_per_tick", "tokens_per_sec_per_chip",
+                      "proposed", "accepted"):
+                if not _finite_number(row.get(k)):
+                    errors.append(f"{where}.{k} is not finite")
+            ar = row.get("accept_rate")
+            if not (_finite_number(ar) and 0.0 <= ar <= 1.0):
+                errors.append(f"{where}.accept_rate must be a finite "
+                              "number in [0, 1]")
     return errors
 
 

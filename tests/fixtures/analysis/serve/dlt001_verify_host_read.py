@@ -1,0 +1,33 @@
+"""Fixture: the speculative decoder's one forbidden shortcut — PER-DRAFT-
+TOKEN host reads inside the jitted verify dispatch (the classic
+speculative pitfall: an `int(accept[i])` / per-token acceptance branch
+inside the compiled verify loop forces a device→host round trip per
+proposed token, which erases exactly the dispatch amortization
+speculation exists to buy). The real Speculator (serve/speculate.py)
+scores all k proposed tokens per slot in ONE jitted call and the host
+reads ONE (tokens, accept-counts) pair per tick, at the dispatch
+boundary — acceptance/rollback are then pure host-side block-table math.
+Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py). Lives under fixtures/analysis/serve/
+beside the DLT001 decode-tick fixture — the fixture tree mirrors the
+package tree it pins."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def verify_dispatch(params, pages, tables, lens, window, vcounts):
+    logits = (params["w"] * window[..., None]).sum(-1)
+    tok = jnp.argmax(logits, axis=-1)
+    accepted = 0
+    for i in range(int(vcounts[0])):   # DLT001: per-draft-token host read
+        if int(tok[0, i]) == 0:        # DLT001: host acceptance branch
+            break
+        accepted += 1
+    return tok, accepted
+
+
+def host_commit(tables, accepts):
+    # NOT traced scope: committing the accepted prefix from the tick's
+    # ONE drained accept-count array is the documented sync point
+    return [tables.commit(s, int(a)) for s, a in enumerate(accepts)]

@@ -245,3 +245,19 @@ def test_serve_fixture_and_serve_modules_clean():
                 "ops/attention.py", "cli/run_serve.py"):
         path = os.path.join(PKG, rel)
         assert lint.lint_file(path) == [], rel
+
+
+def test_speculate_fixture_and_module_clean():
+    """ISSUE 11 satellite: the speculative verify dispatch must never
+    host-read per DRAFT token — an `int(accept[i])` acceptance branch
+    inside the jitted verify loop pays one device→host round trip per
+    proposed token and erases the dispatch amortization speculation
+    exists to buy. The fixture shows the forbidden shape (DLT001 fires
+    twice); serve/speculate.py lints zero-finding by file path — its one
+    host read per tick (tokens + accept counts) happens at the dispatch
+    boundary, and accept/rollback are pure host block-table math."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt001_verify_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    assert lint.lint_file(os.path.join(PKG, "serve", "speculate.py")) == []

@@ -104,6 +104,85 @@ def test_paged_prefill_valid_mask_drops_pad_tail():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+# --------------------------------------------- multi-token window commit
+def test_multi_token_scatter_matches_sequential():
+    """The speculative-verify window commit (ISSUE 11): one [B, S] window
+    scatter through ``paged_scatter_kv`` is bit-identical to S sequential
+    single-token scatters — same (page, offset) cells, same values,
+    masked tails and sentinel rows dropping identically — including
+    shuffled tables and rows whose windows straddle a page boundary."""
+    from distributed_lion_tpu.ops.attention import paged_scatter_kv
+
+    rng = np.random.default_rng(0)
+    NB, bs, KV, hd, B, S = 6, 4, 2, 8, 3, 5
+    pool = jnp.asarray(rng.standard_normal((NB, bs, KV, hd)), jnp.float32)
+    # row 0: shuffled pages mid-sequence; row 1: window crosses into a
+    # fresh page; row 2: SENTINEL table row (inactive slot — every write
+    # must drop)
+    tables = jnp.asarray([[4, 1, 3], [2, 0, 5], [NB, NB, NB]], jnp.int32)
+    pos = jnp.asarray([1, 6, 0], jnp.int32)
+    new = jnp.asarray(rng.standard_normal((B, S, KV, hd)), jnp.float32)
+    # per-row valid COUNTS, the verify-window shape: arange(S) < counts
+    counts = jnp.asarray([5, 3, 4], jnp.int32)
+    valid = jnp.arange(S)[None, :] < counts[:, None]
+
+    window = paged_scatter_kv(pool, tables, pos, new, valid)
+
+    seq = pool
+    for s in range(S):
+        seq = paged_scatter_kv(seq, tables, pos + s, new[:, s:s + 1],
+                               valid[:, s:s + 1])
+    np.testing.assert_array_equal(np.asarray(window), np.asarray(seq))
+    # the sentinel row and the masked tails never touched the pool:
+    # replaying only the valid in-range writes reproduces it too
+    redo = pool
+    for b in range(B - 1):          # row 2 is all-sentinel: contributes 0
+        for s in range(int(counts[b])):
+            redo = paged_scatter_kv(redo, tables[b:b + 1], pos[b:b + 1] + s,
+                                    new[b:b + 1, s:s + 1])
+    np.testing.assert_array_equal(np.asarray(window), np.asarray(redo))
+
+
+def test_block_tables_shrink_is_exact_inverse_of_grow():
+    """``BlockTables.shrink`` — the speculative rollback primitive — is
+    the exact inverse of ``grow``: after an optimistic grow for k draft
+    tokens and a rollback to the accepted length, the tables, owned
+    counts AND the LIFO free-list order are bit-identical to having grown
+    to the accepted length directly (what a token-by-token run holds)."""
+    import copy
+
+    def state(bt):
+        return (bt.tables.copy(), bt.owned.copy(), list(bt._free))
+
+    ref = BlockTables(num_blocks=12, block_size=4, max_seqs=3,
+                      max_blocks_per_seq=4)
+    # interleaved multi-slot history so page ownership is shuffled
+    assert ref.grow(0, 6) and ref.grow(1, 3) and ref.grow(2, 9)
+    spec = copy.deepcopy(ref)
+
+    # token-by-token: slot 0 advances to 9 total entries (one new page)
+    assert ref.grow(0, 9)
+    # speculative: slot 0 optimistically grows for a k=7 window (to 13 →
+    # two extra pages), then a partial accept rolls back to 9
+    assert spec.grow(0, 13)
+    assert spec.owned[0] > ref.owned[0]
+    freed = spec.shrink(0, 9)
+    assert freed == 1
+    for a, b in zip(state(spec), state(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # shrink to a length needing all owned pages (or more) is a no-op
+    assert spec.shrink(0, 9) == 0 and spec.shrink(0, 100) == 0
+    for a, b in zip(state(spec), state(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # full-round-trip: rollback to the pre-speculation state frees in
+    # reverse allocation order, so a subsequent grow reuses the SAME pages
+    before = state(spec)
+    assert spec.grow(0, 16)
+    spec.shrink(0, 9)
+    for a, b in zip(state(spec), before):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 # ------------------------------------------------------- host allocator
 def test_block_tables_alloc_free_invariants():
     bt = BlockTables(num_blocks=8, block_size=4, max_seqs=3,
@@ -415,3 +494,63 @@ def test_serving_stage_rejects_bad_artifacts(tmp_path):
     p.write_text(json.dumps(good).replace(
         str(good["decode"][0]["ms_per_tick"]), "NaN", 1))
     assert not ce.serving_ok(str(p))
+
+
+def test_banked_artifact_passes_speculative_stage():
+    """The committed CPU artifact also satisfies the ISSUE 11 speculative
+    stage (strict frontier schema, both live-recomputed identity markers,
+    a baseline + both drafters on both workloads, ngram accept_rate > 0
+    on the repetitive traffic) — the gate runbook stage 5j re-judges after
+    the on-chip recapture."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ce_spec", os.path.join(REPO, "scripts", "check_evidence.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    assert ce.speculative_ok()
+
+
+def test_speculative_stage_rejects_bad_artifacts(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ce_spec2", os.path.join(REPO, "scripts", "check_evidence.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    with open(ce.SERVE_ARTIFACT) as f:
+        good = json.load(f)
+    p = tmp_path / "serving.json"
+
+    def reject(mutate):
+        doc = json.loads(json.dumps(good))
+        mutate(doc)
+        p.write_text(json.dumps(doc))
+        assert not ce.speculative_ok(str(p))
+
+    # artifact predates ISSUE 11 entirely
+    reject(lambda d: d.pop("speculative"))
+    # a flipped live-recomputed identity marker
+    reject(lambda d: d["speculative"]["markers"].update(
+        greedy_vs_plain=False))
+    reject(lambda d: d["speculative"]["markers"].update(
+        sampled_vs_stream=False))
+    # schema: accept_rate outside [0, 1] (validate_metrics delegation)
+    reject(lambda d: d["speculative"]["frontier"][1].update(
+        accept_rate=1.5))
+    # frontier coverage: no non-speculative baseline to read against /
+    # a drafter missing on one workload
+    reject(lambda d: d["speculative"].update(frontier=[
+        r for r in d["speculative"]["frontier"] if r["drafter"] != "none"]))
+    reject(lambda d: d["speculative"].update(frontier=[
+        r for r in d["speculative"]["frontier"]
+        if not (r["drafter"] == "ngram" and r["workload"] == "random")]))
+    # the n-gram drafter must EARN accept_rate > 0 on repetitive traffic
+    def zero_ngram(d):
+        for r in d["speculative"]["frontier"]:
+            if r["drafter"] == "ngram" and r["workload"] == "repetitive":
+                r["accept_rate"] = 0.0
+    reject(zero_ngram)
+    # the untouched artifact still passes from the tmp copy
+    p.write_text(json.dumps(good))
+    assert ce.speculative_ok(str(p))
