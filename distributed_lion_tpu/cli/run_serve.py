@@ -13,6 +13,12 @@ engine (serve/engine.py), and drains a request file:
 With no --requests, --prompt strings (repeatable) become the workload —
 a smoke mode mirroring run_generate. ``--journal_dir`` records
 ``serve/*`` spans (train/journal) for ``cli/run_analyze``.
+
+``--serve_tp N`` shards the decode path (weights per the Megatron specs,
+page pools over kv heads) across the first N local devices — how the
+NF4 Llama-2-7B artifact serves on a v5e slice (ISSUE 13); ``--prefix_cache``
+shares prompt-prefix KV pages across requests with copy-on-write
+semantics. Both are pinned output-identical to the plain engine.
 """
 
 from __future__ import annotations
@@ -35,6 +41,20 @@ class ServeArguments:
     num_blocks: int = 0              # 0 = auto (max_seqs * max_blocks_per_seq)
     prefill_cap_tokens: int = 512
     quant: str = "none"              # none | nf4 | int8 (ops/quant)
+    quant_block: Optional[int] = None  # quant block override; shrink so
+    # every --serve_tp-sharded last dim splits (ops/quant.validate_quant_tp
+    # names the offending leaf when it can't)
+    serve_tp: int = 0                # tensor-parallel serving degree
+    # (ISSUE 13): 0 = single-device (the pre-TP engine, bit for bit);
+    # N >= 1 shards weights per the Megatron param specs and the page
+    # pools over kv heads across the first N local devices, one
+    # shard_map'd dispatch per tick. tp=1 is pinned bit-identical to the
+    # single-device engine; heads/kv-heads/d_ff must divide N.
+    prefix_cache: bool = False       # share prompt-prefix KV pages across
+    # requests (copy-on-write block tables, serve/kv_cache.PrefixCache):
+    # N requests carrying the same system prompt hold ONE physical copy
+    # of its pages. Outputs pinned identical to the unshared engine.
+    # Refused for MoE checkpoints (shared capacity accounting unproven).
     speculate: str = ""              # '<drafter>:<k>' — speculative decode
     # (serve/speculate.py): 'ngram:4' self-drafts from each request's own
     # history (zero extra device memory); 'draft:2' proposes with a small
@@ -76,6 +96,17 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
                 "pays the draft dispatch plus the k+1-wide verify for "
                 "nothing, silently slower than plain decode)")
     tok, cfg, params, _, _ = build(gen_args)
+    if serve_args.prefix_cache and getattr(cfg, "moe_experts", 0) > 0:
+        # the engine refuses MoE wholesale already (ServeModel build);
+        # name the prefix-cache-specific reason FIRST so the operator
+        # learns which flag to drop — same loud family as the PR 9 gates
+        raise ValueError(
+            "--prefix_cache is not supported for MoE checkpoints: shared "
+            "prefix pages change how many real tokens reach each expert's "
+            "fixed-capacity buffer across sharers, and that capacity "
+            "accounting is unproven — serve the MoE checkpoint without "
+            "--prefix_cache (and without the paged engine, which refuses "
+            "MoE outright)")
     model = as_serve_model(params, cfg)
     draft_model = None
     if serve_args.speculate.startswith("draft"):
@@ -95,6 +126,8 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
         max_new_tokens=gen_args.max_new_tokens,
         temperature=gen_args.temperature, top_k=gen_args.top_k,
         top_p=gen_args.top_p, quant=serve_args.quant,
+        quant_block=serve_args.quant_block,
+        tp=serve_args.serve_tp, prefix_cache=serve_args.prefix_cache,
         speculate=serve_args.speculate,
         eos_id=getattr(tok, "eos_id", None)), draft_model=draft_model)
     return tok, engine

@@ -553,10 +553,12 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos, offset=None):
     return out, {"k": k_cache, "v": v_cache}
 
 
-def _decode_mlp(x, p, cfg: GPT2Config):
+def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None):
     """The post-attention half of a decode block (dense MLP or the MoE
     FFN with decode-friendly capacity) — shared by the dense-cache and
-    paged decode paths so their numerics cannot drift."""
+    paged decode paths so their numerics cannot drift. ``tp_axis`` runs
+    the dense MLP Megatron-split (the TP serving engine; MoE checkpoints
+    are refused upstream of every paged/TP path)."""
     if "moe" in p:  # MoE checkpoint: single-device routing, no collectives
         from distributed_lion_tpu.parallel.expert import moe_ffn
 
@@ -570,7 +572,7 @@ def _decode_mlp(x, p, cfg: GPT2Config):
                        axis_name=None,
                        capacity_override=B2 * S2 if S2 == 1 else None)
         return x + y.reshape(B2, S2, D2)
-    return x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"])
+    return x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"], tp_axis)
 
 
 def _decode_embed(params, tokens, cfg: GPT2Config, pos, offset):
@@ -631,18 +633,25 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
     return _tied_logits(x, params, cfg), new_cache
 
 
-def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid):
+def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid,
+                           tp_axis=None):
     """The paged twin of :func:`_decode_attention`: scatter the new k/v
     into block-table pages, attend over the gathered history
     (ops.attention.paged_decode_attention — same masked-softmax chain as
-    the dense path, so greedy decode is bit-identical when T matches)."""
+    the dense path, so greedy decode is bit-identical when T matches).
+    With ``tp_axis`` (inside shard_map — the TP serving engine): qkv is
+    column-parallel (this rank holds H/tp heads and the page pool's
+    matching kv-head shard), the scatter/gather/attend chain is entirely
+    shard-local, and only the row-parallel output projection crosses the
+    tensor axis (one psum; bias added after the reduction, once)."""
     from distributed_lion_tpu.ops.attention import (
         paged_decode_attention,
         paged_scatter_kv,
     )
 
     B, S, _ = x.shape
-    H, hd = cfg.n_head, cfg.head_dim
+    tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
+    H, hd = cfg.n_head // tp, cfg.head_dim
     qkv = _qkv_project(x, p["qkv"]) + p["qkv_b"].astype(x.dtype)
     q, k, v = (qkv[:, :, i].reshape(B, S, H, hd) for i in range(3))
     k_pages = paged_scatter_kv(c["k"], tables, pos, k.astype(c["k"].dtype), valid)
@@ -650,13 +659,16 @@ def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid):
     out = paged_decode_attention(q.transpose(0, 2, 1, 3), k_pages, v_pages,
                                  tables, pos)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
-    out = _proj(out, p["proj"]) + p["proj_b"].astype(x.dtype)
+    out = _proj(out, p["proj"])
+    if tp_axis is not None:
+        out = reduce_from_tp_region(out, tp_axis)
+    out = out + p["proj_b"].astype(x.dtype)
     return out, {"k": k_pages, "v": v_pages}
 
 
 def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
                       pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
-                      valid=None):
+                      valid=None, tp_axis=None):
     """Block-table decode (the serving engine's model hook): ``tokens``
     [B, S] where row b's tokens sit at absolute positions
     ``pos[b] .. pos[b]+S-1`` of its own sequence; ``pages`` is the
@@ -666,7 +678,12 @@ def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
     page write, logits discarded by the caller). Returns (logits
     [B, S, vocab] f32, updated pages). Positions are PER ROW, so one call
     serves prefill (S = padded prompt, pos = 0) and the rolling decode
-    tick (S = 1, pos = per-slot lengths) — one jitted program each."""
+    tick (S = 1, pos = per-slot lengths) — one jitted program each.
+    With ``tp_axis`` (inside shard_map — the TP serving engine, ISSUE 13)
+    attention/MLP weights and the page pool's kv-head axis are expected
+    pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``;
+    embeddings and the tied head stay replicated, so the returned logits
+    are identical on every tensor rank."""
     if any("moe" in p for p in params["blocks"]):
         # see ServeModel.for_gpt2: a padded prefill routes pad tokens
         # through expert capacity, silently breaking bit-identity
@@ -682,8 +699,8 @@ def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
     new_pages = []
     for p, c in zip(params["blocks"], pages):
         a, c = _paged_attention_block(_layer_norm(x, p["ln_1"]), p["attn"],
-                                      cfg, c, tables, pos, valid)
-        x = _decode_mlp(x + a, p, cfg)
+                                      cfg, c, tables, pos, valid, tp_axis)
+        x = _decode_mlp(x + a, p, cfg, tp_axis)
         new_pages.append(c)
     x = _layer_norm(x, params["ln_f"])
     return _tied_logits(x, params, cfg), new_pages

@@ -319,19 +319,25 @@ def llama_decode(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig, cache: lis
 
 
 def _paged_attention_block(x, p, cfg: LlamaConfig, c, tables, pos, cos, sin,
-                           valid):
+                           valid, tp_axis=None):
     """The paged twin of :func:`_decode_attention` (serve/kv_cache layout):
     scatter the roped new k (and v) into block-table pages, attend over
     the gathered history via ops.attention.paged_decode_attention — the
     same masked-softmax chain, so greedy decode is bit-identical to the
-    dense cache whenever the attended length matches."""
+    dense cache whenever the attended length matches. With ``tp_axis``
+    (the TP serving engine) wq/wk/wv are column-parallel — this rank holds
+    n_head/tp query and n_kv_head/tp kv heads and the page pool's matching
+    kv-head shard — the scatter/gather/attend chain is shard-local (GQA
+    repeat preserved: H/tp over KV/tp), and wo is row-parallel with one
+    psum over the tensor axis."""
     from distributed_lion_tpu.ops.attention import (
         paged_decode_attention,
         paged_scatter_kv,
     )
 
     B, S, _ = x.shape
-    H, KV, hd = cfg.n_head, cfg.n_kv_head, cfg.head_dim
+    tp = 1 if tp_axis is None else jax.lax.psum(1, tp_axis)
+    H, KV, hd = cfg.n_head // tp, cfg.n_kv_head // tp, cfg.head_dim
     q = _matmul(x, p["wq"]).reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = _matmul(x, p["wk"]).reshape(B, S, KV, hd).transpose(0, 2, 1, 3)
     v = _matmul(x, p["wv"]).reshape(B, S, KV, hd)
@@ -341,12 +347,15 @@ def _paged_attention_block(x, p, cfg: LlamaConfig, c, tables, pos, cos, sin,
     v_pages = paged_scatter_kv(c["v"], tables, pos, v.astype(c["v"].dtype), valid)
     out = paged_decode_attention(q, k_pages, v_pages, tables, pos)
     out = out.transpose(0, 2, 1, 3).reshape(B, S, H * hd)
-    return _matmul(out, p["wo"]), {"k": k_pages, "v": v_pages}
+    out = _matmul(out, p["wo"])
+    if tp_axis is not None:
+        out = reduce_from_tp_region(out, tp_axis)
+    return out, {"k": k_pages, "v": v_pages}
 
 
 def llama_decode_paged(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
                        pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
-                       valid=None):
+                       valid=None, tp_axis=None):
     """Block-table decode (the serving engine's model hook): row b's
     ``tokens`` [B, S] sit at positions ``pos[b] .. pos[b]+S-1`` of its own
     sequence (rotary angles gathered per row); ``pages`` is the per-layer
@@ -354,7 +363,11 @@ def llama_decode_paged(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     store kv heads un-repeated, like the dense cache). Returns (logits
     [B, S, vocab] f32, updated pages). One jitted program serves both the
     bucketed prefill (S = padded prompt, ``valid`` masks the tail) and the
-    rolling decode tick (S = 1, pos = per-slot lengths)."""
+    rolling decode tick (S = 1, pos = per-slot lengths). With ``tp_axis``
+    (inside shard_map — the TP serving engine, ISSUE 13) attention/MLP
+    weights and the pool's kv-head axis are pre-sharded per
+    ``parallel.tensor_parallel.llama_param_specs``; wte/lm_head stay
+    replicated, so logits are identical on every tensor rank."""
     B, S = tokens.shape
     from distributed_lion_tpu.models.lora import lora_embed
 
@@ -367,9 +380,9 @@ def llama_decode_paged(params: dict, tokens: jnp.ndarray, cfg: LlamaConfig,
     for p, c in zip(params["blocks"], pages):
         a, c = _paged_attention_block(_rms_norm(x, p["ln_attn"], cfg.rms_eps),
                                       p["attn"], cfg, c, tables, pos, cos, sin,
-                                      valid)
+                                      valid, tp_axis)
         x = x + a
-        x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"])
+        x = x + _mlp(_rms_norm(x, p["ln_mlp"], cfg.rms_eps), p["mlp"], tp_axis)
         new_pages.append(c)
     x = _rms_norm(x, params["ln_f"], cfg.rms_eps)
     return _head_logits(x, params), new_pages

@@ -197,6 +197,33 @@ def paged_scatter_kv(pages: jnp.ndarray, tables: jnp.ndarray,
         flat, mode="drop", unique_indices=False)
 
 
+def paged_copy_pages(pages: list, src: jnp.ndarray,
+                     dst: jnp.ndarray) -> list:
+    """Copy whole pages inside each layer's pool — the device half of
+    copy-on-write prefix sharing (serve/kv_cache.BlockTables.cow).
+
+    pages — the engine's per-layer ``[{"k", "v"}]`` pool list;
+    src/dst [C] int32 — page-id pairs to copy this dispatch, padded with
+    the sentinel (== num_blocks): a sentinel ``dst`` drops the write and a
+    sentinel ``src`` gathers zeros (never kept — its dst is sentinel too),
+    so one fixed-width jitted program serves any number of copies ≤ C
+    without recompiling. The copy is bytewise (no arithmetic): a CoW'd
+    page attends bit-identically to the shared original, which is what
+    keeps shared-prefix decode pinned to the unshared engine. Under
+    tensor parallelism the pool's kv-head axis is sharded and the copy is
+    shard-local — page ids are replicated host math."""
+    out = []
+    for layer in pages:
+        out.append({
+            name: layer[name].at[dst].set(
+                jnp.take(layer[name], src, axis=0, mode="fill",
+                         fill_value=0),
+                mode="drop", unique_indices=False)
+            for name in ("k", "v")
+        })
+    return out
+
+
 def paged_gather_kv(pages: jnp.ndarray, tables: jnp.ndarray) -> jnp.ndarray:
     """[num_blocks, bs, KV, hd] pool + [B, nb] tables → [B, nb*bs, KV, hd]
     contiguous per-row history (sentinel pages read as zeros — they are

@@ -6,11 +6,15 @@ discipline as every other artifact (scripts/validate_metrics.py). Each
 request line:
 
     {"id": "r1", "prompt": "Hello", "max_new_tokens": 32,
-     "seed": 0, "arrival_tick": 0}
+     "seed": 0, "arrival_tick": 0, "prefix_group": "sys-v2"}
 
 ``prompt`` (text, run through the tokenizer) or ``tokens`` (explicit ids)
 — one of the two is required. ``arrival_tick`` staggers admission for
-continuous-batching runs (default 0 = all at start). Response lines carry
+continuous-batching runs (default 0 = all at start). ``prefix_group`` is
+an OPTIONAL routing/accounting tag for requests sharing a prompt prefix
+(the ``--prefix_cache`` engine matches by tokens, so the tag never
+changes what is shared); when present it must be a non-empty string —
+validated strictly, echoed on the response line. Response lines carry
 the request id, the generated ids/text, and the finish reason::
 
     {"id": "r1", "text": "...", "tokens": [...], "reason": "eos",
@@ -50,10 +54,19 @@ def load_request_file(path: str, tokenizer=None
                 raise ValueError(
                     f"{path}:{i}: request needs 'tokens' or 'prompt' "
                     "(with a tokenizer)")
+            group = d.get("prefix_group")
+            if group is not None and (
+                    not isinstance(group, str) or not group):
+                # strict: a mistyped tag must fail loudly, not silently
+                # ride as accounting noise (same discipline as every
+                # other artifact field — scripts/validate_metrics.py)
+                raise ValueError(
+                    f"{path}:{i}: 'prefix_group' must be a non-empty "
+                    f"string when present, got {group!r}")
             requests.append(Request(
                 req_id=rid, tokens=list(toks),
                 max_new_tokens=d.get("max_new_tokens"),
-                seed=int(d.get("seed", 0))))
+                seed=int(d.get("seed", 0)), prefix_group=group))
             arrivals[rid] = int(d.get("arrival_tick", 0))
     return requests, arrivals
 
@@ -70,9 +83,16 @@ def handle_requests(engine: ServingEngine, requests: List[Request],
                     arrivals: Optional[Dict[Any, int]] = None,
                     tokenizer=None) -> List[dict]:
     """Drive the engine over a workload; response records in request
-    order (an unserved id would be loudly missing, not silently skipped)."""
+    order (an unserved id would be loudly missing, not silently skipped).
+    Requests tagged with ``prefix_group`` get it echoed on the record."""
     done = engine.run(requests, arrivals or {})
-    return [completion_record(done[r.req_id], tokenizer) for r in requests]
+    records = []
+    for r in requests:
+        rec = completion_record(done[r.req_id], tokenizer)
+        if r.prefix_group is not None:
+            rec["prefix_group"] = r.prefix_group
+        records.append(rec)
+    return records
 
 
 def serve_request_file(engine: ServingEngine, in_path: str, out_path: str,

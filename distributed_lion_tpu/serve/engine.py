@@ -25,9 +25,41 @@ Scheduling (the vLLM recipe, simplified to two tick kinds):
   it rides or who shares the batch, which is what makes a staggered
   continuous-batching run produce outputs identical to solo runs
   (tests/test_serve.py pins it).
-- **evict** — EOS / ``max_new_tokens`` / cache-overflow slots free their
-  pages; the block table row goes back to sentinel, so the next decode
-  tick simply ignores the slot (no recompile, the shapes never changed).
+- **evict** — EOS / ``max_new_tokens`` / cache-overflow slots release
+  their page refs; the block table row goes back to sentinel, so the next
+  decode tick simply ignores the slot (no recompile, the shapes never
+  changed).
+
+**Tensor-parallel serving** (``ServeConfig.tp`` — ISSUE 13): the engine
+composes with ``parallel/tensor_parallel`` exactly the way the trainer
+does — attention/MLP weights sharded per the Megatron param specs, the
+page pools sharded over their KV-HEAD axis across a ``(data=1,
+tensor=tp)`` mesh, and every decode/prefill/verify dispatch shard_map'd
+over the slice. The kv-head axis is embarrassingly parallel through the
+whole paged chain (scatter/gather/attend are per-head), so each rank runs
+the same program on its head shard and only the row-parallel output
+projections cross the tensor axis (one psum per block). Host-side block
+tables stay REPLICATED numpy — allocation is the same table math at any
+tp and never recompiles. ``tp=0`` (default) is the single-device path,
+bit-for-bit the pre-TP engine; ``tp=1`` runs the sharded program on a
+1-mesh and is pinned bit-identical to it; ``tp>1`` divides weight + KV
+HBM per chip and is pinned token-identical on CPU mesh emulation
+(tests/test_tp_serve.py).
+
+**Prefix sharing** (``ServeConfig.prefix_cache``): a prompt-prefix →
+page-run cache with per-page refcounts (serve/kv_cache.PrefixCache). An
+admitted request shares the cached pages covering its prompt prefix (one
+physical copy for N requests carrying the same system prompt), prefills
+only the uncovered suffix (the shared pages already hold its k/v —
+computed once, by the first request, from the same tokens and weights,
+hence bit-identical), and copy-on-write kicks in at the first divergent
+write: a write landing in a ref>1 page first copies that page
+(``ops.attention.paged_copy_pages``) so ``paged_scatter_kv`` targets a
+private clone for the written suffix only. ``grow``/``shrink``/free are
+refcount ops — speculative rollback over a shared table row releases
+refs without freeing pages a neighbor still reads. Outputs are pinned
+identical to the unshared engine (greedy, sampled, and speculative —
+tests/test_serve.py / test_speculate.py).
 
 With ``ServeConfig.speculate`` set, the decode tick is replaced by the
 speculative draft/verify/commit round (serve/speculate.py): up to k
@@ -40,11 +72,15 @@ NF4/int8 frozen-weight serving: ``quant='nf4'`` re-packs the dense
 checkpoint through ``ops.quant.quantize_tree`` once at engine build; the
 decode paths dequantize inside each matmul's producer fusion
 (``maybe_dequant``), so a 7B checkpoint serves from ~0.5 byte/param of
-HBM plus the page pool.
+HBM plus the page pool. Under TP the quantized leaves shard with the SAME
+specs as their dense twins (the shaped layout's last-dim blocks never
+straddle a shard boundary — ops/quant.validate_quant_tp fails fast when a
+block size can't split).
 
 Journal spans (``serve/admit``, ``serve/prefill``, ``serve/decode_tick``,
-``serve/evict``) ride the PR-7 run journal when one is installed
-(train/journal.install), giving ``cli/run_analyze`` a per-tick timeline.
+``serve/cow``, ``serve/evict``) ride the PR-7 run journal when one is
+installed (train/journal.install), giving ``cli/run_analyze`` a per-tick
+timeline.
 """
 
 from __future__ import annotations
@@ -55,8 +91,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
 from distributed_lion_tpu.serve.kv_cache import (
     BlockTables,
+    PrefixCache,
     bucket_tokens,
     init_pages,
 )
@@ -82,7 +120,26 @@ class ServeConfig:
     top_k: Optional[int] = None  # static (one compiled tick), seeds are
     top_p: Optional[float] = None  # per-request
     quant: str = "none"          # none | nf4 | int8 frozen-weight serving
+    quant_block: Optional[int] = None  # quant block override (elements;
+    # None = the format default). Under --serve_tp every sharded last dim
+    # needs last/2 (nf4 packing) and last/block divisible by tp
+    # (ops/quant.validate_quant_tp fails fast with the leaf path) — small
+    # models need a smaller block than the 64-element default.
     eos_id: Optional[int] = None
+    tp: int = 0                  # tensor-parallel degree. 0 = the
+    # single-device engine (no mesh, no collectives — the pre-TP program
+    # bit for bit); tp >= 1 builds a (data=1, tensor=tp) mesh over the
+    # first tp local devices, shards weights per the Megatron param specs
+    # and the page pools over kv heads, and shard_maps every dispatch.
+    # tp=1 is pinned BIT-identical to tp=0; tp>1 divides weight+KV HBM
+    # per chip and is pinned token-identical (tests/test_tp_serve.py).
+    # kv_heads/n_head/d_ff must divide (parallel.tensor_parallel.
+    # validate_tp — the same rule the trainer enforces).
+    prefix_cache: bool = False   # share prompt-prefix KV pages across
+    # requests (serve/kv_cache.PrefixCache): refcounted page runs, CoW on
+    # the first divergent write, LRU reclaim under pool pressure. Outputs
+    # pinned identical to the unshared engine; only the physical page
+    # count (and the prefill work for cache hits) changes.
     speculate: str = ""          # '' = one token per decode tick;
     # '<drafter>:<k>' (ngram:4 | draft:2 ...) arms speculative decode
     # (serve/speculate.py): the drafter proposes up to k tokens per slot,
@@ -104,6 +161,10 @@ class Request:
     tokens: List[int]                    # prompt token ids (non-empty)
     max_new_tokens: Optional[int] = None  # None = engine default
     seed: int = 0
+    prefix_group: Optional[str] = None   # optional routing/accounting tag
+    # for requests sharing a prompt prefix (serve/api validates it
+    # strictly and echoes it on the response); the prefix cache itself
+    # matches by TOKENS, so the tag never changes what is shared
 
 
 @dataclasses.dataclass
@@ -126,9 +187,11 @@ class _Slot:
 class ServeModel:
     """Family adapter: the paged decode hook + cache geometry the engine
     needs, built from a (params, config) pair. ``decode_paged(params,
-    tokens, pages, tables, pos, valid)`` must return ``(logits [B,S,V]
-    f32, pages')`` — models/gpt2.gpt2_decode_paged and
-    models/llama.llama_decode_paged are the two implementations."""
+    tokens, pages, tables, pos, valid, tp_axis)`` must return ``(logits
+    [B,S,V] f32, pages')`` — models/gpt2.gpt2_decode_paged and
+    models/llama.llama_decode_paged are the two implementations; with
+    ``tp_axis`` the call runs inside the engine's shard_map and the hook
+    threads the axis into the model's Megatron-split blocks."""
 
     def __init__(self, family: str, cfg: Any, params: Any,
                  decode_paged: Callable, n_layer: int, kv_heads: int,
@@ -147,6 +210,18 @@ class ServeModel:
         # engine refuses a page geometry that would silently alias/exceed
         self.max_positions = max_positions
 
+    def param_specs(self) -> dict:
+        """The Megatron PartitionSpec tree for this family — ONE source of
+        truth with the trainer (parallel/tensor_parallel), so serving and
+        training can never shard the same checkpoint differently."""
+        from distributed_lion_tpu.parallel.tensor_parallel import (
+            gpt2_param_specs,
+            llama_param_specs,
+        )
+
+        fn = gpt2_param_specs if self.family == "gpt2" else llama_param_specs
+        return fn(self.cfg)
+
     @staticmethod
     def for_gpt2(params: Any, cfg: Any) -> "ServeModel":
         from distributed_lion_tpu.models.gpt2 import gpt2_decode_paged
@@ -163,8 +238,9 @@ class ServeModel:
                 "the bucketed prefill); serve a dense checkpoint or use "
                 "single-shot run_generate")
 
-        def decode(p, toks, pages, tables, pos, valid=None):
-            return gpt2_decode_paged(p, toks, cfg, pages, tables, pos, valid)
+        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None):
+            return gpt2_decode_paged(p, toks, cfg, pages, tables, pos,
+                                     valid, tp_axis)
 
         return ServeModel("gpt2", cfg, params, decode, cfg.n_layer,
                           cfg.n_head, cfg.head_dim, cfg.compute_dtype,
@@ -174,8 +250,9 @@ class ServeModel:
     def for_llama(params: Any, cfg: Any) -> "ServeModel":
         from distributed_lion_tpu.models.llama import llama_decode_paged
 
-        def decode(p, toks, pages, tables, pos, valid=None):
-            return llama_decode_paged(p, toks, cfg, pages, tables, pos, valid)
+        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None):
+            return llama_decode_paged(p, toks, cfg, pages, tables, pos,
+                                      valid, tp_axis)
 
         return ServeModel("llama", cfg, params, decode, cfg.n_layer,
                           cfg.n_kv_head, cfg.head_dim, cfg.compute_dtype,
@@ -219,6 +296,42 @@ def _sample_rows(logits, seeds, counts, temperature: float,
     return jax.vmap(jax.random.categorical)(keys, filtered)
 
 
+def _flat_leaves(tree, is_leaf=None):
+    import jax
+
+    return jax.tree.flatten(tree, is_leaf=is_leaf)
+
+
+def _shard_params(params: Any, specs: Any, mesh) -> Any:
+    """Place a (possibly NF4/int8-quantized) weight tree onto the TP mesh
+    per its Megatron PartitionSpec tree. Quantized leaves shard with the
+    SAME spec as their dense twin: the shaped layout keeps every leading
+    dim 1:1 with the dense weight and blocks run along the last dim only
+    (ops/quant), so codes and absmax both slice cleanly."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from distributed_lion_tpu.ops.quant import QuantizedTensor
+
+    leaves, treedef = _flat_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    spec_leaves, _ = _flat_leaves(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+    assert len(leaves) == len(spec_leaves), \
+        "param tree and spec tree disagree"
+
+    def place(w, spec):
+        s = NamedSharding(mesh, spec)
+        if isinstance(w, QuantizedTensor):
+            return QuantizedTensor(jax.device_put(w.codes, s),
+                                   jax.device_put(w.absmax, s),
+                                   w.shape, w.fmt, w.block, w.layout)
+        return jax.device_put(w, s)
+
+    return jax.tree.unflatten(
+        treedef, [place(w, s) for w, s in zip(leaves, spec_leaves)])
+
+
 class ServingEngine:
     """See module doc. Host-side driver: ``submit`` requests, call
     ``step()`` per tick (or ``run()`` to drain a workload), collect
@@ -228,6 +341,7 @@ class ServingEngine:
                  draft_model: Optional[ServeModel] = None):
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.model = model
         self.cfg = cfg
@@ -237,8 +351,8 @@ class ServingEngine:
         if cfg.quant != "none":
             from distributed_lion_tpu.ops.quant import quantize_tree
 
-            params = quantize_tree(params, cfg.quant)
-        self.params = params
+            params = quantize_tree(params, cfg.quant,
+                                   block=cfg.quant_block)
         horizon = cfg.block_size * cfg.max_blocks_per_seq
         if model.max_positions is not None and horizon > model.max_positions:
             raise ValueError(
@@ -246,39 +360,92 @@ class ServingEngine:
                 f"position budget is {model.max_positions} (n_ctx); shrink "
                 "--block_size/--max_blocks_per_seq — positions past the "
                 "trained horizon would silently alias")
+
+        # ---- tensor-parallel mesh (tp=0: the single-device program)
+        self._mesh = None
+        self._param_specs = None
+        self._pages_spec = None
+        pages_sharding = None
+        if cfg.tp:
+            from distributed_lion_tpu.parallel.mesh import make_mesh
+            from distributed_lion_tpu.parallel.tensor_parallel import (
+                validate_tp,
+            )
+
+            validate_tp(model.cfg, cfg.tp, model.family)
+            if model.kv_heads % cfg.tp:
+                raise ValueError(
+                    f"kv heads ({model.kv_heads}) not divisible by "
+                    f"--serve_tp {cfg.tp}: the page pool shards over the "
+                    "kv-head axis")
+            devices = jax.devices()
+            if len(devices) < cfg.tp:
+                raise ValueError(
+                    f"--serve_tp {cfg.tp} needs {cfg.tp} devices, backend "
+                    f"has {len(devices)}")
+            self._mesh = make_mesh(data=1, tensor=cfg.tp,
+                                   devices=devices[:cfg.tp])
+            specs = model.param_specs()
+            if cfg.quant != "none":
+                from distributed_lion_tpu.ops.quant import validate_quant_tp
+
+                validate_quant_tp(params, specs, cfg.tp, TENSOR_AXIS)
+            params = _shard_params(params, specs, self._mesh)
+            self._param_specs = specs
+            pool_spec = P(None, None, TENSOR_AXIS, None)
+            self._pages_spec = [{"k": pool_spec, "v": pool_spec}
+                                for _ in range(model.n_layer)]
+            pages_sharding = NamedSharding(self._mesh, pool_spec)
+        self.params = params
+
         self.tables = BlockTables(cfg.resolved_num_blocks(), cfg.block_size,
                                   cfg.max_seqs, cfg.max_blocks_per_seq)
         self.pages = init_pages(model.n_layer, cfg.resolved_num_blocks(),
                                 cfg.block_size, model.kv_heads,
                                 model.head_dim, model.cache_dtype)
+        if pages_sharding is not None:
+            self.pages = [
+                {k: jax.device_put(v, pages_sharding)
+                 for k, v in layer.items()} for layer in self.pages]
+        self.prefix = PrefixCache(self.tables) if cfg.prefix_cache else None
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_seqs
         self.pending: deque = deque()
         self.stats = {"ticks": 0, "decode_ticks": 0, "prefill_dispatches": 0,
                       "decode_tokens": 0, "prefill_tokens": 0,
-                      "padded_prefill_tokens": 0, "evictions": 0}
+                      "padded_prefill_tokens": 0, "evictions": 0,
+                      "freed_pages": 0}
+        if self.prefix is not None:
+            self.stats.update(prefix_hits=0, shared_tokens=0, cow_copies=0,
+                              reclaimed_pages=0)
 
-        # page donation halves the pool's HBM traffic on TPU; the CPU
-        # backend has no donation and would warn every tick
-        donate = (1,) if jax.default_backend() != "cpu" else ()
         samp = (cfg.temperature, cfg.top_k, cfg.top_p)
+        tp_axis = TENSOR_AXIS if self._mesh is not None else None
 
         def decode_tick(params, pages, tables, lens, last, seeds, counts):
             logits, pages = model.decode_paged(params, last[:, None], pages,
-                                               tables, lens)
+                                               tables, lens, tp_axis=tp_axis)
             return _sample_rows(logits[:, -1], seeds, counts, *samp), pages
 
-        def prefill(params, pages, tables, toks, length, seed, count):
+        def prefill(params, pages, tables, toks, start, length, seed, count):
+            # toks [1, P] — the prompt SUFFIX not covered by shared prefix
+            # pages, scattered at absolute positions start..start+P-1
+            # (start == 0 without prefix sharing: the whole prompt)
             valid = jnp.arange(toks.shape[1])[None, :] < length
-            pos = jnp.zeros((1,), jnp.int32)
             logits, pages = model.decode_paged(params, toks, pages, tables,
-                                               pos, valid)
+                                               start, valid, tp_axis=tp_axis)
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                                 keepdims=False)
             tok = _sample_rows(last[None], seed[None], count[None], *samp)
             return tok[0], pages
 
-        self._decode_tick = jax.jit(decode_tick, donate_argnums=donate)
-        self._prefill = jax.jit(prefill, donate_argnums=donate)
+        def cow_copy(pages, src, dst):
+            from distributed_lion_tpu.ops.attention import paged_copy_pages
+
+            return paged_copy_pages(pages, src, dst)
+
+        self._decode_tick = self._jit_paged(decode_tick, n_rest=5)
+        self._prefill = self._jit_paged(prefill, n_rest=6)
+        self._cow = self._jit_cow(cow_copy)
 
         self._speculator = None
         if cfg.speculate:
@@ -286,6 +453,47 @@ class ServingEngine:
 
             self._speculator = build_speculator(self, cfg.speculate,
                                                 draft_model)
+
+    # ------------------------------------------------------- TP dispatch
+    def _jit_paged(self, fn, n_rest: int):
+        """jit a dispatch ``fn(params, pages, *rest) -> (out, pages)``;
+        under TP the body is shard_map'd over the serving mesh — params
+        and pages sharded per their spec trees, every host-built operand
+        (tables, lens, tokens, seeds) replicated, the sampled tokens
+        replicated back out (each rank computes identical logits: see the
+        model hooks). ``check_vma=False`` mirrors the trainer's usage."""
+        import jax
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        if self._mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        from jax.sharding import PartitionSpec as P
+
+        rep = P()
+        body = jax.shard_map(
+            fn, mesh=self._mesh,
+            in_specs=(self._param_specs, self._pages_spec)
+            + (rep,) * n_rest,
+            out_specs=(rep, self._pages_spec), check_vma=False)
+        return jax.jit(body, donate_argnums=donate)
+
+    def _jit_cow(self, fn):
+        """jit the CoW page-copy ``fn(pages, src, dst) -> pages`` (pages
+        donated; shard-local under TP — page ids are replicated host
+        math, the kv-head axis stays put)."""
+        import jax
+
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        if self._mesh is None:
+            return jax.jit(fn, donate_argnums=donate)
+        from jax.sharding import PartitionSpec as P
+
+        rep = P()
+        body = jax.shard_map(
+            fn, mesh=self._mesh,
+            in_specs=(self._pages_spec, rep, rep),
+            out_specs=self._pages_spec, check_vma=False)
+        return jax.jit(body, donate_argnums=donate)
 
     # ------------------------------------------------------------- intake
     def submit(self, req: Request) -> None:
@@ -297,6 +505,65 @@ class ServingEngine:
     def _bucket(self, n: int) -> int:
         return bucket_tokens(n, self.cfg.block_size,
                              self.cfg.max_blocks_per_seq)
+
+    # ------------------------------------------------- page bookkeeping
+    def _grow(self, slot: int, n_tokens: int) -> bool:
+        """``tables.grow`` with prefix-cache reclaim as the fallback: a
+        pool exhausted by CACHED pages (refs held only by the cache) is
+        not full — LRU chains are dropped until the grow fits or the
+        cache is empty. Overflow semantics beyond that are the caller's
+        (unchanged from the unshared engine)."""
+        if self.tables.grow(slot, n_tokens):
+            return True
+        if self.prefix is None:
+            return False
+        if self.tables.blocks_for(n_tokens) > self.tables.max_blocks_per_seq:
+            return False  # width cap, not pool pressure: no reclaim helps
+        need = (self.tables.blocks_for(n_tokens)
+                - int(self.tables.owned[slot]))
+        self.stats["reclaimed_pages"] += self.prefix.reclaim(need)
+        return self.tables.grow(slot, n_tokens)
+
+    def _cow_if_shared(self, slot: int, pos: int, pairs: List[tuple]) -> bool:
+        """Queue a copy-on-write for the page holding ``pos`` when it is
+        shared (refs > 1) — the caller flushes ``pairs`` as ONE device
+        dispatch before any write lands. Returns False only when no page
+        can be found even after cache reclaim (caller overflow-evicts)."""
+        if self.prefix is None or not self.tables.shared_at(slot, pos):
+            return True
+        pair = self.tables.cow(slot, pos)
+        if pair is None:
+            self.stats["reclaimed_pages"] += self.prefix.reclaim(1)
+            if not self.tables.shared_at(slot, pos):
+                # the reclaim dropped the cache's own ref on this page —
+                # it is private now, no copy needed (retrying cow here
+                # would trip its shared-page precondition)
+                return True
+            pair = self.tables.cow(slot, pos)
+            if pair is None:
+                return False
+        pairs.append(pair)
+        self.stats["cow_copies"] += 1
+        return True
+
+    def _flush_cow(self, pairs: List[tuple]) -> None:
+        """Dispatch the tick's queued page copies (one fixed-width jitted
+        program, sentinel-padded — no recompiles as the copy count
+        varies). A no-op on an empty queue: the common tick pays zero."""
+        if not pairs:
+            return
+        import jax.numpy as jnp
+
+        width = self.cfg.max_seqs
+        assert len(pairs) <= width, "more CoW copies than slots"
+        sentinel = self.tables.sentinel
+        src = np.full((width,), sentinel, np.int32)
+        dst = np.full((width,), sentinel, np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i], dst[i] = s, d
+        with journal.active().span("serve/cow", copies=len(pairs)):
+            self.pages = self._cow(self.pages, jnp.asarray(src),
+                                   jnp.asarray(dst))
 
     # -------------------------------------------------------------- ticks
     def _admit(self, completions: List[Completion]) -> None:
@@ -313,28 +580,52 @@ class ServingEngine:
                 self.pending.popleft()
                 completions.append(Completion(req.req_id, L, [], "rejected"))
                 continue
-            P = self._bucket(L)
+            slot = self.tables.find_free_slot()
+            if slot is None:
+                break  # no slot: wait for evictions — checked BEFORE the
+                # prefix match so a stalled queue costs O(1) per tick,
+                # not a full match walk (which would also touch LRU
+                # recency for a request that cannot admit)
+            run, covered = ([], 0)
+            if self.prefix is not None:
+                run, covered = self.prefix.match(req.tokens)
+            P = self._bucket(L - covered)
             if admitted and P > budget:
                 break  # fairness cap — but never starve an empty tick
-            slot = self.tables.find_free_slot()
-            if slot is None or not self.tables.grow(slot, L + 1):
-                break  # no slot/pages: wait for evictions
+            if run:
+                self.tables.share(slot, run)
+            cow_pairs: List[tuple] = []
+            if not (self._grow(slot, L + 1)
+                    and self._cow_if_shared(slot, covered, cow_pairs)):
+                # no pages even after reclaim: roll the slot back EMPTY
+                # (all-or-nothing — a half-reserved slot strands refs)
+                self.stats["freed_pages"] += self.tables.free_slot(slot)
+                break
             self.pending.popleft()
+            self._flush_cow(cow_pairs)
+            suffix = req.tokens[covered:]
             with jrnl.span("serve/prefill", req_id=str(req.req_id),
-                           prompt_len=L, padded=P, slot=slot):
+                           prompt_len=L, padded=P, slot=slot,
+                           shared=covered):
                 toks = np.zeros((1, P), np.int32)
-                toks[0, :L] = req.tokens
+                toks[0, :len(suffix)] = suffix
                 tok, self.pages = self._prefill(
                     self.params, self.pages,
                     jnp.asarray(self.tables.tables[slot:slot + 1]),
-                    jnp.asarray(toks), jnp.int32(L),
+                    jnp.asarray(toks), jnp.full((1,), covered, jnp.int32),
+                    jnp.int32(len(suffix)),
                     jnp.uint32(req.seed), jnp.int32(0))
                 first = int(tok)  # ONE host sync per prefill dispatch
             budget -= P
             admitted += 1
             self.stats["prefill_dispatches"] += 1
-            self.stats["prefill_tokens"] += L
+            self.stats["prefill_tokens"] += len(suffix)
             self.stats["padded_prefill_tokens"] += P
+            if self.prefix is not None:
+                if covered:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["shared_tokens"] += covered
+                self.prefix.register(slot, list(req.tokens))
             slot_state = _Slot(req=req, cache_len=L, last_tok=first,
                                budget=(req.max_new_tokens
                                        or self.cfg.max_new_tokens))
@@ -360,7 +651,11 @@ class ServingEngine:
         with journal.active().span("serve/evict", req_id=str(s.req.req_id),
                                    slot=slot, reason=reason,
                                    n_generated=len(s.gen)):
-            self.tables.free_slot(slot)
+            # refcount-honest accounting: evicting a sharer whose pages
+            # all outlive it (prefix cache / other slots) frees ZERO
+            # physical pages — freed_pages records what really returned
+            freed = self.tables.free_slot(slot)
+            self.stats["freed_pages"] += freed
             self.slots[slot] = None
             self.stats["evictions"] += 1
             if self._speculator is not None:
@@ -374,15 +669,21 @@ class ServingEngine:
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if not active:
             return
-        # grow tables for the tick's ONE write per active slot; a slot the
-        # pool can't grow is evicted as overflow (truncated output) so the
-        # rest of the batch keeps moving
+        # grow tables for the tick's ONE write per active slot (CoW'ing a
+        # shared boundary page first — the first decode write after a
+        # cache-hit admit is the canonical divergent write); a slot the
+        # pool can't serve even after reclaim is evicted as overflow
+        # (truncated output) so the rest of the batch keeps moving
+        cow_pairs: List[tuple] = []
         for i in list(active):
-            if not self.tables.grow(i, self.slots[i].cache_len + 1):
+            s = self.slots[i]
+            if not (self._grow(i, s.cache_len + 1)
+                    and self._cow_if_shared(i, s.cache_len, cow_pairs)):
                 self._maybe_finish(i, completions, overflow=True)
                 active.remove(i)
         if not active:
             return
+        self._flush_cow(cow_pairs)
         S = self.cfg.max_seqs
         lens = np.zeros((S,), np.int32)
         last = np.zeros((S,), np.int32)

@@ -16,6 +16,20 @@ past the pool). Scatters to a sentinel page drop (XLA scatter
 ``mode='drop'``), gathers from it fill zeros — inactive decode slots and
 right-padded prefill tails are inert without a single host branch inside
 the compiled tick.
+
+Prefix sharing (ISSUE 13): every page carries a REFCOUNT. ``grow`` mints
+ref-1 pages exactly as before; :meth:`BlockTables.share` points a slot's
+leading table entries at pages another sequence (or the
+:class:`PrefixCache`) already owns, bumping their refs; ``shrink`` /
+``free_slot`` release refs and a page returns to the free list only at
+ref 0 — so N requests carrying the same system prompt hold ONE physical
+copy of its KV pages, and speculative rollback over a shared table row
+releases refs without freeing pages a neighbor still reads. A write into
+a ref>1 page is forbidden; the engine first calls :meth:`BlockTables.cow`
+(copy-on-write: a fresh ref-1 page replaces the table entry, the device
+copy rides ``ops.attention.paged_copy_pages``) so the first divergent
+write targets a private copy — content-identical up to the written
+suffix, bit-identity preserved by construction.
 """
 
 from __future__ import annotations
@@ -79,6 +93,12 @@ class BlockTables:
         self.tables = np.full((max_seqs, max_blocks_per_seq), self.sentinel,
                               np.int32)
         self.owned = np.zeros((max_seqs,), np.int32)
+        # per-page refcounts: a table entry AND a PrefixCache registration
+        # each hold one ref; a page is free iff refs == 0 (then it sits on
+        # the free list). pages_allocated counts every mint (grow pops +
+        # CoW pops) — the bench's physical-page ledger.
+        self.refs = np.zeros((self.num_blocks,), np.int32)
+        self.pages_allocated = 0
 
     # ------------------------------------------------------------ capacity
     @property
@@ -101,6 +121,25 @@ class BlockTables:
         return need - int(self.owned[slot]) <= len(self._free)
 
     # ---------------------------------------------------------- alloc/free
+    def _mint(self) -> int:
+        """Pop a fresh page off the free list at ref 1 (counted)."""
+        p = self._free.pop()
+        assert self.refs[p] == 0, f"page {p} on the free list with refs"
+        self.refs[p] = 1
+        self.pages_allocated += 1
+        return p
+
+    def _release(self, page: int) -> int:
+        """Drop one ref; the page returns to the LIFO free list only at
+        ref 0. Returns 1 when the page was physically freed, else 0."""
+        page = int(page)
+        assert self.refs[page] > 0, f"double free of page {page}"
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self._free.append(page)
+            return 1
+        return 0
+
     def grow(self, slot: int, n_tokens: int) -> bool:
         """Ensure ``slot``'s table covers ``n_tokens`` total cache
         entries, allocating pages as needed. Returns False (allocating
@@ -110,15 +149,20 @@ class BlockTables:
             return False
         need = self.blocks_for(n_tokens)
         have = int(self.owned[slot])
+        if need <= have:
+            # grow never shrinks: writing owned = need here would orphan
+            # the tail pages' refs (table entries past owned are invisible
+            # to every release path) — the refcount fuzz test caught this
+            return True
         for i in range(have, need):
-            self.tables[slot, i] = self._free.pop()
+            self.tables[slot, i] = self._mint()
         self.owned[slot] = need
         return True
 
     def shrink(self, slot: int, n_tokens: int) -> int:
-        """Free ``slot``'s pages beyond those ``n_tokens`` total cache
-        entries need — the EXACT inverse of :meth:`grow`: pages return to
-        the LIFO free list in reverse allocation order, so
+        """Release ``slot``'s pages beyond those ``n_tokens`` total cache
+        entries need — the EXACT inverse of :meth:`grow`: ref-1 pages
+        return to the LIFO free list in reverse allocation order, so
         ``grow(slot, a); shrink(slot, b)`` leaves the allocator (tables,
         owned, free-list order) bit-identical to ``grow(slot, b)`` for any
         ``b <= a``. This is the speculative-decode rollback primitive
@@ -126,26 +170,33 @@ class BlockTables:
         table for k draft tokens and the rejected tail's pages are handed
         back as if they were never allocated, so the post-commit state
         matches what a token-by-token run would hold (tests/test_serve.py
-        pins it). Returns the page count freed."""
+        pins it). SHARED tail pages (refs > 1 — a rollback over a shared
+        prefix) only drop this slot's ref: the physical page survives for
+        its other holders. Returns the count of pages physically freed."""
         need = self.blocks_for(n_tokens)
         have = int(self.owned[slot])
         if need >= have:
             return 0
+        freed = 0
         for i in range(have - 1, need - 1, -1):
-            self._free.append(int(self.tables[slot, i]))
+            freed += self._release(self.tables[slot, i])
             self.tables[slot, i] = self.sentinel
         self.owned[slot] = need
-        return have - need
+        return freed
 
     def free_slot(self, slot: int) -> int:
-        """Return all of ``slot``'s pages to the pool; the table row goes
-        back to sentinel (inert on device). Returns the page count freed."""
+        """Release all of ``slot``'s refs; the table row goes back to
+        sentinel (inert on device). Returns the count of pages physically
+        freed — evicting a sharer whose pages all outlive it (the prefix
+        cache or another slot still holds them) frees ZERO pages, and the
+        engine's accounting must say so."""
         n = int(self.owned[slot])
+        freed = 0
         for i in range(n):
-            self._free.append(int(self.tables[slot, i]))
+            freed += self._release(self.tables[slot, i])
         self.tables[slot, :] = self.sentinel
         self.owned[slot] = 0
-        return n
+        return freed
 
     def find_free_slot(self) -> Optional[int]:
         """Lowest slot index owning zero pages (the engine marks a slot
@@ -154,3 +205,225 @@ class BlockTables:
             if self.owned[s] == 0:
                 return s
         return None
+
+    # ------------------------------------------------------ prefix sharing
+    def share(self, slot: int, pages: list) -> None:
+        """Point an EMPTY slot's leading table entries at already-owned
+        pages (a prefix-cache hit), taking one ref per page. ``grow`` then
+        extends the row with fresh private pages as usual."""
+        if int(self.owned[slot]) != 0:
+            raise ValueError(
+                f"share() needs an empty slot, slot {slot} owns "
+                f"{int(self.owned[slot])} pages")
+        if len(pages) > self.max_blocks_per_seq:
+            raise ValueError(
+                f"shared run of {len(pages)} pages exceeds the table "
+                f"width {self.max_blocks_per_seq}")
+        for i, p in enumerate(pages):
+            assert self.refs[p] > 0, f"sharing unowned page {p}"
+            self.tables[slot, i] = int(p)
+            self.refs[p] += 1
+        self.owned[slot] = len(pages)
+
+    def page_at(self, slot: int, pos: int) -> int:
+        """The page id holding cache position ``pos`` of ``slot``."""
+        return int(self.tables[slot, pos // self.block_size])
+
+    def shared_at(self, slot: int, pos: int) -> bool:
+        """True when the page holding ``pos`` is shared (refs > 1) — a
+        write there needs :meth:`cow` first."""
+        idx = pos // self.block_size
+        if idx >= int(self.owned[slot]):
+            return False
+        return int(self.refs[self.tables[slot, idx]]) > 1
+
+    def cow(self, slot: int, pos: int) -> Optional[tuple]:
+        """Copy-on-write: replace the shared page holding ``pos`` with a
+        fresh private page (the caller device-copies the content via
+        ``ops.attention.paged_copy_pages`` before any write lands).
+        Returns ``(src_page, dst_page)`` — or None when the pool is dry
+        (caller falls back to reclaim/overflow, nothing changed)."""
+        idx = pos // self.block_size
+        src = int(self.tables[slot, idx])
+        assert self.refs[src] > 1, \
+            f"cow on unshared page {src} (slot {slot} pos {pos})"
+        if not self._free:
+            return None
+        dst = self._mint()
+        self.refs[src] -= 1  # > 0 by the assert: never returns to the pool
+        self.tables[slot, idx] = dst
+        return src, dst
+
+    # ----------------------------------------------- cache-side ref plumbing
+    def add_ref(self, page: int) -> None:
+        """One more holder of ``page`` (the PrefixCache's registration)."""
+        assert self.refs[page] > 0, f"ref on unowned page {page}"
+        self.refs[page] += 1
+
+    def release_page(self, page: int) -> int:
+        """Drop a non-table ref (PrefixCache eviction). Returns 1 when the
+        page was physically freed."""
+        return self._release(page)
+
+    @property
+    def physical_pages(self) -> int:
+        """Pages currently holding data (refs > 0)."""
+        return self.num_blocks - len(self._free)
+
+
+class PrefixCache:
+    """Prompt-prefix → page-run cache over a :class:`BlockTables` pool.
+
+    Keys are the literal token tuples a page's content depends on (causal
+    attention: page ``i``'s k/v are a pure function of ``tokens[:cover]``
+    where ``cover`` is the page's last covered position + 1), so a hit can
+    never alias two different prefixes — no hash-collision risk, and the
+    chain walk is one dict probe per page. Entries hold one allocator ref
+    each (``BlockTables.add_ref``), so cached pages survive their creating
+    request; :meth:`reclaim` drops least-recently-used chains when the
+    engine needs pages back.
+
+    Full pages register under their exact coverage key; a PARTIAL tail
+    page (a prompt whose length is not a page multiple) registers under
+    every prefix of its coverage too — page content at offsets < t depends
+    only on ``tokens[:k*bs + t]``, so a request matching just a prefix of
+    the partial page may still share it (its first own write then lands
+    inside the shared page and triggers the engine's CoW). Matches are
+    capped at ``len(prompt) - 1``: a request must always prefill at least
+    its last prompt token to produce the logits its first sample needs.
+    """
+
+    def __init__(self, tables: BlockTables):
+        self.tables = tables
+        self.bs = tables.block_size
+        # key (token tuple) -> {"page": id, "full": bool, "tick": lru}
+        # partial pages appear under EVERY prefix key of their coverage;
+        # all keys of one physical page share the ONE entry dict, so a
+        # touch through any key refreshes the whole page's recency
+        self._entries = {}
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.reclaimed_pages = 0
+
+    def __len__(self) -> int:
+        return len({id(e) for e in self._entries.values()})
+
+    def _touch(self, entry: dict) -> None:
+        self._tick += 1
+        entry["tick"] = self._tick
+
+    # --------------------------------------------------------------- match
+    def match(self, tokens: list) -> tuple:
+        """Longest cached prefix of ``tokens`` usable by a new request:
+        ``(pages, covered)`` with ``covered <= len(tokens) - 1`` (the last
+        prompt token always prefills — see class doc). Pages are returned
+        in table order; the caller shares them into a slot via
+        :meth:`BlockTables.share` BEFORE growing the private tail."""
+        L = len(tokens)
+        pages, covered = [], 0
+        while covered + self.bs <= L - 1:
+            e = self._entries.get(tuple(tokens[:covered + self.bs]))
+            if e is None or not e["full"]:
+                break
+            pages.append(e["page"])
+            self._touch(e)
+            covered += self.bs
+        # a full page whose coverage ends EXACTLY at the prompt end may
+        # still be shared for its first bs-1 tokens (the last prompt token
+        # re-prefills through the engine's CoW copy — identical k/v, but
+        # its logits must be computed for this request's first sample)
+        if covered + self.bs == L:
+            e = self._entries.get(tuple(tokens[:L]))
+            if e is not None and e["full"]:
+                pages.append(e["page"])
+                self._touch(e)
+                covered += self.bs - 1
+        # the partial tail: longest registered prefix of the next page
+        # (an empty range when the edge above already covered L-1)
+        for t in range(min(self.bs - 1, L - 1 - covered), 0, -1):
+            e = self._entries.get(tuple(tokens[:covered + t]))
+            if e is not None and not e["full"]:
+                pages.append(e["page"])
+                self._touch(e)
+                covered += t
+                break
+        if pages:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, covered
+
+    # ------------------------------------------------------------ register
+    def register(self, slot: int, tokens: list) -> int:
+        """Bank ``slot``'s freshly-prefilled prompt pages: one entry per
+        full page plus the partial tail (under all its prefix keys).
+        Already-cached keys are touched, not re-registered — a sharer's
+        own table entries ARE the cached pages for the shared span, so the
+        walk naturally skips them. Returns the number of NEW pages the
+        cache took a ref on."""
+        bt = self.tables
+        L = len(tokens)
+        added = 0
+        for k in range(L // self.bs):
+            key = tuple(tokens[:(k + 1) * self.bs])
+            e = self._entries.get(key)
+            if e is not None:
+                self._touch(e)
+                continue
+            page = int(bt.tables[slot, k])
+            bt.add_ref(page)
+            entry = {"page": page, "full": True, "tick": 0}
+            self._touch(entry)
+            self._entries[key] = entry
+            added += 1
+        rem = L % self.bs
+        if rem:
+            full_key = tuple(tokens[:L])
+            if full_key not in self._entries:
+                page = int(bt.tables[slot, L // self.bs])
+                bt.add_ref(page)
+                entry = {"page": page, "full": False, "tick": 0}
+                self._touch(entry)
+                for t in range(1, rem + 1):
+                    # prefix keys may already belong to an older entry on
+                    # the same chain — first registration wins (both
+                    # contents are valid for that prefix; the outer
+                    # full-coverage guard means t == rem is always new)
+                    key = tuple(tokens[:L - rem + t])
+                    if key not in self._entries:
+                        self._entries[key] = entry
+                added += 1
+        return added
+
+    # ------------------------------------------------------------- reclaim
+    def reclaim(self, n_pages: int) -> int:
+        """Drop least-recently-used cached pages until ``n_pages`` are
+        physically free (or the cache is empty). Evicting a page also
+        evicts every longer chain that extends through it — a child whose
+        parent is gone can never be matched again and would leak its ref.
+        Returns the count of pages physically freed."""
+        freed = 0
+        while self.tables.free_blocks < n_pages and self._entries:
+            # distinct entries, oldest first
+            oldest = min({id(e): e for e in self._entries.values()}.values(),
+                         key=lambda e: e["tick"])
+            roots = sorted((k for k, e in self._entries.items()
+                            if e is oldest), key=len)
+            # phase 1: a key extending any victim key is a descendant —
+            # its whole ENTRY dies (an entry whose page ref is released
+            # must lose every key, or a surviving shorter prefix key
+            # would dangle onto a freed page)
+            dead = {id(oldest): oldest}
+            for key, e in self._entries.items():
+                if any(len(key) >= len(r) and key[:len(r)] == r
+                       for r in roots):
+                    dead[id(e)] = e
+            # phase 2: drop every key of every dead entry, then the refs
+            self._entries = {k: e for k, e in self._entries.items()
+                             if id(e) not in dead}
+            for e in dead.values():
+                n = self.tables.release_page(e["page"])
+                freed += n
+                self.reclaimed_pages += n
+        return freed

@@ -370,7 +370,9 @@ class Speculator:
             engine.stats.setdefault(key, 0)
         samp = (engine.cfg.temperature, engine.cfg.top_k, engine.cfg.top_p)
         model = engine.model
-        donate = (1,) if jax.default_backend() != "cpu" else ()
+        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+
+        tp_axis = TENSOR_AXIS if engine._mesh is not None else None
 
         def verify(params, pages, tables, lens, window, vcounts, seeds,
                    counts):
@@ -380,7 +382,8 @@ class Speculator:
             W = window.shape[1]
             valid = jnp.arange(W)[None, :] < vcounts[:, None]
             logits, pages = model.decode_paged(params, window, pages,
-                                               tables, lens, valid)
+                                               tables, lens, valid,
+                                               tp_axis=tp_axis)
             B, _, V = logits.shape
             # the pinned per-request stream: position s of row b draws
             # with fold_in(key(seed_b), counts_b + s) — exactly the key
@@ -392,7 +395,10 @@ class Speculator:
                                  counts_r.reshape(-1), *samp)
             return draws.reshape(B, W), pages
 
-        self._verify = jax.jit(verify, donate_argnums=donate)
+        # the engine's dispatch wrapper: plain jit at tp=0, shard_map'd
+        # over the serving mesh under TP (ISSUE 13) — the verify window
+        # is just a wider decode tick, so it shards identically
+        self._verify = engine._jit_paged(verify, n_rest=6)
 
     # lifecycle relays from the engine
     def on_admit(self, slot: int, tokens: List[int]) -> None:
@@ -425,9 +431,11 @@ class Speculator:
         # words. The unconditional invariant (pinned): each request's
         # output is a prefix of the other run's, completed requests
         # identical.
+        cow_pairs = []
         for i in list(active):
             s = eng.slots[i]
-            if not tables.grow(i, s.cache_len + 1):
+            if not (eng._grow(i, s.cache_len + 1)
+                    and eng._cow_if_shared(i, s.cache_len, cow_pairs)):
                 eng._maybe_finish(i, completions, overflow=True)
                 active.remove(i)
         if not active:
@@ -435,14 +443,20 @@ class Speculator:
         # Phase 2: drafts claim only the LEFTOVER pool — the token budget
         # caps the window (a slot one token from its budget needs no
         # drafts), then degrade to fewer drafts as grows fail; rejected
-        # tails hand their pages back at commit.
+        # tails hand their pages back at commit. Only the FIRST write
+        # position can sit in a shared page (pages past the prompt are
+        # always private), so phase 1's CoW covers the whole window.
         desired = np.zeros((S,), np.int32)
         for i in active:
             s = eng.slots[i]
             v = max(min(self.k, s.budget - len(s.gen) - 1), 0)
+            # plain tables.grow, NOT eng._grow: a draft page is optional
+            # and rolls back at commit — it must degrade to fewer drafts
+            # under pressure, never evict prefix-cache chains to exist
             while v > 0 and not tables.grow(i, s.cache_len + v + 1):
                 v -= 1
             desired[i] = v
+        eng._flush_cow(cow_pairs)
 
         with jrnl.span("serve/draft", drafter=self.drafter.name,
                        batch=len(active), k=self.k):
@@ -524,6 +538,14 @@ def build_speculator(engine, spec: str,
     if name == "ngram":
         drafter = NGramDrafter(k)
     else:
+        if engine._mesh is not None:
+            raise ValueError(
+                "--speculate draft:<k> does not compose with --serve_tp "
+                "yet: the draft mirror would keep its own unsharded page "
+                "pool on rank 0 and steal page-pool HBM from the sharded "
+                "target (ROADMAP item 3 residual); use ngram:<k> — the "
+                "host-side drafter needs no device state — or serve "
+                "without TP")
         if draft_model is None:
             raise ValueError(
                 "--speculate draft:<k> needs a draft model "

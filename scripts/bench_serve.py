@@ -26,6 +26,18 @@ ROADMAP item 4):
   markers recomputed live (greedy speculative == plain paged decode;
   sampled speculative == the same per-request PRNG stream). Judged by
   check_evidence's ``speculative`` stage (runbook stage 5j).
+- **tp_serving section** (ISSUE 13) — TP-degree rows (tokens/s/CHIP at
+  each measured tp with p50/p99 tick latency: the per-chip number is the
+  honest one — tp divides HBM per chip, not free throughput) and the
+  shared-prefix memory leg: a 256-request shared-system-prompt workload
+  drained through the prefix-cache engine vs the unshared engine,
+  ``prefix_mem_ratio`` = physical pages allocated ÷ the unshared run's
+  allocations (MEASURED, both runs, not derived). Identity markers
+  recomputed live: tp=1 == unsharded, tp>1 == unsharded, and
+  shared == unshared for greedy / sampled / speculative decode. Judged
+  by check_evidence's ``tp_serving`` stage (runbook stage 5k). The tp>1
+  markers/rows need ≥2 devices — on CPU run under
+  ``DLION_PLATFORM=cpu8`` (the bench honors it via force_cpu_platform).
 
 CPU-produced artifacts are first-class smoke evidence (tiny model — the
 engine mechanism, not chip throughput); ``meta.backend`` records what
@@ -33,6 +45,7 @@ measured it, and the runbook re-captures on chip at gpt2_124m.
 
     python scripts/bench_serve.py --out runs/serving
     python scripts/bench_serve.py --batches 32 --ticks 10   # quick look
+    DLION_PLATFORM=cpu8 python scripts/bench_serve.py --out runs/serving
 """
 
 from __future__ import annotations
@@ -85,14 +98,17 @@ def _serve_model(model_name: str, family: str):
 def _build(model_name: str, family: str, quant: str, max_seqs: int,
            block_size: int, max_blocks_per_seq: int,
            prefill_cap: int = 1 << 30, temperature: float = 0.0,
-           top_k=None, speculate: str = ""):
+           top_k=None, speculate: str = "", tp: int = 0,
+           prefix_cache: bool = False, num_blocks: int = 0):
     from distributed_lion_tpu.serve.engine import ServeConfig, ServingEngine
 
     model, params, cfg = _serve_model(model_name, family)
     scfg = ServeConfig(max_seqs=max_seqs, block_size=block_size,
                        max_blocks_per_seq=max_blocks_per_seq,
+                       num_blocks=num_blocks,
                        prefill_cap_tokens=prefill_cap,
                        temperature=temperature, top_k=top_k, quant=quant,
+                       tp=tp, prefix_cache=prefix_cache,
                        speculate=speculate)
     draft = model if speculate.startswith("draft") else None
     return ServingEngine(model, scfg, draft_model=draft), params, cfg
@@ -356,7 +372,165 @@ def bit_identity_markers(family: str) -> dict:
             "batched_vs_solo": bool(ok)}
 
 
+def _feasible_tps(family, cfg, requested) -> list:
+    """Filter the requested TP degrees to ones this backend/model can
+    actually run (enough devices, heads/kv-heads/d_ff divide) — dropped
+    degrees are reported, never silently skipped (no-silent-caps)."""
+    import jax
+
+    from distributed_lion_tpu.parallel.tensor_parallel import validate_tp
+
+    n_dev = len(jax.devices())
+    out, dropped = [], []
+    for t in requested:
+        try:
+            if t > n_dev:
+                raise ValueError(f"{t} > {n_dev} devices")
+            if t >= 1:
+                validate_tp(cfg, t, family)
+                kv = cfg.n_head if family == "gpt2" else cfg.n_kv_head
+                if kv % t:
+                    raise ValueError(f"kv heads {kv} % {t}")
+            out.append(t)
+        except ValueError as e:
+            dropped.append((t, str(e)))
+    for t, why in dropped:
+        print(json.dumps({"dropped_tp_degree": t, "why": why},
+                         allow_nan=False), flush=True)
+    return out
+
+
+def bench_tp_serving(model_name: str, family: str, quant: str,
+                     block_size: int, ticks: int, warmup: int,
+                     batch: int, tps, prefix_requests: int) -> dict:
+    """The ISSUE 13 evidence: TP-degree decode rows (tokens/s/CHIP +
+    p50/p99 tick latency), the shared-prefix memory leg (physical ÷
+    logical pages, both MEASURED by draining the same workload through
+    the shared and unshared engines), and the five live-recomputed
+    identity markers (tiny model — identity is backend-independent)."""
+    import numpy as np
+
+    from distributed_lion_tpu.serve.engine import Request
+
+    model, _, cfg = _serve_model(model_name, family)
+
+    # ---- TP rows: full-occupancy timed decode ticks per degree
+    rows = []
+    for tp in _feasible_tps(family, cfg, tps):
+        need = PROMPT_LEN + warmup + ticks + 2
+        nblocks = -(-need // block_size)
+        eng, _, _ = _build(model_name, family, quant, batch, block_size,
+                           nblocks, tp=tp)
+        for i, toks in enumerate(_prompts(batch, cfg.vocab_size)):
+            eng.submit(Request(req_id=i, tokens=toks, max_new_tokens=need,
+                               seed=i))
+        while eng.pending:
+            eng.step()
+        assert all(s is not None for s in eng.slots), "slots did not fill"
+        for _ in range(warmup):
+            eng.step()
+        tick_ms = []
+        for _ in range(ticks):
+            t0 = time.perf_counter()
+            eng.step()  # host-syncs its token batch: fully retired
+            tick_ms.append((time.perf_counter() - t0) * 1e3)
+        total_s = sum(tick_ms) / 1e3
+        chips = max(tp, 1)
+        row = {
+            "tp": tp, "batch": batch, "decode_ticks": ticks,
+            "ms_per_tick_p50": round(float(np.percentile(tick_ms, 50)), 4),
+            "ms_per_tick_p99": round(float(np.percentile(tick_ms, 99)), 4),
+            "tokens_per_sec_per_chip": round(
+                batch * ticks / total_s / chips, 2),
+        }
+        rows.append(row)
+        print(json.dumps(row, allow_nan=False), flush=True)
+
+    # ---- shared-prefix memory leg: 256 requests, one system prompt
+    rng = np.random.default_rng(31)
+    prompt_len = 132  # NOT page-aligned at the default block 16: the
+    #                   partial boundary page exercises real CoW
+    horizon = model.max_positions or 1 << 30
+    prompt_len = min(prompt_len, (horizon // block_size) * block_size - 12)
+    gen = 8
+    sys_prompt = list(map(int, rng.integers(1, cfg.vocab_size, prompt_len)))
+    reqs = [Request(req_id=i, tokens=list(sys_prompt), max_new_tokens=gen,
+                    seed=i) for i in range(prefix_requests)]
+    bps = -(-(prompt_len + gen + 1) // block_size)
+    geom = dict(max_seqs=32, block_size=block_size, max_blocks_per_seq=bps)
+
+    def drain(prefix_cache):
+        eng, _, _ = _build(model_name, family, quant,
+                           prefix_cache=prefix_cache, **geom)
+        t0 = time.perf_counter()
+        eng.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                         r.seed) for r in reqs])
+        dt = time.perf_counter() - t0
+        return eng, dt
+
+    unshared, dt_u = drain(False)
+    shared, dt_s = drain(True)
+    logical = unshared.tables.pages_allocated
+    physical = shared.tables.pages_allocated
+    prefix = {
+        "requests": prefix_requests,
+        "prompt_len": prompt_len,
+        "max_new_tokens": gen,
+        "logical_pages": int(logical),
+        "physical_pages": int(physical),
+        "prefix_mem_ratio": round(physical / logical, 4),
+        "prefix_hits": int(shared.stats["prefix_hits"]),
+        "cow_copies": int(shared.stats["cow_copies"]),
+        "tokens_per_sec_shared": round(
+            shared.stats["decode_tokens"] / dt_s, 2),
+        "tokens_per_sec_unshared": round(
+            unshared.stats["decode_tokens"] / dt_u, 2),
+    }
+    print(json.dumps(prefix, allow_nan=False), flush=True)
+
+    # ---- identity markers, recomputed live on the tiny model (identity
+    # is backend/scale-independent; the tiny model keeps capture cheap)
+    def outputs(ident_kw, samp=None):
+        eng, _, tcfg = _build("tiny", family, "none", 6, 4, 16,
+                              num_blocks=128, **(ident_kw or {}),
+                              **(samp or {}))
+        trng = np.random.default_rng(17)
+        sysp = list(map(int, trng.integers(1, tcfg.vocab_size, 13)))
+        prompts = [sysp + list(map(int, trng.integers(1, tcfg.vocab_size,
+                                                      3)))
+                   for _ in range(4)] + [list(sysp)] * 2
+        done = eng.run([Request(req_id=i, tokens=list(t), max_new_tokens=8,
+                                seed=i) for i, t in enumerate(prompts)])
+        return {r: c.tokens for r, c in done.items()}
+
+    plain = outputs({})
+    tiny_cfg = _serve_model("tiny", family)[2]
+    tpn = max(_feasible_tps(family, tiny_cfg, [4, 2]) or [0])
+    sampled = dict(temperature=0.9, top_k=40)
+    markers = {
+        "tp1_vs_unsharded": outputs({"tp": 1}) == plain,
+        "tpN_vs_unsharded": (tpn >= 2
+                             and outputs({"tp": tpn}) == plain),
+        "shared_vs_unshared_greedy":
+            outputs({"prefix_cache": True}) == plain,
+        "shared_vs_unshared_sampled":
+            outputs({"prefix_cache": True}, sampled)
+            == outputs({}, sampled),
+        "shared_vs_unshared_speculative":
+            outputs({"prefix_cache": True, "speculate": "ngram:4"})
+            == plain,
+    }
+    markers = {k: bool(v) for k, v in markers.items()}
+    return {"markers": markers, "tp_degree_max_measured": int(tpn),
+            "rows": rows, "prefix": prefix}
+
+
 def main() -> int:
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
+
+    force_cpu_platform()  # DLION_PLATFORM=cpu8 → 8 virtual devices for
+    #                       the TP legs (must run before first device use)
+
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=os.path.join(REPO, "runs", "serving"))
     ap.add_argument("--model", default=None,
@@ -377,6 +551,13 @@ def main() -> int:
                          "drafter x k x workload engines)")
     ap.add_argument("--spec_ks", default="2,4",
                     help="draft lengths measured per drafter")
+    ap.add_argument("--tps", default="1,2,4",
+                    help="TP degrees for the tp_serving rows (degrees the "
+                         "backend/model can't run are dropped LOUDLY)")
+    ap.add_argument("--tp_batch", type=int, default=32,
+                    help="decode batch of the TP rows")
+    ap.add_argument("--prefix_requests", type=int, default=256,
+                    help="requests in the shared-system-prompt memory leg")
     args = ap.parse_args()
 
     import jax
@@ -416,6 +597,10 @@ def main() -> int:
                              args.spec_batch,
                              tuple(int(k) for k in args.spec_ks.split(",")
                                    if k))
+    tp_serving = bench_tp_serving(
+        model_name, args.family, args.quant, args.block_size, args.ticks,
+        args.warmup, args.tp_batch,
+        [int(t) for t in args.tps.split(",") if t], args.prefix_requests)
 
     doc = {
         "meta": {
@@ -434,6 +619,7 @@ def main() -> int:
         "prefill_share": share_rows,
         "bit_identity": bits,
         "speculative": spec,
+        "tp_serving": tp_serving,
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "serving.json")
@@ -445,10 +631,15 @@ def main() -> int:
     print(json.dumps({"artifact": path, **bits,
                       **{f"spec_{k}": v
                          for k, v in spec["markers"].items()},
+                      **{f"tp_{k}": v
+                         for k, v in tp_serving["markers"].items()},
+                      "prefix_mem_ratio":
+                          tp_serving["prefix"]["prefix_mem_ratio"],
                       "best_tokens_per_sec_per_chip": max(
                           r["tokens_per_sec_per_chip"] for r in decode_rows)},
                      allow_nan=False), flush=True)
-    return 0 if all(bits.values()) and all(spec["markers"].values()) else 1
+    return 0 if (all(bits.values()) and all(spec["markers"].values())
+                 and all(tp_serving["markers"].values())) else 1
 
 
 if __name__ == "__main__":

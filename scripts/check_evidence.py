@@ -16,6 +16,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py dcn_overlap    # pipelined hier DCN leg
     python scripts/check_evidence.py serving        # paged-KV decode bench
     python scripts/check_evidence.py speculative    # draft/verify/commit
+    python scripts/check_evidence.py tp_serving     # TP decode + prefix share
     python scripts/check_evidence.py elasticity     # live worker leave/join
     python scripts/check_evidence.py all
 
@@ -665,6 +666,63 @@ def speculative_ok(path: str = SERVE_ARTIFACT) -> bool:
                and r.get("accept_rate", 0) > 0 for r in rows)
 
 
+# the tp_serving stage (ISSUE 13): the TP-sharded + prefix-sharing
+# section of the SAME serving.json artifact (bench_serve writes it;
+# runbook stage 5k re-captures on chip) — (a) the whole artifact passes
+# the strict schema (validate_metrics: TP rows + prefix leg per-row
+# validated), (b) ALL FIVE live-recomputed identity markers hold (tp=1
+# sharded == unsharded, tp>1 == unsharded on the measuring mesh, and
+# shared-prefix == unshared for greedy/sampled/speculative decode —
+# sharding and sharing may only change HBM and speed, never an output),
+# (c) a TP row at degree >= 2 exists (the section is about multi-chip
+# serving; on CPU the bench runs under DLION_PLATFORM=cpu8) with
+# tokens/s/chip above the same floor the serving stage uses at every
+# measured degree, and (d) the shared-system-prompt workload actually
+# demonstrates the memory story: >= 256 requests and
+# prefix_mem_ratio <= TP_SERVE_MEM_RATIO (physical ÷ logical pages,
+# both MEASURED by draining the workload through both engines).
+TP_SERVE_MEM_RATIO = 0.15
+TP_SERVE_MIN_REQUESTS = 256
+
+
+def tp_serving_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    sec = doc.get("tp_serving")
+    if not isinstance(sec, dict):
+        return False
+    marks = sec.get("markers", {})
+    for k in ("tp1_vs_unsharded", "tpN_vs_unsharded",
+              "shared_vs_unshared_greedy", "shared_vs_unshared_sampled",
+              "shared_vs_unshared_speculative"):
+        if marks.get(k) is not True:
+            return False
+    rows = sec.get("rows", [])
+    if not any(r.get("tp", 0) >= 2 for r in rows):
+        return False  # no multi-chip measurement: the section's point
+    for r in rows:
+        if not isinstance(r.get("tokens_per_sec_per_chip"), (int, float)):
+            return False
+        if r["tokens_per_sec_per_chip"] < SERVE_MIN_TOKS:
+            return False
+    pref = sec.get("prefix", {})
+    if pref.get("requests", 0) < TP_SERVE_MIN_REQUESTS:
+        return False
+    ratio = pref.get("prefix_mem_ratio")
+    if not isinstance(ratio, (int, float)) or ratio > TP_SERVE_MEM_RATIO:
+        return False
+    return True
+
+
 # the live-elasticity stage (ISSUE 10): scripts/bench_elasticity.py's
 # artifact under runs/elasticity — (a) passes the strict elasticity.json
 # schema (validate_metrics, loaded by FILE PATH so this script stays
@@ -751,6 +809,7 @@ STAGES = [
     ("dcn_overlap", dcn_overlap_ok),
     ("serving", serving_ok),
     ("speculative", speculative_ok),
+    ("tp_serving", tp_serving_ok),
     ("elasticity", elasticity_ok),
 ]
 
@@ -822,6 +881,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return serving_ok(arg or SERVE_ARTIFACT)
     if what == "speculative":
         return speculative_ok(arg or SERVE_ARTIFACT)
+    if what == "tp_serving":
+        return tp_serving_ok(arg or SERVE_ARTIFACT)
     if what == "elasticity":
         return elasticity_ok(arg or ELASTICITY_ARTIFACT)
     if what == "all":

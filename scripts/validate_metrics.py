@@ -38,8 +38,9 @@ the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
 rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
-bit-identity/speculative-frontier document, per-row validated the same
-way incl. accept_rate ∈ [0,1] on every frontier row), and the
+bit-identity/speculative-frontier/tp_serving document, per-row validated
+the same way incl. accept_rate ∈ [0,1] on every frontier row and the
+TP-degree + shared-prefix rows of the ISSUE 13 section), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
 document; timeline rows are strict-validated per row).
@@ -200,7 +201,7 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
     decode; sampled speculative == the same per-request PRNG stream)."""
     errors = []
     for key in ("meta", "decode", "prefill_share", "bit_identity",
-                "speculative"):
+                "speculative", "tp_serving"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -273,6 +274,55 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
             if not (_finite_number(ar) and 0.0 <= ar <= 1.0):
                 errors.append(f"{where}.accept_rate must be a finite "
                               "number in [0, 1]")
+    tps = doc.get("tp_serving")
+    if tps is not None and not isinstance(tps, dict):
+        errors.append(f"{path}: 'tp_serving' must be an object")
+    elif isinstance(tps, dict):
+        marks = tps.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: tp_serving.markers must be an object")
+        else:
+            for k in ("tp1_vs_unsharded", "tpN_vs_unsharded",
+                      "shared_vs_unshared_greedy",
+                      "shared_vs_unshared_sampled",
+                      "shared_vs_unshared_speculative"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(
+                        f"{path}: tp_serving.markers.{k} must be a bool")
+        rows = tps.get("rows")
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: tp_serving.rows must be a non-empty "
+                          "list")
+            rows = []
+        for i, row in enumerate(rows):
+            where = f"{path}: tp_serving.rows[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where} is not an object")
+                continue
+            for k in ("tp", "batch", "decode_ticks"):
+                if not (isinstance(row.get(k), int)
+                        and not isinstance(row.get(k), bool)
+                        and row[k] >= 0):
+                    errors.append(f"{where}.{k} must be a non-negative int")
+            for k in ("ms_per_tick_p50", "ms_per_tick_p99",
+                      "tokens_per_sec_per_chip"):
+                if not _finite_number(row.get(k)):
+                    errors.append(f"{where}.{k} is not finite")
+        pref = tps.get("prefix")
+        if not isinstance(pref, dict):
+            errors.append(f"{path}: tp_serving.prefix must be an object")
+        else:
+            for k in ("requests", "prompt_len", "logical_pages",
+                      "physical_pages", "prefix_hits", "cow_copies"):
+                if not (isinstance(pref.get(k), int)
+                        and not isinstance(pref.get(k), bool)
+                        and pref[k] >= 0):
+                    errors.append(f"{path}: tp_serving.prefix.{k} must be "
+                                  "a non-negative int")
+            ratio = pref.get("prefix_mem_ratio")
+            if not (_finite_number(ratio) and ratio > 0):
+                errors.append(f"{path}: tp_serving.prefix.prefix_mem_ratio "
+                              "must be a finite positive number")
     return errors
 
 

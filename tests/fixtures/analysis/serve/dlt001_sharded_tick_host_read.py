@@ -1,0 +1,29 @@
+"""Fixture: the TP serving engine's forbidden shape — PER-TOKEN host
+reads inside the SHARD_MAP'd decode tick. Under tensor parallelism the
+cost is worse than the single-device version of this pitfall
+(fixtures/analysis/serve/dlt001_decode_tick_host_read.py): an
+`int(token)` inside the sharded tick forces every rank of the slice to
+round-trip the host per generated token, serializing the whole mesh, not
+just one chip. The real engine (serve/engine._jit_paged) keeps the one
+host read per tick at the dispatch boundary, outside traced scope.
+Never imported; parsed by graft-check's tier-1 tests."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.shard_map, mesh=None, in_specs=None, out_specs=None)
+def sharded_decode_tick(params, pages, tables, lens, last_tok):
+    logits = (params["w"] * last_tok[:, None]).sum(-1)
+    tok = jnp.argmax(logits, axis=-1)
+    first = int(tok[0])           # DLT001: per-token host read in the tick
+    if float(logits.max()) > 0:   # DLT001: host-side branch on device data
+        lens = lens + 1
+    return tok, first, lens
+
+
+def host_tick_loop(engine, toks):
+    # NOT traced scope: one whole-batch token-array read per dispatch is
+    # the engine's documented sync point — identical at any tp degree
+    return [int(t) for t in toks]

@@ -1,0 +1,27 @@
+"""Fixture: DLT005 in serve-layer SHARDING code — hardcoded mesh-axis
+string literals where the parallel.mesh constants belong. The TP serving
+engine (serve/engine.py) threads TENSOR_AXIS from parallel/mesh through
+its shard_map specs and psum exits; a literal "tensor" here silently
+decouples from the mesh axis-naming convention (rename the axis once and
+the serve path keeps compiling against a ghost name). Never imported;
+parsed by graft-check's tier-1 tests (tests/test_analysis_lint.py)."""
+from functools import partial
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def pages_spec(n_layer):
+    # DLT005: the page pool's kv-head axis named by a raw string literal
+    spec = P(None, None, "tensor", None)
+    return [{"k": spec, "v": spec} for _ in range(n_layer)]
+
+
+def sharded_decode_tick(mesh, fn, param_specs, pages_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=(param_specs, pages_specs),
+                         out_specs=P("tensor"),      # DLT005
+                         check_vma=False)
+
+
+def tp_degree(axis_name="tensor"):                   # DLT005: literal default
+    return axis_name

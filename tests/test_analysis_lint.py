@@ -247,6 +247,32 @@ def test_serve_fixture_and_serve_modules_clean():
         assert lint.lint_file(path) == [], rel
 
 
+def test_tp_serve_fixtures_and_serve_parallel_modules_clean():
+    """ISSUE 13 satellite: TP serving code must (a) never hardcode a
+    mesh-axis string literal — the engine threads parallel.mesh's
+    TENSOR_AXIS through its shard_map specs and the models' psum exits
+    (DLT005 fires 3× on the fixture showing the forbidden shape), and
+    (b) never host-read per token inside the SHARD_MAP'd decode tick —
+    worse than the single-device pitfall, it serializes the whole slice
+    (DLT001 fires 2× on its fixture). Every module under serve/ and
+    parallel/ lints zero-finding by file path."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt005_tp_axis_literal.py"))
+    assert [f.rule for f in findings] == ["DLT005"] * 3, (
+        [str(f) for f in findings])
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt001_sharded_tick_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for sub in ("serve", "parallel"):
+        base = os.path.join(PKG, sub)
+        for name in sorted(os.listdir(base)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(base, name)
+            assert lint.lint_file(path) == [], f"{sub}/{name}"
+
+
 def test_speculate_fixture_and_module_clean():
     """ISSUE 11 satellite: the speculative verify dispatch must never
     host-read per DRAFT token — an `int(accept[i])` acceptance branch

@@ -489,3 +489,66 @@ def test_speculative_journal_spans(tmp_path):
         j.close()
     names = {r["name"] for r in j.tail() if r["kind"] == "span"}
     assert {"serve/draft", "serve/verify", "serve/commit"} <= names
+
+
+# -------------------------------------------- prefix sharing (ISSUE 13)
+def test_speculative_rollback_over_shared_pages_pinned():
+    """Speculation × prefix sharing: requests sharing a cached prompt
+    prefix draft/verify/commit with rollback shrinking REFS, never
+    freeing pages a neighbor or the cache still holds — outputs pinned
+    to the plain unshared engine (greedy AND sampled) and the pool
+    conserved after the workload drains."""
+    vocab = _cached_model("gpt2").cfg.vocab_size
+    rng = np.random.default_rng(23)
+    motif = list(map(int, rng.integers(1, vocab, 4)))
+    sys_p = motif * 3                       # 12 tokens: repetitive AND shared
+    prompts = [sys_p + list(map(int, rng.integers(1, vocab, 2)))
+               for _ in range(4)] + [list(sys_p)]
+    reqs = [Request(req_id=f"r{i}", tokens=list(p), max_new_tokens=8,
+                    seed=i) for i, p in enumerate(prompts)]
+    for samp in (dict(temperature=0.0), dict(temperature=0.9, top_k=40)):
+        plain = _run(_engine("gpt2", num_blocks=96, max_blocks_per_seq=16,
+                             **samp),
+                     [Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                              r.seed) for r in reqs])
+        eng = _engine("gpt2", num_blocks=96, max_blocks_per_seq=16,
+                      prefix_cache=True, speculate="ngram:4", **samp)
+        out = _run(eng, [Request(r.req_id, list(r.tokens),
+                                 r.max_new_tokens, r.seed) for r in reqs])
+        for r in reqs:
+            assert out[r.req_id].tokens == plain[r.req_id].tokens, r.req_id
+            assert out[r.req_id].reason == plain[r.req_id].reason
+        assert eng.stats["spec_rounds"] > 0
+        assert eng.stats["prefix_hits"] > 0
+        # rollback + eviction left the pool conserved: every live ref is
+        # the cache's, free + physical == pool, and no slot holds pages
+        assert all(s is None for s in eng.slots)
+        assert (eng.tables.physical_pages + eng.tables.free_blocks
+                == eng.tables.num_blocks)
+        assert int(eng.tables.refs.sum()) == eng.tables.physical_pages
+
+
+def test_speculative_shared_partial_accept_state_matches_unshared():
+    """A partial accept over a table row whose PREFIX pages are shared:
+    shrink hands back only the private tail pages (the shared run's refs
+    are untouched), leaving len/last/table state equal to the unshared
+    engine's on the same stream."""
+    vocab = _cached_model("gpt2").cfg.vocab_size
+    rng = np.random.default_rng(29)
+    sys_p = list(map(int, rng.integers(1, vocab, 9)))
+    reqs = [Request(req_id=f"r{i}", tokens=sys_p + [int(t)],
+                    max_new_tokens=6, seed=i)
+            for i, t in enumerate(rng.integers(1, vocab, 3))]
+    plain_eng = _engine("gpt2", num_blocks=96, max_blocks_per_seq=16)
+    plain = _run(plain_eng, [Request(r.req_id, list(r.tokens),
+                                     r.max_new_tokens, r.seed)
+                             for r in reqs])
+    eng = _engine("gpt2", num_blocks=96, max_blocks_per_seq=16,
+                  prefix_cache=True, speculate="ngram:2")
+    out = _run(eng, [Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                             r.seed) for r in reqs])
+    for r in reqs:
+        assert out[r.req_id].tokens == plain[r.req_id].tokens, r.req_id
+    # the cached run survived every rollback/evict cycle intact
+    run, covered = eng.prefix.match(sys_p + [int(vocab - 1)])
+    assert covered >= 8 and len(run) >= 2

@@ -1,0 +1,246 @@
+"""TP-sharded serving (ISSUE 13): the tp=1 sharded engine pinned
+BIT-identical to the single-device engine (token streams AND the raw page
+pools, bytewise), tp>1 pinned token-identical on the CPU mesh, quantized
+TP serving, page-pool sharding, and the loud refusals (indivisible heads,
+missing devices, draft-model speculation under TP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+from distributed_lion_tpu.models.llama import LlamaConfig, llama_init
+from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+from distributed_lion_tpu.serve.engine import (
+    Request,
+    ServeConfig,
+    ServeModel,
+    ServingEngine,
+)
+
+
+def _gpt2():
+    cfg = GPT2Config.tiny()
+    return cfg, gpt2_init(jax.random.key(0), cfg)
+
+
+def _requests(vocab, n=4, max_new=8, lens=(3, 9, 5, 14, 2)):
+    rng = np.random.default_rng(7)
+    return [Request(req_id=i,
+                    tokens=list(map(int, rng.integers(1, vocab, L))),
+                    max_new_tokens=max_new, seed=i)
+            for i, L in enumerate(lens[:n])]
+
+
+def _engine(params, cfg, family="gpt2", **kw):
+    base = dict(max_seqs=4, block_size=4, max_blocks_per_seq=8)
+    base.update(kw)
+    model = (ServeModel.for_gpt2(params, cfg) if family == "gpt2"
+             else ServeModel.for_llama(params, cfg))
+    return ServingEngine(model, ServeConfig(**base))
+
+
+# ------------------------------------------------------- tp=1: bitwise pin
+def test_tp1_bit_identical_to_single_device():
+    """The sharded program on a 1-mesh IS the single-device engine: same
+    token streams AND bytewise-equal page pools after the same workload —
+    the psum over a size-1 axis is the identity and nothing else differs."""
+    cfg, params = _gpt2()
+    reqs = _requests(cfg.vocab_size)
+    e0 = _engine(params, cfg)
+    e1 = _engine(params, cfg, tp=1)
+    out0 = e0.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                           r.seed) for r in reqs])
+    out1 = e1.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                           r.seed) for r in reqs])
+    for r in reqs:
+        assert out1[r.req_id].tokens == out0[r.req_id].tokens, r.req_id
+        assert out1[r.req_id].reason == out0[r.req_id].reason
+    # the strong form: every k/v byte the two engines ever scattered
+    for l0, l1 in zip(e0.pages, e1.pages):
+        for k in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(l0[k]),
+                                          np.asarray(l1[k]))
+
+
+# ----------------------------------------------------- tp>1: token identity
+@pytest.mark.parametrize("tp", [2, 4])
+@pytest.mark.parametrize("sampling", ["greedy", "stochastic"])
+def test_tp_matches_single_device(tp, sampling):
+    """tp>1 divides the head dimension across the CPU mesh; the partial
+    row-parallel sums reduce in a different order than one device's
+    matmul, so the pin is the engine-level one every serving claim uses:
+    identical emitted token streams, greedy AND sampled."""
+    cfg, params = _gpt2()
+    samp = (dict(temperature=0.0) if sampling == "greedy"
+            else dict(temperature=0.9, top_k=40))
+    reqs = _requests(cfg.vocab_size, n=5)
+    base = _engine(params, cfg, **samp).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    got = _engine(params, cfg, tp=tp, **samp).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    for r in reqs:
+        assert got[r.req_id].tokens == base[r.req_id].tokens, r.req_id
+
+
+def test_llama_tp2_matches_single_device():
+    """GQA: tiny llama has 4 query / 2 kv heads — tp=2 leaves one kv head
+    per rank in the page-pool shard and the repeat factor intact."""
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.key(0), cfg)
+    reqs = _requests(cfg.vocab_size, n=3, lens=(3, 7, 11))
+    base = _engine(params, cfg, family="llama").run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    got = _engine(params, cfg, family="llama", tp=2).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    for r in reqs:
+        assert got[r.req_id].tokens == base[r.req_id].tokens, r.req_id
+
+
+# -------------------------------------------------------- sharded layouts
+def test_tp_pages_and_params_sharded():
+    cfg, params = _gpt2()
+    eng = _engine(params, cfg, tp=2)
+    assert eng.pages[0]["k"].sharding.spec == P(None, None, TENSOR_AXIS,
+                                                None)
+    qkv = eng.params["blocks"][0]["attn"]["qkv"]
+    assert qkv.sharding.spec == P(None, None, TENSOR_AXIS)
+    # replicated leaves really are replicated (embeddings, norms)
+    assert eng.params["wte"].sharding.spec == P()
+    # host-side tables stay plain numpy — allocation never recompiles
+    assert isinstance(eng.tables.tables, np.ndarray)
+
+
+def test_nf4_tp2_matches_nf4_single_device():
+    """Quantized leaves shard with the SAME specs as their dense twins
+    (shaped layout, ops/quant) — NF4 serving composes with TP and the
+    outputs match the single-device NF4 engine."""
+    cfg, params = _gpt2()
+    reqs = _requests(cfg.vocab_size, n=3)
+    kw = dict(quant="nf4", quant_block=16)
+    base = _engine(params, cfg, **kw).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    eng = _engine(params, cfg, tp=2, **kw)
+    got = eng.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                           r.seed) for r in reqs])
+    for r in reqs:
+        assert got[r.req_id].tokens == base[r.req_id].tokens, r.req_id
+    from distributed_lion_tpu.ops.quant import QuantizedTensor
+
+    assert isinstance(eng.params["blocks"][0]["attn"]["qkv"],
+                      QuantizedTensor)
+
+
+# --------------------------------------------------------------- refusals
+def test_tp_refuses_indivisible_heads():
+    cfg, params = _gpt2()  # 4 heads
+    with pytest.raises(ValueError, match="divisible"):
+        _engine(params, cfg, tp=3)
+
+
+def test_tp_refuses_more_ranks_than_devices():
+    cfg, params = _gpt2()
+    # conftest provides 8 virtual CPU devices; 8 does not divide 4 heads,
+    # so ask for a divisor of the heads that still exceeds the devices
+    cfg16 = GPT2Config.tiny(n_head=16, d_model=256)
+    params16 = gpt2_init(jax.random.key(0), cfg16)
+    with pytest.raises(ValueError, match="devices"):
+        _engine(params16, cfg16, tp=16)
+    del params
+
+
+def test_tp_quant_block_that_cannot_shard_is_refused():
+    cfg, params = _gpt2()  # d_model 64: one 64-element block per last dim
+    with pytest.raises(ValueError, match="quant"):
+        _engine(params, cfg, tp=2, quant="nf4")
+
+
+def test_tp_refuses_draft_model_speculation():
+    cfg, params = _gpt2()
+    model = ServeModel.for_gpt2(params, cfg)
+    draft = ServeModel.for_gpt2(params, cfg)
+    with pytest.raises(ValueError, match="serve_tp"):
+        ServingEngine(model, ServeConfig(max_seqs=2, block_size=4,
+                                         max_blocks_per_seq=8, tp=2,
+                                         speculate="draft:2"),
+                      draft_model=draft)
+
+
+# ------------------------------------------------------------ composition
+def test_tp_speculative_ngram_matches_plain():
+    """ngram speculation under TP: the verify window is just a wider
+    decode tick and shards identically — outputs pinned to the plain
+    single-device engine (the stream is the acceptance rule)."""
+    cfg, params = _gpt2()
+    rng = np.random.default_rng(3)
+    motif = list(map(int, rng.integers(1, cfg.vocab_size, 4)))
+    prompts = [motif * 4 for _ in range(3)]
+    reqs = [Request(req_id=i, tokens=list(t), max_new_tokens=10, seed=i)
+            for i, t in enumerate(prompts)]
+    base = _engine(params, cfg, max_blocks_per_seq=16).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    eng = _engine(params, cfg, max_blocks_per_seq=16, tp=2,
+                  speculate="ngram:4")
+    got = eng.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                           r.seed) for r in reqs])
+    for r in reqs:
+        assert got[r.req_id].tokens == base[r.req_id].tokens, r.req_id
+    assert eng.stats["spec_accepted"] > 0  # the drafter actually earned
+
+
+def test_tp_prefix_cache_composes():
+    """TP × prefix sharing: the two levers multiply — sharded pools,
+    shared pages, outputs still pinned to the plain engine."""
+    cfg, params = _gpt2()
+    rng = np.random.default_rng(5)
+    sys_p = list(map(int, rng.integers(1, cfg.vocab_size, 13)))
+    prompts = [sys_p + list(map(int, rng.integers(1, cfg.vocab_size, 3)))
+               for _ in range(5)]
+    reqs = [Request(req_id=i, tokens=list(t), max_new_tokens=6, seed=i)
+            for i, t in enumerate(prompts)]
+    base = _engine(params, cfg, num_blocks=64).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    eng = _engine(params, cfg, num_blocks=64, tp=2, prefix_cache=True)
+    got = eng.run([Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                           r.seed) for r in reqs])
+    for r in reqs:
+        assert got[r.req_id].tokens == base[r.req_id].tokens, r.req_id
+    assert eng.stats["prefix_hits"] > 0
+
+
+def test_tp_one_decode_dispatch_per_tick():
+    """The sharded tick is still ONE dispatch advancing every slot — the
+    host's per-tick work stays table math + one token-array read."""
+    cfg, params = _gpt2()
+    eng = _engine(params, cfg, tp=2)
+    for r in _requests(cfg.vocab_size, n=3, max_new=4):
+        eng.submit(r)
+    eng.step()  # admissions + first decode tick
+    t0 = eng.stats["decode_ticks"]
+    eng.step()
+    assert eng.stats["decode_ticks"] == t0 + 1
+
+
+def test_tp_serve_config_survives_jit_cache():
+    """Two engines at different tp degrees coexist (separate meshes and
+    compiled programs) — outputs of each still match the baseline."""
+    cfg, params = _gpt2()
+    reqs = _requests(cfg.vocab_size, n=2)
+    base = _engine(params, cfg).run(
+        [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+         for r in reqs])
+    for tp in (1, 2):
+        got = _engine(params, cfg, tp=tp).run(
+            [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+             for r in reqs])
+        for r in reqs:
+            assert got[r.req_id].tokens == base[r.req_id].tokens
