@@ -319,6 +319,43 @@ def membership_timeline(events: list,
     return rows
 
 
+# serve-side replica lifecycle events (serve/replica_plane.py): the
+# fleet's replica leave/drain/slow/rejoin transitions plus per-request
+# migration records — the serving twin of MEMBERSHIP_EVENTS, surfaced as
+# its own timeline beside the membership one (a serve journal and a train
+# journal never mix ranks, but one analyzer reads both).
+REPLICA_EVENTS = ("replica_left", "replica_rejoined", "replica_draining",
+                  "replica_slow", "request_migrated", "request_failed",
+                  "request_timeout")
+
+
+def replica_timeline(events: list, rank: Optional[int] = None) -> list:
+    """Chronological replica lifecycle + request-migration timeline from
+    the fleet's journal events — a crash, the migrations it caused, and
+    the rejoin that restored capacity read off one report, the way the
+    membership timeline reads for training workers."""
+    rows, seen = [], set()
+    for r in events:
+        if r.get("kind") != "event" or r.get("name") not in REPLICA_EVENTS:
+            continue
+        if rank is not None and r.get("rank") != rank:
+            continue
+        row = {"event": r["name"]}
+        for k in ("tick", "replica", "req_id", "from_replica", "to_replica",
+                  "cause", "attempt", "attempts", "committed", "residents",
+                  "latency_ticks", "alive", "world"):
+            if k in r:
+                row[k] = r[k]
+        key = tuple(sorted(row.items()))
+        if key in seen:
+            continue
+        seen.add(key)
+        rows.append(row)
+    rows.sort(key=lambda r: (r.get("tick", 0),
+                             0 if r["event"].startswith("replica") else 1))
+    return rows
+
+
 def step_skew(events: list) -> Optional[dict]:
     """Cross-host step-skew percentiles from the per-rank ``step_log``
     events on the merged wall timeline: for every step logged by more than
@@ -398,6 +435,7 @@ def analyze_dir(directory: str, rank: Optional[int] = None,
         "top_stalls": top_stalls(loaded["events"], rank),
         "step_skew": step_skew(loaded["events"]),
         "membership": membership_timeline(loaded["events"], rank),
+        "replicas": replica_timeline(loaded["events"], rank),
     }
     if baseline:
         base_att = load_baseline_attribution(baseline)
@@ -449,6 +487,33 @@ def render(report: dict) -> str:
                       if "alive" in r and "world" in r else "")
             lines.append(f"  step {r.get('step', '?'):>6}  {who}: {what}"
                          + (f" ({r['cause']})" if r.get("cause") else "")
+                         + quorum)
+    if report.get("replicas"):
+        lines.append("replica timeline:")
+        for r in report["replicas"]:
+            if "req_id" in r:
+                # request events first: engine-side timeouts carry BOTH a
+                # req_id and the replica it happened on — the incident
+                # report must say WHICH request, not just where
+                src = r.get("from_replica", r.get("replica", "?"))
+                dst = (f" -> {r['to_replica']}" if "to_replica" in r else "")
+                who = f"request {r['req_id']} (replica {src}{dst})"
+            elif "replica" in r:
+                who = f"replica {r['replica']}"
+            else:
+                who = "fleet"
+            extra = []
+            if r.get("cause"):
+                extra.append(r["cause"])
+            if "committed" in r:
+                extra.append(f"{r['committed']} committed")
+            if "residents" in r:
+                extra.append(f"{r['residents']} resident(s)")
+            quorum = (f"  [alive {r['alive']}/{r['world']}]"
+                      if "alive" in r and "world" in r else "")
+            lines.append(f"  tick {r.get('tick', '?'):>6}  {who}: "
+                         f"{r['event']}"
+                         + (f" ({', '.join(extra)})" if extra else "")
                          + quorum)
     skew = report.get("step_skew")
     if skew:

@@ -19,6 +19,13 @@ page pools over kv heads) across the first N local devices — how the
 NF4 Llama-2-7B artifact serves on a v5e slice (ISSUE 13); ``--prefix_cache``
 shares prompt-prefix KV pages across requests with copy-on-write
 semantics. Both are pinned output-identical to the plain engine.
+
+``--replicas N`` serves through the elastic fleet
+(serve/replica_plane.py, ISSUE 14): N engines over the one loaded
+checkpoint, live replica crash/drain/slow/rejoin (``--inject_serve``
+schedules the fault matrix), in-flight requests migrating
+token-identically from their recovery records, per-request ``deadline_s``
+honored with honest ``timeout``/``failed`` statuses.
 """
 
 from __future__ import annotations
@@ -65,12 +72,28 @@ class ServeArguments:
     # --speculate draft:<k> (same loaders as --model_path)
     draft_model_name: Optional[str] = None   # draft architecture (default:
     # the target's model_name — self-drafting smoke mode)
+    replicas: int = 1                # elastic serving fleet width
+    # (serve/replica_plane, ISSUE 14): N independent engines (weights
+    # shared, page pools per-replica) behind one admission queue with
+    # prefix_group-affine routing; replicas leave/drain/rejoin live and
+    # in-flight requests migrate token-identically from their recovery
+    # records. 1 (default) = the single engine, no fleet layer at all.
+    inject_serve: str = ""           # serve-side fault schedule
+    # (resilience.parse_serve_specs, comma-separated):
+    # replica_crash:<r>:<tick> | replica_drain:<r>[:<tick>] |
+    # slow_tick:<r>:<ms> | replica_rejoin:<r>:<tick> — consumed by the
+    # fleet at tick boundaries. Needs --replicas >= 2 to mean anything
+    # (a 1-replica fleet with a crash has nowhere to migrate).
     journal_dir: Optional[str] = None
 
 
-def build_engine(gen_args, serve_args: "ServeArguments"):
-    """(tokenizer, engine) from the run_generate model surface + serve
-    knobs — shared by this CLI, the decode bench, and tests."""
+def build_engine_factory(gen_args, serve_args: "ServeArguments"):
+    """(tokenizer, factory) from the run_generate model surface + serve
+    knobs: checkpoints load ONCE, ``factory()`` builds a fresh
+    :class:`ServingEngine` over the shared weights (its own page pool and
+    block tables each call — what a rejoining fleet replica needs).
+    Shared by :func:`build_engine`, the ``--replicas`` fleet path, and
+    the bench."""
     from distributed_lion_tpu.cli.run_generate import build
     from distributed_lion_tpu.serve.engine import (
         ServeConfig,
@@ -118,7 +141,7 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
             model_name=serve_args.draft_model_name or gen_args.model_name)
         _, dcfg, dparams, _, _ = build(d_args)
         draft_model = as_serve_model(dparams, dcfg)
-    engine = ServingEngine(model, ServeConfig(
+    scfg = ServeConfig(
         max_seqs=serve_args.max_seqs, block_size=serve_args.block_size,
         max_blocks_per_seq=serve_args.max_blocks_per_seq,
         num_blocks=serve_args.num_blocks,
@@ -129,8 +152,29 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
         quant_block=serve_args.quant_block,
         tp=serve_args.serve_tp, prefix_cache=serve_args.prefix_cache,
         speculate=serve_args.speculate,
-        eos_id=getattr(tok, "eos_id", None)), draft_model=draft_model)
-    return tok, engine
+        eos_id=getattr(tok, "eos_id", None))
+
+    def factory() -> ServingEngine:
+        return ServingEngine(model, scfg, draft_model=draft_model)
+
+    return tok, factory
+
+
+def build_engine(gen_args, serve_args: "ServeArguments"):
+    """(tokenizer, engine) — the single-engine surface this CLI, the
+    decode bench, and tests share."""
+    tok, factory = build_engine_factory(gen_args, serve_args)
+    return tok, factory()
+
+
+def build_fleet(gen_args, serve_args: "ServeArguments"):
+    """(tokenizer, fleet) for ``--replicas N`` — N engines over ONE
+    loaded checkpoint behind the replica plane's admission queue
+    (serve/replica_plane.ServingFleet)."""
+    from distributed_lion_tpu.serve.replica_plane import ServingFleet
+
+    tok, factory = build_engine_factory(gen_args, serve_args)
+    return tok, ServingFleet(factory, replicas=serve_args.replicas)
 
 
 def main(argv=None):
@@ -146,12 +190,26 @@ def main(argv=None):
 
     gen_args, args = parse_dataclasses((GenerateArguments, ServeArguments),
                                        argv)
+    if args.replicas < 1:
+        raise ValueError(f"--replicas must be >= 1, got {args.replicas}")
+    if args.inject_serve and args.replicas < 2:
+        raise ValueError(
+            "--inject_serve needs --replicas >= 2: a one-replica fleet "
+            "has no survivor to migrate in-flight requests to")
     jrnl = None
     if args.journal_dir:
         jrnl = journal_mod.Journal(args.journal_dir)
         journal_mod.install(jrnl)
     try:
-        tok, engine = build_engine(gen_args, args)
+        if args.inject_serve:
+            from distributed_lion_tpu.train import resilience
+
+            resilience.inject_fault(
+                "serve", resilience.parse_serve_specs(args.inject_serve))
+        if args.replicas > 1:
+            tok, engine = build_fleet(gen_args, args)
+        else:
+            tok, engine = build_engine(gen_args, args)
         if args.requests:
             records = api.serve_request_file(engine, args.requests,
                                              args.out or "/dev/stdout", tok)
@@ -170,6 +228,12 @@ def main(argv=None):
             k: int(v) for k, v in engine.stats.items()})
         return records
     finally:
+        if args.inject_serve:
+            from distributed_lion_tpu.train import resilience
+
+            resilience.inject_fault("serve", [])  # disarm leftovers — a
+            # half-consumed schedule must not leak into the next engine
+            # built in this process (tests drive main() in-process)
         if jrnl is not None:
             journal_mod.uninstall(jrnl)
             jrnl.close()

@@ -10,10 +10,14 @@ checkpoints at production batch sizes on the same stack that trained them.
 - ``speculate`` — speculative decode: draft/verify/commit on the paged
   cache, outputs pinned identical to the one-token tick
 - ``api``       — request-file front end (offline mode for CI)
+- ``replica_plane`` — elastic multi-replica fleet: replica lifecycle,
+  live request migration from recovery records (token-identical by the
+  pinned PRNG streams), the serve-side fault matrix
 """
 
 from distributed_lion_tpu.serve.engine import (  # noqa: F401
     Completion,
+    RecoveryRecord,
     Request,
     ServeConfig,
     ServeModel,

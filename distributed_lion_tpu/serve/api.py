@@ -5,17 +5,24 @@ requests in, a JSONL file of responses out — the same strict-JSON
 discipline as every other artifact (scripts/validate_metrics.py). Each
 request line:
 
-    {"id": "r1", "prompt": "Hello", "max_new_tokens": 32,
-     "seed": 0, "arrival_tick": 0, "prefix_group": "sys-v2"}
+    {"id": "r1", "prompt": "Hello", "max_new_tokens": 32, "seed": 0,
+     "arrival_tick": 0, "prefix_group": "sys-v2", "deadline_s": 2.5}
 
 ``prompt`` (text, run through the tokenizer) or ``tokens`` (explicit ids)
 — one of the two is required. ``arrival_tick`` staggers admission for
 continuous-batching runs (default 0 = all at start). ``prefix_group`` is
 an OPTIONAL routing/accounting tag for requests sharing a prompt prefix
 (the ``--prefix_cache`` engine matches by tokens, so the tag never
-changes what is shared); when present it must be a non-empty string —
-validated strictly, echoed on the response line. Response lines carry
-the request id, the generated ids/text, and the finish reason::
+changes what is shared — under ``--replicas`` the fleet additionally
+routes one group to one replica, serve/replica_plane); when present it
+must be a non-empty string — validated strictly, echoed on the response
+line. ``deadline_s`` is an OPTIONAL wall-clock budget from submission;
+when present it must be a positive finite number — validated strictly,
+echoed on the response line — and an expired request completes with the
+honest ``timeout`` reason (partial output attached), never silent loss.
+Response lines carry the request id, the generated ids/text, and the
+finish reason (``eos | length | overflow | rejected | timeout | failed``
+— the last two from deadlines and the fleet's retry budget)::
 
     {"id": "r1", "text": "...", "tokens": [...], "reason": "eos",
      "prompt_len": 5, "n_generated": 12}
@@ -29,7 +36,7 @@ from __future__ import annotations
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
-from distributed_lion_tpu.serve.engine import Completion, Request, ServingEngine
+from distributed_lion_tpu.serve.engine import Completion, Request
 
 
 def load_request_file(path: str, tokenizer=None
@@ -63,10 +70,24 @@ def load_request_file(path: str, tokenizer=None
                 raise ValueError(
                     f"{path}:{i}: 'prefix_group' must be a non-empty "
                     f"string when present, got {group!r}")
+            deadline = d.get("deadline_s")
+            if deadline is not None and (
+                    isinstance(deadline, bool)
+                    or not isinstance(deadline, (int, float))
+                    or not deadline > 0 or deadline != deadline
+                    or deadline == float("inf")):
+                # strict: a malformed deadline must refuse, not silently
+                # serve without one (a request that LOOKS bounded but
+                # isn't is the worst failure mode a deadline can have)
+                raise ValueError(
+                    f"{path}:{i}: 'deadline_s' must be a positive finite "
+                    f"number when present, got {deadline!r}")
             requests.append(Request(
                 req_id=rid, tokens=list(toks),
                 max_new_tokens=d.get("max_new_tokens"),
-                seed=int(d.get("seed", 0)), prefix_group=group))
+                seed=int(d.get("seed", 0)), prefix_group=group,
+                deadline_s=(float(deadline) if deadline is not None
+                            else None)))
             arrivals[rid] = int(d.get("arrival_tick", 0))
     return requests, arrivals
 
@@ -79,23 +100,27 @@ def completion_record(c: Completion, tokenizer=None) -> dict:
     return rec
 
 
-def handle_requests(engine: ServingEngine, requests: List[Request],
+def handle_requests(engine, requests: List[Request],
                     arrivals: Optional[Dict[Any, int]] = None,
                     tokenizer=None) -> List[dict]:
-    """Drive the engine over a workload; response records in request
-    order (an unserved id would be loudly missing, not silently skipped).
-    Requests tagged with ``prefix_group`` get it echoed on the record."""
+    """Drive an engine — or a ``serve/replica_plane.ServingFleet``, the
+    two share the ``run(requests, arrivals)`` surface — over a workload;
+    response records in request order (an unserved id would be loudly
+    missing, not silently skipped). Requests tagged with ``prefix_group``
+    / ``deadline_s`` get them echoed on the record."""
     done = engine.run(requests, arrivals or {})
     records = []
     for r in requests:
         rec = completion_record(done[r.req_id], tokenizer)
         if r.prefix_group is not None:
             rec["prefix_group"] = r.prefix_group
+        if r.deadline_s is not None:
+            rec["deadline_s"] = r.deadline_s
         records.append(rec)
     return records
 
 
-def serve_request_file(engine: ServingEngine, in_path: str, out_path: str,
+def serve_request_file(engine, in_path: str, out_path: str,
                        tokenizer=None) -> List[dict]:
     requests, arrivals = load_request_file(in_path, tokenizer)
     records = handle_requests(engine, requests, arrivals, tokenizer)

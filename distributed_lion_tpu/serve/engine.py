@@ -77,6 +77,21 @@ specs as their dense twins (the shaped layout's last-dim blocks never
 straddle a shard boundary — ops/quant.validate_quant_tp fails fast when a
 block size can't split).
 
+**Elastic serving** (ISSUE 14): every unfinished request is exportable as
+a :class:`RecoveryRecord` — prompt + committed tokens + seed (+ budget and
+deadline) — and a request carrying ``committed`` tokens re-admits by
+prefilling its whole history and RESUMING the pinned per-request sample
+stream at ``token_index = len(committed)``. Because every draw's key is
+``fold_in(key(seed), token_index)`` and prefill-computed k/v are
+bit-identical to decode-written k/v for the same tokens at the same
+positions, a migrated request's continued stream is token-identical to
+the uninterrupted one by construction — the property
+``serve/replica_plane.ServingFleet`` builds replica crash/drain/rejoin on
+(tests/test_replica_plane.py pins it, greedy/sampled/speculative,
+prefix_cache on and off). Requests may also carry a wall-clock
+``deadline_s``; expiry evicts with the honest ``timeout`` status at the
+next tick boundary, partial output attached.
+
 Journal spans (``serve/admit``, ``serve/prefill``, ``serve/decode_tick``,
 ``serve/cow``, ``serve/evict``) ride the PR-7 run journal when one is
 installed (train/journal.install), giving ``cli/run_analyze`` a per-tick
@@ -86,6 +101,7 @@ timeline.
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
@@ -165,6 +181,16 @@ class Request:
     # for requests sharing a prompt prefix (serve/api validates it
     # strictly and echoes it on the response); the prefix cache itself
     # matches by TOKENS, so the tag never changes what is shared
+    committed: List[int] = dataclasses.field(default_factory=list)
+    # tokens this request already generated on ANOTHER replica (the
+    # migration path, serve/replica_plane): the engine prefills
+    # tokens + committed as one history and resumes the request's pinned
+    # sample stream at index len(committed) — the per-request PRNG keys
+    # are fold_in(key(seed), token_index), so the continued stream is
+    # token-identical to never having migrated, by construction
+    deadline_s: Optional[float] = None   # wall-clock budget from submit;
+    # an expired request is evicted with the honest 'timeout' status
+    # (partial output attached), never silently dropped
 
 
 @dataclasses.dataclass
@@ -172,7 +198,53 @@ class Completion:
     req_id: Any
     prompt_len: int
     tokens: List[int]    # generated ids (EOS included when emitted)
-    reason: str          # eos | length | overflow | rejected
+    reason: str          # eos | length | overflow | rejected | timeout
+    #                      (| failed — replica_plane's retry-budget status)
+
+
+@dataclasses.dataclass
+class RecoveryRecord:
+    """The minimal per-request state a survivor needs to continue a
+    request token-identically after its replica dies: prompt + committed
+    tokens + seed (+ the resolved budget and deadline). The pinned
+    per-request PRNG stream (``_sample_rows``: fold_in(key(seed),
+    token_index)) carries the rest — re-prefilling the committed history
+    and resuming at token_index = len(committed) reproduces the exact
+    stream the dead replica was emitting. Exported every tick by
+    :meth:`ServingEngine.export_records`; the fleet
+    (serve/replica_plane.ServingFleet) shadows these OUTSIDE the replica,
+    so a crash never needs to ask the dead engine anything."""
+
+    req_id: Any
+    tokens: List[int]                    # the ORIGINAL prompt
+    committed: List[int]                 # tokens generated so far
+    seed: int
+    budget: Optional[int]                # total max_new_tokens (resolved
+    #                                      for resident slots)
+    prefix_group: Optional[str] = None
+    deadline_at: Optional[float] = None  # absolute time.monotonic() stamp
+    #                                      — survives migration unmoved
+
+    def to_request(self) -> "Request":
+        return Request(req_id=self.req_id, tokens=list(self.tokens),
+                       max_new_tokens=self.budget, seed=int(self.seed),
+                       prefix_group=self.prefix_group,
+                       committed=list(self.committed))
+
+    @staticmethod
+    def from_request(req: "Request", committed, budget,
+                     deadline_at: Optional[float]) -> "RecoveryRecord":
+        """The ONE construction site (engine slot/pending exports and the
+        fleet's routing-time shadow all build records here, so a future
+        field cannot silently miss one of them). ``req.tokens`` is shared,
+        not copied: the prompt list is immutable after submit (nothing in
+        the engine or fleet writes to it) and it dominates the per-tick
+        shadow-refresh cost on long prompts; ``committed`` mutates every
+        tick and is always copied."""
+        return RecoveryRecord(
+            req_id=req.req_id, tokens=req.tokens,
+            committed=list(committed), seed=int(req.seed), budget=budget,
+            prefix_group=req.prefix_group, deadline_at=deadline_at)
 
 
 @dataclasses.dataclass
@@ -410,10 +482,14 @@ class ServingEngine:
         self.prefix = PrefixCache(self.tables) if cfg.prefix_cache else None
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_seqs
         self.pending: deque = deque()
+        # req_id -> absolute time.monotonic() deadline (requests with a
+        # deadline_s, or an inherited stamp from a pre-migration submit)
+        self._deadline_at: Dict[Any, float] = {}
         self.stats = {"ticks": 0, "decode_ticks": 0, "prefill_dispatches": 0,
                       "decode_tokens": 0, "prefill_tokens": 0,
                       "padded_prefill_tokens": 0, "evictions": 0,
-                      "freed_pages": 0}
+                      "freed_pages": 0, "timeouts": 0, "resumed_requests": 0,
+                      "resumed_tokens": 0}
         if self.prefix is not None:
             self.stats.update(prefix_hits=0, shared_tokens=0, cow_copies=0,
                               reclaimed_pages=0)
@@ -496,11 +572,39 @@ class ServingEngine:
         return jax.jit(body, donate_argnums=donate)
 
     # ------------------------------------------------------------- intake
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request, deadline_at: Optional[float] = None
+               ) -> None:
+        """Queue a request. ``deadline_at`` (absolute ``time.monotonic()``)
+        overrides the fresh ``deadline_s`` stamp — the migration path: a
+        request's wall-clock budget started at its ORIGINAL submission and
+        must not reset when a survivor re-admits it."""
+        if deadline_at is None and req.deadline_s is not None:
+            deadline_at = time.monotonic() + float(req.deadline_s)
+        if deadline_at is not None:
+            self._deadline_at[req.req_id] = float(deadline_at)
         self.pending.append(req)
 
     def has_work(self) -> bool:
         return bool(self.pending) or any(s is not None for s in self.slots)
+
+    def export_records(self) -> List[RecoveryRecord]:
+        """Snapshot every unfinished request (resident slots + the pending
+        queue) as :class:`RecoveryRecord`s — pure host-side table/list
+        reads, no device sync. The fleet copies these OUT of the replica
+        each tick so a crash recovers from the shadow, never from the
+        dead engine."""
+        recs = []
+        for s in self.slots:
+            if s is None:
+                continue
+            recs.append(RecoveryRecord.from_request(
+                s.req, s.gen, int(s.budget),
+                self._deadline_at.get(s.req.req_id)))
+        for req in self.pending:
+            recs.append(RecoveryRecord.from_request(
+                req, req.committed, req.max_new_tokens,
+                self._deadline_at.get(req.req_id)))
+        return recs
 
     def _bucket(self, n: int) -> int:
         return bucket_tokens(n, self.cfg.block_size,
@@ -574,11 +678,32 @@ class ServingEngine:
         jrnl = journal.active()
         while self.pending:
             req = self.pending[0]
-            L = len(req.tokens)
-            if L == 0 or L > self.tables.max_tokens_per_seq - 1:
+            # a migrated request prefills its WHOLE history — prompt plus
+            # the tokens it already generated elsewhere — and resumes the
+            # pinned sample stream at index len(committed) (see Request)
+            hist = list(req.tokens) + list(req.committed)
+            L = len(hist)
+            cap = self.tables.max_tokens_per_seq
+            if not req.tokens or len(req.tokens) > cap - 1:
                 # -1: a prompt must leave room for one decode write
                 self.pending.popleft()
-                completions.append(Completion(req.req_id, L, [], "rejected"))
+                self._deadline_at.pop(req.req_id, None)
+                completions.append(Completion(
+                    req.req_id, len(req.tokens), list(req.committed),
+                    "rejected"))
+                continue
+            if L > cap:
+                # a resumption already past the horizon: the uninterrupted
+                # run overflow-evicted at exactly this point, delivering
+                # these committed tokens — same status, same tokens, no
+                # pointless prefill (L == cap still admits: the history
+                # fills the table, one token samples, and the NEXT tick's
+                # failed grow overflow-evicts like the uninterrupted run)
+                self.pending.popleft()
+                self._deadline_at.pop(req.req_id, None)
+                completions.append(Completion(
+                    req.req_id, len(req.tokens), list(req.committed),
+                    "overflow"))
                 continue
             slot = self.tables.find_free_slot()
             if slot is None:
@@ -588,14 +713,14 @@ class ServingEngine:
                 # recency for a request that cannot admit)
             run, covered = ([], 0)
             if self.prefix is not None:
-                run, covered = self.prefix.match(req.tokens)
+                run, covered = self.prefix.match(hist)
             P = self._bucket(L - covered)
             if admitted and P > budget:
                 break  # fairness cap — but never starve an empty tick
             if run:
                 self.tables.share(slot, run)
             cow_pairs: List[tuple] = []
-            if not (self._grow(slot, L + 1)
+            if not (self._grow(slot, min(L + 1, cap))
                     and self._cow_if_shared(slot, covered, cow_pairs)):
                 # no pages even after reclaim: roll the slot back EMPTY
                 # (all-or-nothing — a half-reserved slot strands refs)
@@ -603,44 +728,52 @@ class ServingEngine:
                 break
             self.pending.popleft()
             self._flush_cow(cow_pairs)
-            suffix = req.tokens[covered:]
+            suffix = hist[covered:]
             with jrnl.span("serve/prefill", req_id=str(req.req_id),
                            prompt_len=L, padded=P, slot=slot,
-                           shared=covered):
+                           shared=covered, resumed=len(req.committed)):
                 toks = np.zeros((1, P), np.int32)
                 toks[0, :len(suffix)] = suffix
+                # the sample index resumes at len(committed): the key for
+                # this draw is fold_in(key(seed), len(committed)) — the
+                # exact key the pre-migration engine would use next
                 tok, self.pages = self._prefill(
                     self.params, self.pages,
                     jnp.asarray(self.tables.tables[slot:slot + 1]),
                     jnp.asarray(toks), jnp.full((1,), covered, jnp.int32),
                     jnp.int32(len(suffix)),
-                    jnp.uint32(req.seed), jnp.int32(0))
+                    jnp.uint32(req.seed), jnp.int32(len(req.committed)))
                 first = int(tok)  # ONE host sync per prefill dispatch
             budget -= P
             admitted += 1
             self.stats["prefill_dispatches"] += 1
             self.stats["prefill_tokens"] += len(suffix)
             self.stats["padded_prefill_tokens"] += P
+            if req.committed:
+                self.stats["resumed_requests"] += 1
+                self.stats["resumed_tokens"] += len(req.committed)
             if self.prefix is not None:
                 if covered:
                     self.stats["prefix_hits"] += 1
                     self.stats["shared_tokens"] += covered
-                self.prefix.register(slot, list(req.tokens))
+                self.prefix.register(slot, hist)
             slot_state = _Slot(req=req, cache_len=L, last_tok=first,
                                budget=(req.max_new_tokens
                                        or self.cfg.max_new_tokens))
-            slot_state.gen.append(first)
+            slot_state.gen = list(req.committed) + [first]
             self.slots[slot] = slot_state
             if self._speculator is not None:
-                self._speculator.on_admit(slot, list(req.tokens))
+                self._speculator.on_admit(slot, hist, len(req.committed))
             self._maybe_finish(slot, completions)
 
     def _maybe_finish(self, slot: int, completions: List[Completion],
-                      overflow: bool = False) -> None:
+                      overflow: bool = False, timeout: bool = False) -> None:
         s = self.slots[slot]
         reason = None
         if overflow:
             reason = "overflow"
+        elif timeout:
+            reason = "timeout"
         elif self.cfg.eos_id is not None and s.gen and \
                 s.gen[-1] == self.cfg.eos_id:
             reason = "eos"
@@ -658,8 +791,11 @@ class ServingEngine:
             self.stats["freed_pages"] += freed
             self.slots[slot] = None
             self.stats["evictions"] += 1
+            if reason == "timeout":
+                self.stats["timeouts"] += 1
             if self._speculator is not None:
                 self._speculator.on_evict(slot)
+        self._deadline_at.pop(s.req.req_id, None)
         completions.append(
             Completion(s.req.req_id, len(s.req.tokens), list(s.gen), reason))
 
@@ -710,12 +846,46 @@ class ServingEngine:
             s.gen.append(int(toks[i]))
             self._maybe_finish(i, completions)
 
+    def _expire_deadlines(self, completions: List[Completion]) -> None:
+        """Evict every request past its wall-clock deadline with the
+        honest ``timeout`` status (partial output attached) — checked at
+        the tick boundary BEFORE admit/decode, so an expired pending
+        request never pays a prefill and an expired resident never pays
+        another dispatch. Host-side clock reads only."""
+        if not self._deadline_at:
+            return
+        now = time.monotonic()
+        jrnl = journal.active()
+        keep: deque = deque()
+        while self.pending:
+            req = self.pending.popleft()
+            at = self._deadline_at.get(req.req_id)
+            if at is not None and now >= at:
+                self._deadline_at.pop(req.req_id, None)
+                self.stats["timeouts"] += 1
+                jrnl.event("serve/timeout", req_id=str(req.req_id),
+                           where="pending",
+                           n_generated=len(req.committed))
+                completions.append(Completion(
+                    req.req_id, len(req.tokens), list(req.committed),
+                    "timeout"))
+            else:
+                keep.append(req)
+        self.pending = keep
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            at = self._deadline_at.get(s.req.req_id)
+            if at is not None and now >= at:
+                self._maybe_finish(i, completions, timeout=True)
+
     def step(self) -> List[Completion]:
-        """One engine tick: admit/prefill under the fairness cap, then one
-        decode dispatch over the rolling batch. Returns the requests that
-        finished this tick."""
+        """One engine tick: expire deadlines, admit/prefill under the
+        fairness cap, then one decode dispatch over the rolling batch.
+        Returns the requests that finished this tick."""
         completions: List[Completion] = []
         self.stats["ticks"] += 1
+        self._expire_deadlines(completions)
         with journal.active().span("serve/admit",
                                    pending=len(self.pending)):
             self._admit(completions)

@@ -1,0 +1,480 @@
+"""Elastic serving: replica lifecycle + live request migration.
+
+PR 10's control plane (train/control_plane.py) taught *training* to lose
+and regain workers mid-run; this module is the serving twin (ROADMAP item
+2(d)): a host-side per-replica lifecycle
+
+    healthy ──drain──▶ draining ──residents done──▶ departed
+       ▲                                               │
+       │ probe ticks ok                                │ replica_rejoin
+    rejoining ◀────────(fresh engine, fresh page pool)─┘
+       (a crash jumps healthy/draining → departed directly)
+
+driving a :class:`ServingFleet` of N independent ``ServingEngine``s behind
+ONE admission queue. The robustness core is **request migration**: after
+every replica tick the fleet copies each unfinished request's
+:class:`~distributed_lion_tpu.serve.engine.RecoveryRecord` (prompt +
+committed tokens + seed + budget + deadline — the minimal resumption
+state) into its own shadow map, so when a replica dies the fleet never
+asks the dead engine anything. A survivor re-admits the record: the
+engine prefills the committed history (suffix-only when ``prefix_cache``
+covers a shared prefix — the two compose) and resumes the pinned
+per-request PRNG stream at ``token_index = len(committed)``, which makes
+the migrated output token-identical to the never-migrated run BY
+CONSTRUCTION — greedy and sampled, with and without speculation
+(tests/test_replica_plane.py pins the matrix; the same discipline the
+paper's 1-bit vote wire applies to degraded training quorums).
+
+Fault matrix (the ``serve`` registry schedule, ``--inject_serve`` /
+``resilience.parse_serve_specs``, consumed at fleet-tick boundaries via
+the same ``resilience.consume_due`` helper the membership schedule uses):
+
+- ``replica_crash:<r>:<tick>`` — r's engine is discarded mid-decode; its
+  residents and pending requests re-queue from the recovery shadow with
+  ZERO accepted-token loss (the shadow refreshes every tick).
+- ``replica_drain:<r>[:<tick>]`` — r stops admitting; its pending queue
+  migrates immediately, residents finish in place; when empty r departs.
+- ``slow_tick:<r>:<ms>`` — every tick of r pays <ms> extra. The
+  tick-latency watch flags r (mean over a recent window vs the median of
+  its peers) and NEW work routes around it; residents keep their slots.
+- ``replica_rejoin:<r>:<tick>`` — a departed r re-enters with a FRESH
+  engine and page pool (the factory) through a short ``rejoining``
+  probation: new work prefers healthy replicas until the probe window
+  elapses (the rejoiner still admits when it is the only survivor — a
+  probation that strands the queue would be worse than none).
+
+Routing honors the serve/api ``prefix_group`` affinity tag: requests of
+one group land on one replica (so its prefix cache actually accumulates
+their shared pages), falling back to least-loaded among admitting,
+non-slow replicas. Failures are never silent: each migration consumes one
+unit of the per-request retry budget with exponential tick backoff, and a
+request that exhausts the budget (or its wall-clock ``deadline_s``)
+completes with the honest ``failed`` / ``timeout`` status, partial output
+attached.
+
+Journal events (ride the installed PR-7 run journal; ``cli/run_analyze``
+renders them as the replica timeline beside the PR-10 membership
+timeline): ``replica_left`` / ``replica_rejoined`` / ``replica_draining``
+/ ``replica_slow`` (cause, tick, resident counts, alive/world) and
+``request_migrated`` / ``request_failed`` (req_id, from/to replica,
+committed count, attempt, cause, tick).
+
+Layering: host-side list/dict math only — engines do all device work;
+this module must stay free of jax imports at module scope (the fleet is
+pure scheduling, like train/control_plane is pure deciding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from distributed_lion_tpu.serve.engine import (
+    Completion,
+    RecoveryRecord,
+    Request,
+    ServingEngine,
+)
+from distributed_lion_tpu.train import journal, resilience
+
+REPLICA_STATES = ("healthy", "draining", "departed", "rejoining")
+
+
+@dataclasses.dataclass
+class _Replica:
+    engine: Optional[ServingEngine]
+    state: str = "healthy"
+    slow_ms: int = 0                 # armed slow_tick injection (ms/tick)
+    slow: bool = False               # flagged by the tick-latency watch
+    rejoined_at: int = -1            # fleet tick of the last rejoin
+    admissions: int = 0              # requests routed here, lifetime
+    assigned: set = dataclasses.field(default_factory=set)
+    tick_ms: deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=16))
+
+
+@dataclasses.dataclass
+class _QueueItem:
+    req: Request
+    not_before: int                  # earliest admissible fleet tick
+    #                                  (exponential migration backoff)
+    deadline_at: Optional[float]     # absolute monotonic stamp — set at
+    #                                  FIRST submission, never reset
+    cause: Optional[str] = None      # non-None = this entry is a
+    from_replica: int = -1           # migration (journaled at re-route)
+    attempt: int = 0
+    orphaned_at: int = -1            # fleet tick the home replica died
+    #                                  (the recovery-latency clock)
+
+
+class ServingFleet:
+    """N serving replicas behind one admission queue (see module doc).
+
+    ``factory`` builds ONE fresh :class:`ServingEngine` per call — shared
+    weights are the caller's concern (close over one loaded model); the
+    page pool and block tables are per-replica and a rejoiner always gets
+    new ones. Drive with :meth:`submit` + :meth:`step`, or :meth:`run`
+    (the same workload signature as ``ServingEngine.run``, so
+    ``serve/api.handle_requests`` serves through a fleet unchanged).
+    """
+
+    def __init__(self, factory: Callable[[], ServingEngine],
+                 replicas: int = 2, max_retries: int = 2,
+                 backoff_ticks: int = 1, slow_factor: float = 4.0,
+                 slow_min_ticks: int = 4, rejoin_probe_ticks: int = 2,
+                 record_latency: bool = False):
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        if max_retries < 0 or backoff_ticks < 1:
+            raise ValueError(
+                f"need max_retries >= 0 and backoff_ticks >= 1, got "
+                f"{max_retries}/{backoff_ticks}")
+        self.factory = factory
+        self.replicas = [_Replica(engine=factory())
+                         for _ in range(replicas)]
+        self.max_retries = int(max_retries)
+        self.backoff_ticks = int(backoff_ticks)
+        self.slow_factor = float(slow_factor)
+        self.slow_min_ticks = int(slow_min_ticks)
+        self.rejoin_probe_ticks = int(rejoin_probe_ticks)
+        self.tick_no = 0
+        self.queue: deque = deque()            # _QueueItem FIFO
+        self._records: Dict[Any, RecoveryRecord] = {}   # the shadow
+        self._attempts: Dict[Any, int] = {}
+        self._home: Dict[str, int] = {}        # prefix_group -> replica
+        self.migration_latency_ticks: List[int] = []
+        # full per-replica tick-latency history (ms) — bench/diagnostic
+        # only (unbounded), the watch itself reads the bounded window
+        self.tick_latency_log: Optional[Dict[int, List[float]]] = (
+            {i: [] for i in range(replicas)} if record_latency else None)
+        self.stats = {"ticks": 0, "migrations": 0, "failed": 0,
+                      "timeouts": 0, "replica_crashes": 0,
+                      "replica_drains": 0, "replica_rejoins": 0,
+                      "slow_detected": 0}
+
+    # ------------------------------------------------------------- state
+    def alive(self) -> int:
+        return sum(r.engine is not None for r in self.replicas)
+
+    def lifecycle(self) -> List[str]:
+        """Per-replica state names — the fleet's authoritative view (the
+        serving twin of ControlPlane.lifecycle)."""
+        return [r.state for r in self.replicas]
+
+    def _admitting(self) -> List[int]:
+        return [i for i, r in enumerate(self.replicas)
+                if r.engine is not None
+                and r.state in ("healthy", "rejoining")]
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(
+            r.engine is not None and r.engine.has_work()
+            for r in self.replicas)
+
+    # ------------------------------------------------------------ intake
+    def submit(self, req: Request) -> None:
+        """Queue a request; the wall-clock deadline (if any) stamps NOW —
+        migrations inherit the stamp, they never reset it."""
+        deadline_at = (time.monotonic() + float(req.deadline_s)
+                       if req.deadline_s is not None else None)
+        self.queue.append(_QueueItem(req=req, not_before=self.tick_no,
+                                     deadline_at=deadline_at))
+
+    # --------------------------------------------------- fault transitions
+    def _event(self, name: str, **fields) -> None:
+        journal.active().event(name, alive=self.alive(),
+                               world=len(self.replicas), **fields)
+
+    def _orphan(self, rid: Any, rep: int, tick: int, cause: str,
+                completions: List[Completion], count_attempt: bool) -> None:
+        """Re-queue one request from the recovery shadow (its replica is
+        gone), spending retry budget when the move was a failure
+        (``count_attempt``) and never when it is an administrative drain.
+        Budget exhaustion completes the request as ``failed`` — loud,
+        partial output attached."""
+        rec = self._records.get(rid)
+        if rec is None:  # completed this very tick: nothing to recover
+            return
+        attempt = self._attempts.get(rid, 0)
+        if count_attempt:
+            attempt += 1
+            self._attempts[rid] = attempt
+        if attempt > self.max_retries:
+            self._records.pop(rid, None)
+            self._attempts.pop(rid, None)
+            self.stats["failed"] += 1
+            self._event("request_failed", req_id=str(rid), tick=tick,
+                        from_replica=rep, attempts=attempt, cause=cause,
+                        committed=len(rec.committed))
+            completions.append(Completion(
+                rid, len(rec.tokens), list(rec.committed), "failed"))
+            return
+        backoff = (self.backoff_ticks * (2 ** max(attempt - 1, 0))
+                   if count_attempt else 0)
+        self.queue.append(_QueueItem(
+            req=rec.to_request(), not_before=tick + backoff,
+            deadline_at=rec.deadline_at, cause=cause, from_replica=rep,
+            attempt=attempt, orphaned_at=tick))
+
+    def _crash(self, r: int, tick: int, cause: str,
+               completions: List[Completion]) -> None:
+        rep = self.replicas[r]
+        if rep.engine is None:
+            return  # already gone; a second signal is not a transition
+        residents = sorted(rep.assigned, key=str)
+        rep.engine = None          # the engine (and its device state) dies
+        rep.state = "departed"
+        rep.slow = False
+        rep.tick_ms.clear()
+        self.stats["replica_crashes"] += 1
+        self._event("replica_left", replica=r, tick=tick, cause=cause,
+                    residents=len(residents))
+        self._home = {g: h for g, h in self._home.items() if h != r}
+        for rid in residents:      # deterministic order: sorted req_ids
+            self._orphan(rid, r, tick, cause, completions,
+                         count_attempt=True)
+        rep.assigned = set()
+
+    def _drain(self, r: int, tick: int,
+               completions: List[Completion]) -> None:
+        rep = self.replicas[r]
+        if rep.engine is None or rep.state == "draining":
+            return
+        rep.state = "draining"
+        self.stats["replica_drains"] += 1
+        self._event("replica_draining", replica=r, tick=tick,
+                    cause="injected_drain", residents=len(rep.assigned))
+        self._home = {g: h for g, h in self._home.items() if h != r}
+        # pending (un-prefilled) requests migrate NOW — they hold no
+        # cache state here, so moving them costs nothing and frees the
+        # drain to finish in resident-count ticks; residents finish in
+        # place (their pages live here). No retry budget is spent: a
+        # drain is administrative, not a failure.
+        pend = list(rep.engine.pending)
+        rep.engine.pending.clear()
+        for req in pend:
+            rep.assigned.discard(req.req_id)
+            self._orphan(req.req_id, r, tick, "drain", completions,
+                         count_attempt=False)
+
+    def _rejoin(self, r: int, tick: int) -> None:
+        rep = self.replicas[r]
+        if rep.engine is not None or rep.state != "departed":
+            return  # rejoining a replica that never left is undefined —
+            # ignore it the way the control plane ignores the matching
+            # worker_rejoin (loud refusal would kill a fleet over a
+            # mis-ticked schedule entry that changes nothing)
+        rep.engine = self.factory()       # fresh page pool by construction
+        rep.state = "rejoining"
+        rep.slow = False
+        rep.slow_ms = 0
+        rep.rejoined_at = tick
+        rep.tick_ms.clear()
+        self.stats["replica_rejoins"] += 1
+        self._event("replica_rejoined", replica=r, tick=tick,
+                    cause="injected_rejoin",
+                    probe_ticks=self.rejoin_probe_ticks)
+
+    def _consume_faults(self, tick: int,
+                        completions: List[Completion]) -> None:
+        for kind, r, at, arg in resilience.consume_due("serve", tick):
+            if not 0 <= int(r) < len(self.replicas):
+                raise ValueError(
+                    f"serve fault {kind}:{r} outside fleet of "
+                    f"{len(self.replicas)} replicas")
+            r = int(r)
+            if kind == "replica_crash":
+                self._crash(r, tick, "injected_crash", completions)
+            elif kind == "replica_drain":
+                self._drain(r, tick, completions)
+            elif kind == "slow_tick":
+                self.replicas[r].slow_ms = int(arg)
+            else:  # replica_rejoin
+                self._rejoin(r, tick)
+
+    # ----------------------------------------------------------- routing
+    def _pick_replica(self, req: Request) -> Optional[int]:
+        admitting = self._admitting()
+        if not admitting:
+            return None
+        # probation: new work PREFERS replicas that have finished their
+        # probe window — a fresh rejoiner only admits when no healthy
+        # replica exists (it must not strand the queue as sole survivor);
+        # then route around detected-slow replicas whenever a non-slow
+        # candidate exists (residents stay — their pages live there;
+        # only NEW work avoids the slow box)
+        healthy = [i for i in admitting
+                   if self.replicas[i].state == "healthy"]
+        pool = healthy or admitting
+        fast = [i for i in pool if not self.replicas[i].slow]
+        pool = fast or pool
+        if req.prefix_group is not None:
+            home = self._home.get(req.prefix_group)
+            if home in pool:
+                return home
+        # least-loaded: fewest assigned requests, lowest index breaks ties
+        target = min(pool, key=lambda i: (len(self.replicas[i].assigned), i))
+        if req.prefix_group is not None:
+            self._home[req.prefix_group] = target
+        return target
+
+    def _route(self, tick: int, completions: List[Completion]) -> None:
+        now = time.monotonic()
+        later: deque = deque()
+        while self.queue:
+            item = self.queue.popleft()
+            rid = item.req.req_id
+            if item.deadline_at is not None and now >= item.deadline_at:
+                self._records.pop(rid, None)
+                self._attempts.pop(rid, None)
+                self.stats["timeouts"] += 1
+                self._event("request_timeout", req_id=str(rid), tick=tick,
+                            committed=len(item.req.committed))
+                completions.append(Completion(
+                    rid, len(item.req.tokens), list(item.req.committed),
+                    "timeout"))
+                continue
+            if item.not_before > tick:
+                later.append(item)
+                continue
+            target = self._pick_replica(item.req)
+            if target is None:
+                later.append(item)   # no admitting replica: wait (a
+                continue             # scheduled rejoin may restore one)
+            rep = self.replicas[target]
+            rep.engine.submit(item.req, deadline_at=item.deadline_at)
+            rep.assigned.add(rid)
+            rep.admissions += 1
+            # shadow the request IMMEDIATELY: a crash before this
+            # replica's first export must still recover it
+            self._records[rid] = RecoveryRecord.from_request(
+                item.req, item.req.committed, item.req.max_new_tokens,
+                item.deadline_at)
+            if item.cause is not None:
+                self.stats["migrations"] += 1
+                if item.orphaned_at >= 0:
+                    self.migration_latency_ticks.append(
+                        tick - item.orphaned_at)
+                self._event("request_migrated", req_id=str(rid), tick=tick,
+                            from_replica=item.from_replica,
+                            to_replica=target, cause=item.cause,
+                            attempt=item.attempt,
+                            committed=len(item.req.committed),
+                            latency_ticks=max(tick - item.orphaned_at, 0))
+        self.queue = later
+
+    # ------------------------------------------------------------- watch
+    def _watch_slow(self, tick: int) -> None:
+        """Flag replicas whose recent MEDIAN tick latency exceeds
+        ``slow_factor`` × the median of their live peers' medians — pure
+        host-side clock math over the measured window, so an injected
+        ``slow_tick`` is DETECTED from the same signal a real straggler
+        would produce. Medians, not means: every replica's window carries
+        one-off spikes (the first tick's jit compile, a GC pause) that
+        must neither flag a healthy replica nor mask a slow one. Un-flags
+        when the latency returns to band."""
+        meds = {}
+        for i, rep in enumerate(self.replicas):
+            if rep.engine is not None and \
+                    len(rep.tick_ms) >= self.slow_min_ticks:
+                window = sorted(rep.tick_ms)
+                meds[i] = window[len(window) // 2]
+        for i, m in meds.items():
+            peers = sorted(v for j, v in meds.items() if j != i)
+            if not peers:
+                continue
+            med = peers[len(peers) // 2]
+            rep = self.replicas[i]
+            if m > self.slow_factor * max(med, 1e-6):
+                if not rep.slow:
+                    rep.slow = True
+                    self.stats["slow_detected"] += 1
+                    self._event("replica_slow", replica=i, tick=tick,
+                                median_tick_ms=round(m, 3),
+                                peer_median_ms=round(med, 3))
+            elif rep.slow:
+                rep.slow = False
+
+    # -------------------------------------------------------------- tick
+    def step(self) -> List[Completion]:
+        """One fleet tick: consume due faults, route the admission queue,
+        step every live replica once (refreshing the recovery shadow from
+        its host-side tables), watch tick latency, finish drains."""
+        completions: List[Completion] = []
+        tick = self.tick_no
+        self.stats["ticks"] += 1
+        self._consume_faults(tick, completions)
+        self._route(tick, completions)
+        for i, rep in enumerate(self.replicas):
+            if rep.engine is None or not rep.engine.has_work():
+                continue
+            t0 = time.perf_counter()
+            if rep.slow_ms:
+                time.sleep(rep.slow_ms / 1e3)   # the injected straggler
+            for c in rep.engine.step():
+                rid = c.req_id
+                rep.assigned.discard(rid)
+                self._records.pop(rid, None)
+                self._attempts.pop(rid, None)
+                if c.reason == "timeout":
+                    # a resident/engine-side deadline miss must show on
+                    # the replica timeline like a queue-side one — an
+                    # incident report that omits it would read as if the
+                    # deadline never fired
+                    self.stats["timeouts"] += 1
+                    self._event("request_timeout", req_id=str(rid),
+                                tick=tick, replica=i,
+                                committed=len(c.tokens))
+                completions.append(c)
+            ms = (time.perf_counter() - t0) * 1e3
+            rep.tick_ms.append(ms)
+            if self.tick_latency_log is not None:
+                self.tick_latency_log[i].append(ms)
+            # refresh the shadow from the replica's host-side state: what
+            # the fleet holds here is what a crash NEXT tick can recover,
+            # which is every token accepted up to and including this tick
+            for rec in rep.engine.export_records():
+                self._records[rec.req_id] = rec
+        self._watch_slow(tick)
+        for i, rep in enumerate(self.replicas):
+            if rep.state == "draining" and rep.engine is not None \
+                    and not rep.engine.has_work():
+                rep.engine = None
+                rep.state = "departed"
+                self._event("replica_left", replica=i, tick=tick,
+                            cause="drained", residents=0)
+            elif rep.state == "rejoining" and \
+                    tick - rep.rejoined_at >= self.rejoin_probe_ticks:
+                rep.state = "healthy"
+        self.tick_no += 1
+        return completions
+
+    # ------------------------------------------------------------ driver
+    def run(self, requests: List[Request],
+            arrivals: Optional[Dict[Any, int]] = None,
+            max_ticks: int = 100_000) -> Dict[Any, Completion]:
+        """Drain a workload — the ``ServingEngine.run`` signature, so
+        ``serve/api`` drives a fleet and a single engine identically."""
+        arrivals = arrivals or {}
+        todo = sorted(requests, key=lambda r: arrivals.get(r.req_id, 0))
+        out: Dict[Any, Completion] = {}
+        while todo or self.has_work():
+            while todo and arrivals.get(todo[0].req_id, 0) <= self.tick_no:
+                self.submit(todo.pop(0))
+            if self.queue and not self._admitting() \
+                    and not resilience.fault("serve"):
+                raise RuntimeError(
+                    f"serving fleet has {len(self.queue)} queued request(s) "
+                    f"but no admitting replica (lifecycle "
+                    f"{self.lifecycle()}) and no scheduled rejoin — "
+                    "refusing to spin forever")
+            for c in self.step():
+                out[c.req_id] = c
+            if self.tick_no > max_ticks:
+                raise RuntimeError(
+                    f"serving fleet did not drain within {max_ticks} ticks "
+                    f"({len(self.queue)} queued, lifecycle "
+                    f"{self.lifecycle()})")
+        return out

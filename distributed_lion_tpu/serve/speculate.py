@@ -154,10 +154,16 @@ class NGramDrafter:
                 prev = index.get(gram)
                 index[gram] = (p - n + 1, None if prev is None else prev[0])
 
-    def admit(self, slot: int, tokens: List[int]) -> None:
+    def admit(self, slot: int, tokens: List[int],
+              n_committed: int = 0) -> None:
+        # ``tokens`` is the slot's full prefilled history; its last
+        # ``n_committed`` entries are ALSO the head of the slot's ``gen``
+        # (a migrated request resumes mid-stream, serve/replica_plane) —
+        # start the gen cursor past them or the propose-time sync would
+        # index the committed tokens twice
         self._hist[slot] = []
         self._index[slot] = {}
-        self._ngen[slot] = 0
+        self._ngen[slot] = int(n_committed)
         self._append(slot, tokens)
 
     def evict(self, slot: int) -> None:
@@ -289,7 +295,12 @@ class DraftModelDrafter:
         self.tables.free_slot(slot)
         self.len[slot] = 0
 
-    def admit(self, slot: int, tokens: List[int]) -> None:
+    def admit(self, slot: int, tokens: List[int],
+              n_committed: int = 0) -> None:
+        # the mirror prefills the slot's FULL history (a migrated
+        # request's committed tokens included — they are cache content
+        # like any other); n_committed only matters to gen-cursor
+        # drafters, so it is accepted and unused here
         import jax.numpy as jnp
 
         L = len(tokens)
@@ -401,8 +412,9 @@ class Speculator:
         self._verify = engine._jit_paged(verify, n_rest=6)
 
     # lifecycle relays from the engine
-    def on_admit(self, slot: int, tokens: List[int]) -> None:
-        self.drafter.admit(slot, tokens)
+    def on_admit(self, slot: int, tokens: List[int],
+                 n_committed: int = 0) -> None:
+        self.drafter.admit(slot, tokens, n_committed)
 
     def on_evict(self, slot: int) -> None:
         self.drafter.evict(slot)

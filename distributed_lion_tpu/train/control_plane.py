@@ -319,16 +319,13 @@ class ControlPlane:
         pair heals the worker rather than silently ignoring the rejoin),
         schedule order within each kind."""
         self.sched_through = max(self.sched_through, int(step))
-        pending = resilience.fault("membership")
         events = _new_events()
-        if not pending:
-            return events
-        due = sorted((m for m in pending if int(m[2]) <= step),
+        # one shared pop-the-due-entries helper with the serve-side
+        # replica plane (resilience.consume_due): 'due at boundary b'
+        # means the same thing to both lifecycles
+        due = sorted(resilience.consume_due("membership", int(step)),
                      key=lambda m: (int(m[2]),
                                     0 if m[0] == "worker_drop" else 1))
-        if due:
-            resilience.inject_fault(
-                "membership", [m for m in pending if int(m[2]) > step])
         for kind, worker, at in due:
             worker = int(worker)
             if not 0 <= worker < self.world:

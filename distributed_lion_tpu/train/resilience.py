@@ -81,6 +81,19 @@ from typing import Any, Iterable, Optional
 #                         healthy mean, ballot history reset, probation
 #                         window). Host-side only: membership transitions
 #                         are mask flips between dispatches, never traced.)
+#   serve                (list of (kind, replica, tick, arg) from
+#                         parse_serve_specs(): the serve-side replica
+#                         fault schedule serve/replica_plane.ServingFleet
+#                         consumes at fleet-tick boundaries —
+#                         replica_crash kills the replica's engine (its
+#                         residents migrate from the fleet's recovery
+#                         shadow), replica_drain stops admission and lets
+#                         residents finish, slow_tick:<r>:<ms> injects ms
+#                         of latency into every tick of replica r (the
+#                         tick-latency watch must detect it and route new
+#                         work around), replica_rejoin re-enters a
+#                         departed replica with a FRESH engine/page pool.
+#                         Host-side only, like membership.)
 _FAULTS: dict[str, Any] = {}
 _FAULTS_LOCK = threading.Lock()
 
@@ -98,6 +111,28 @@ def clear_faults() -> None:
 def fault(name: str, default: Any = None) -> Any:
     with _FAULTS_LOCK:
         return _FAULTS.get(name, default)
+
+
+def consume_due(name: str, through: int, step_of=None) -> list:
+    """Atomically pop the DUE entries of a list-valued schedule fault:
+    entries whose step/tick (``step_of``, default ``entry[2]``) is
+    ``<= through`` are returned in schedule order and removed from the
+    registry; later entries stay armed. The membership schedule
+    (train/control_plane.membership_due) and the serve-side replica
+    schedule (serve/replica_plane.ServingFleet) both consume their
+    boundaries through this one helper, so 'due' can never mean two
+    different things."""
+    if step_of is None:
+        def step_of(e):
+            return int(e[2])
+    with _FAULTS_LOCK:
+        pending = _FAULTS.get(name)
+        if not pending:
+            return []
+        due = [e for e in pending if step_of(e) <= through]
+        if due:
+            _FAULTS[name] = [e for e in pending if step_of(e) > through]
+        return due
 
 
 POISON_KINDS = ("nan_grads", "frozen_ballot", "flipped_ballot")
@@ -143,6 +178,62 @@ def parse_membership_specs(specs: str) -> list:
     tuples, consumed in order by the control plane as their steps come
     due."""
     return [parse_membership(s.strip())
+            for s in specs.split(",") if s.strip()]
+
+
+SERVE_FAULT_KINDS = ("replica_crash", "replica_drain", "slow_tick",
+                     "replica_rejoin")
+
+
+def parse_serve_fault(spec: str) -> tuple[str, int, int, int]:
+    """Parse one serve-side replica-fault spec into the normalized
+    ``(kind, replica, tick, arg)`` tuple the fleet consumes (the third
+    field is ALWAYS the due tick, so the schedule pops through
+    :func:`consume_due` like membership):
+
+    - ``replica_crash:<r>:<tick>`` — replica r dies at that fleet tick
+      (engine discarded; residents migrate from the recovery shadow)
+    - ``replica_drain:<r>[:<tick>]`` — r stops admitting at tick (default
+      0), finishes its residents, then departs
+    - ``slow_tick:<r>:<ms>`` — every tick of replica r pays <ms> extra
+      milliseconds, armed from tick 0 (``arg`` carries the ms)
+    - ``replica_rejoin:<r>:<tick>`` — a departed r re-enters the rotation
+      with a fresh engine/page pool; requires an explicit tick (rejoining
+      a replica that never left is undefined, same rule as
+      worker_rejoin)
+
+    Single source of truth for the --inject_serve CLI flag and direct
+    registry injection in tests/the bench."""
+    parts = spec.split(":")
+    if len(parts) not in (2, 3) or parts[0] not in SERVE_FAULT_KINDS:
+        raise ValueError(
+            f"bad serve fault spec {spec!r}: expected '<kind>:<replica>"
+            f"[:<tick|ms>]' with kind in {SERVE_FAULT_KINDS}")
+    if parts[0] in ("replica_crash", "slow_tick", "replica_rejoin") \
+            and len(parts) != 3:
+        raise ValueError(
+            f"bad serve fault spec {spec!r}: {parts[0]} requires an "
+            f"explicit third field ('{parts[0]}:<replica>:"
+            f"{'<ms>' if parts[0] == 'slow_tick' else '<tick>'}')")
+    try:
+        replica = int(parts[1])
+        val = int(parts[2]) if len(parts) == 3 else 0
+    except ValueError:
+        raise ValueError(f"bad serve fault spec {spec!r}: replica/"
+                         "tick/ms must be integers")
+    if replica < 0 or val < 0:
+        raise ValueError(f"bad serve fault spec {spec!r}: replica/"
+                         "tick/ms must be >= 0")
+    if parts[0] == "slow_tick":
+        return parts[0], replica, 0, val   # armed from tick 0; arg = ms
+    return parts[0], replica, val, 0
+
+
+def parse_serve_specs(specs: str) -> list:
+    """Comma-separated serve fault specs (the --inject_serve flag) → the
+    ``serve`` fault registry value, consumed in order by the fleet as
+    their ticks come due."""
+    return [parse_serve_fault(s.strip())
             for s in specs.split(",") if s.strip()]
 
 

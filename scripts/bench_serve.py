@@ -26,6 +26,14 @@ ROADMAP item 4):
   markers recomputed live (greedy speculative == plain paged decode;
   sampled speculative == the same per-request PRNG stream). Judged by
   check_evidence's ``speculative`` stage (runbook stage 5j).
+- **serve_resilience section** (ISSUE 14) — the replica plane's fault
+  matrix through `serve/replica_plane.ServingFleet`: the crash-at-tick
+  rows (tokens lost == 0 and migrated outputs token-identical at every
+  cut, recovery-latency column), the one-slow-replica leg (per-replica
+  p99 tick latency vs clean, detection + route-around facts), drain and
+  rejoin legs, and identity markers recomputed live across
+  greedy/sampled/speculative/prefix-cache engines. Judged by
+  check_evidence's ``serve_resilience`` stage (runbook stage 5l).
 - **tp_serving section** (ISSUE 13) — TP-degree rows (tokens/s/CHIP at
   each measured tp with p50/p99 tick latency: the per-chip number is the
   honest one — tp divides HBM per chip, not free throughput) and the
@@ -525,6 +533,173 @@ def bench_tp_serving(model_name: str, family: str, quant: str,
             "rows": rows, "prefix": prefix}
 
 
+def bench_serve_resilience(model_name: str, family: str, quant: str,
+                           block_size: int) -> dict:
+    """The ISSUE 14 evidence: the serve-side fault matrix through the
+    replica plane. Crash-at-tick rows (tokens lost MUST be 0 and the
+    migrated outputs token-identical — both measured against the
+    single-engine baseline, with the recovery-latency column from the
+    fleet's migration clock), the one-slow-replica leg (per-replica p99
+    tick latency slow-vs-clean, detection + route-around facts), the
+    drain and rejoin legs, and the identity markers recomputed live
+    across greedy / sampled / speculative / prefix-cache engines."""
+    import numpy as np
+
+    from distributed_lion_tpu.serve.engine import Request
+    from distributed_lion_tpu.serve.replica_plane import ServingFleet
+    from distributed_lion_tpu.train import resilience
+
+    model, _, cfg = _serve_model(model_name, family)
+    gen = 16
+    need = PROMPT_LEN + gen + 2
+    nblocks = -(-need // block_size)
+    n_req = 12
+    prompts = _prompts(n_req, cfg.vocab_size, seed=5)
+    arrivals = {i: (i // 2) for i in range(n_req)}
+
+    def reqs():
+        return [Request(req_id=i, tokens=list(t), max_new_tokens=gen,
+                        seed=i) for i, t in enumerate(prompts)]
+
+    def factory_for(**kw):
+        def factory():
+            eng, _, _ = _build(model_name, family, quant, 8, block_size,
+                               nblocks, **kw)
+            return eng
+        return factory
+
+    def fleet_run(specs, reqs_list=None, arr=None, record_latency=False,
+                  **kw):
+        resilience.inject_fault(
+            "serve", resilience.parse_serve_specs(specs) if specs else [])
+        fleet = ServingFleet(factory_for(**kw), replicas=2,
+                             record_latency=record_latency)
+        done = fleet.run(reqs_list if reqs_list is not None else reqs(),
+                         dict(arr if arr is not None else arrivals))
+        resilience.inject_fault("serve", [])
+        return fleet, done
+
+    def identical(done, base):
+        return all(done[i].tokens == base[i].tokens
+                   and done[i].reason == base[i].reason for i in base)
+
+    def lost(done, base):
+        return int(sum(max(len(base[i].tokens) - len(done[i].tokens), 0)
+                       for i in base))
+
+    base = factory_for()().run(reqs(), dict(arrivals))
+
+    # ---- crash-at-tick matrix: zero accepted-token loss at every cut
+    crash_matrix = []
+    for crash_tick in (1, 3, 6):
+        fleet, done = fleet_run(f"replica_crash:0:{crash_tick}")
+        row = {
+            "crash_tick": crash_tick,
+            "migrated": int(fleet.stats["migrations"]),
+            "tokens_lost": lost(done, base),
+            "identical": bool(identical(done, base)),
+            "recovery_latency_ticks": int(
+                max(fleet.migration_latency_ticks, default=0)),
+        }
+        crash_matrix.append(row)
+        print(json.dumps({"serve_resilience": "crash", **row},
+                         allow_nan=False), flush=True)
+
+    # ---- identity under sampling / speculation / prefix sharing: the
+    # migrated stream must be the SAME stream, not just a plausible one
+    samp = dict(temperature=0.9, top_k=40)
+    base_samp = factory_for(**samp)().run(reqs(), dict(arrivals))
+    _, done_samp = fleet_run("replica_crash:0:3", **samp)
+    base_pc = factory_for(prefix_cache=True)().run(reqs(), dict(arrivals))
+    _, done_spec = fleet_run("replica_crash:0:3", speculate="ngram:4")
+    _, done_pc = fleet_run("replica_crash:0:3", prefix_cache=True)
+
+    # ---- drain: admission stops, residents finish, nothing is lost
+    fleet_d, done_d = fleet_run("replica_drain:0:2")
+    drain = {
+        "completed": int(len(done_d)),
+        "identical": bool(identical(done_d, base)),
+        "drained_departed": bool(fleet_d.lifecycle()[0] == "departed"),
+        "migrated_pending": int(fleet_d.stats["migrations"]),
+    }
+    print(json.dumps({"serve_resilience": "drain", **drain},
+                     allow_nan=False), flush=True)
+
+    # ---- one slow replica: detected by the tick-latency watch, new
+    # work routes around it, and the p99 story is measured per replica
+    slow_ms = 20
+    n_slow = 24
+    slow_prompts = _prompts(n_slow, cfg.vocab_size, seed=6)
+    slow_reqs = [Request(req_id=i, tokens=list(t), max_new_tokens=gen,
+                         seed=i) for i, t in enumerate(slow_prompts)]
+    slow_arr = {i: (i // 2) for i in range(n_slow)}
+    fleet_c, done_c = fleet_run("", reqs_list=[
+        Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+        for r in slow_reqs], arr=slow_arr, record_latency=True)
+    fleet_s, done_s = fleet_run(f"slow_tick:0:{slow_ms}", reqs_list=[
+        Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
+        for r in slow_reqs], arr=slow_arr, record_latency=True)
+
+    def p99(ms_list):
+        return (round(float(np.percentile(ms_list, 99)), 3)
+                if ms_list else 0.0)
+
+    slow_base = {i: c.tokens for i, c in done_c.items()}
+    slow = {
+        "slow_ms": slow_ms,
+        "p99_ms_slow_replica": p99(fleet_s.tick_latency_log[0]),
+        "p99_ms_clean_replica": p99(fleet_s.tick_latency_log[1]),
+        "p99_ms_clean_run": max(p99(fleet_c.tick_latency_log[0]),
+                                p99(fleet_c.tick_latency_log[1])),
+        "detected": bool(fleet_s.stats["slow_detected"] >= 1),
+        "admissions_slow": int(fleet_s.replicas[0].admissions),
+        "admissions_fast": int(fleet_s.replicas[1].admissions),
+        "identical": bool(all(done_s[i].tokens == slow_base[i]
+                              for i in slow_base)),
+    }
+    print(json.dumps({"serve_resilience": "slow", **slow},
+                     allow_nan=False), flush=True)
+
+    # ---- crash then rejoin: the rejoiner re-enters the rotation with a
+    # FRESH page pool and actually serves (its new engine's own stats
+    # can only count post-rejoin work). Arrivals stretch PAST the rejoin
+    # tick so there is new work to route to it — per-request outputs are
+    # batch/arrival-independent (the engine's pinned streams), so the
+    # same baseline still judges identity.
+    fleet_r, done_r = fleet_run("replica_crash:0:2,replica_rejoin:0:6",
+                                arr={i: i for i in range(n_req)})
+    rep0 = fleet_r.replicas[0]
+    rejoin = {
+        "rejoined": bool(fleet_r.stats["replica_rejoins"] == 1),
+        "served_after_rejoin": bool(
+            rep0.engine is not None
+            and rep0.engine.stats["prefill_dispatches"] > 0),
+        "identical": bool(identical(done_r, base)),
+        "final_lifecycle": list(fleet_r.lifecycle()),
+    }
+    print(json.dumps({"serve_resilience": "rejoin", **rejoin},
+                     allow_nan=False), flush=True)
+
+    markers = {
+        "migrated_identity_greedy": all(r["identical"]
+                                        for r in crash_matrix),
+        "migrated_identity_sampled": identical(done_samp, base_samp),
+        "migrated_identity_speculative": identical(done_spec, base),
+        "migrated_identity_prefix_cache": identical(done_pc, base_pc),
+        "zero_token_loss": all(r["tokens_lost"] == 0
+                               for r in crash_matrix),
+        "drain_completes_residents": drain["identical"]
+        and drain["drained_departed"],
+        "slow_detected_and_routed": slow["detected"]
+        and slow["admissions_slow"] < slow["admissions_fast"],
+        "rejoin_serves": rejoin["rejoined"]
+        and rejoin["served_after_rejoin"] and rejoin["identical"],
+    }
+    markers = {k: bool(v) for k, v in markers.items()}
+    return {"markers": markers, "crash_matrix": crash_matrix,
+            "drain": drain, "slow": slow, "rejoin": rejoin}
+
+
 def main() -> int:
     from distributed_lion_tpu.parallel.mesh import force_cpu_platform
 
@@ -601,6 +776,8 @@ def main() -> int:
         model_name, args.family, args.quant, args.block_size, args.ticks,
         args.warmup, args.tp_batch,
         [int(t) for t in args.tps.split(",") if t], args.prefix_requests)
+    serve_resilience = bench_serve_resilience(
+        model_name, args.family, args.quant, args.block_size)
 
     doc = {
         "meta": {
@@ -620,6 +797,7 @@ def main() -> int:
         "bit_identity": bits,
         "speculative": spec,
         "tp_serving": tp_serving,
+        "serve_resilience": serve_resilience,
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "serving.json")
@@ -633,13 +811,16 @@ def main() -> int:
                          for k, v in spec["markers"].items()},
                       **{f"tp_{k}": v
                          for k, v in tp_serving["markers"].items()},
+                      **{f"sr_{k}": v
+                         for k, v in serve_resilience["markers"].items()},
                       "prefix_mem_ratio":
                           tp_serving["prefix"]["prefix_mem_ratio"],
                       "best_tokens_per_sec_per_chip": max(
                           r["tokens_per_sec_per_chip"] for r in decode_rows)},
                      allow_nan=False), flush=True)
     return 0 if (all(bits.values()) and all(spec["markers"].values())
-                 and all(tp_serving["markers"].values())) else 1
+                 and all(tp_serving["markers"].values())
+                 and all(serve_resilience["markers"].values())) else 1
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py serving        # paged-KV decode bench
     python scripts/check_evidence.py speculative    # draft/verify/commit
     python scripts/check_evidence.py tp_serving     # TP decode + prefix share
+    python scripts/check_evidence.py serve_resilience  # replica fault matrix
     python scripts/check_evidence.py elasticity     # live worker leave/join
     python scripts/check_evidence.py all
 
@@ -723,6 +724,61 @@ def tp_serving_ok(path: str = SERVE_ARTIFACT) -> bool:
     return True
 
 
+# the serve_resilience stage (ISSUE 14): the replica-plane section of
+# the SAME serving.json artifact (bench_serve writes it; runbook stage
+# 5l re-captures on chip) — (a) the whole artifact passes the strict
+# schema (validate_metrics: crash-matrix/slow/drain/rejoin rows per-row
+# validated), (b) ALL EIGHT live-recomputed markers hold (crash-migrated
+# outputs token-identical greedy/sampled/speculative/prefix-cache, zero
+# accepted-token loss, drain finishes residents and departs, the slow
+# replica is detected AND routed around, a rejoiner serves from a fresh
+# pool), (c) the crash matrix covers >= SERVE_RES_MIN_CRASH_TICKS cut
+# points, every row with tokens_lost == 0, identical, and at least one
+# actual migration, and (d) the slow leg's measured story holds: the
+# slow replica's p99 tick latency strictly above its clean peer's in the
+# same run (the latency watch had a real signal to act on).
+SERVE_RES_MIN_CRASH_TICKS = 3
+
+
+def serve_resilience_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    sec = doc.get("serve_resilience")
+    if not isinstance(sec, dict):
+        return False
+    marks = sec.get("markers", {})
+    for k in ("migrated_identity_greedy", "migrated_identity_sampled",
+              "migrated_identity_speculative",
+              "migrated_identity_prefix_cache", "zero_token_loss",
+              "drain_completes_residents", "slow_detected_and_routed",
+              "rejoin_serves"):
+        if marks.get(k) is not True:
+            return False
+    rows = sec.get("crash_matrix", [])
+    if len({r.get("crash_tick") for r in rows}) < SERVE_RES_MIN_CRASH_TICKS:
+        return False  # 'crash at any tick' needs more than one cut point
+    for r in rows:
+        if r.get("tokens_lost") != 0 or r.get("identical") is not True:
+            return False
+    if not any(r.get("migrated", 0) > 0 for r in rows):
+        return False  # a matrix where nothing migrated proved nothing
+    slow = sec.get("slow", {})
+    if not (isinstance(slow.get("p99_ms_slow_replica"), (int, float))
+            and isinstance(slow.get("p99_ms_clean_replica"), (int, float))
+            and slow["p99_ms_slow_replica"] > slow["p99_ms_clean_replica"]):
+        return False
+    return True
+
+
 # the live-elasticity stage (ISSUE 10): scripts/bench_elasticity.py's
 # artifact under runs/elasticity — (a) passes the strict elasticity.json
 # schema (validate_metrics, loaded by FILE PATH so this script stays
@@ -810,6 +866,7 @@ STAGES = [
     ("serving", serving_ok),
     ("speculative", speculative_ok),
     ("tp_serving", tp_serving_ok),
+    ("serve_resilience", serve_resilience_ok),
     ("elasticity", elasticity_ok),
 ]
 
@@ -883,6 +940,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return speculative_ok(arg or SERVE_ARTIFACT)
     if what == "tp_serving":
         return tp_serving_ok(arg or SERVE_ARTIFACT)
+    if what == "serve_resilience":
+        return serve_resilience_ok(arg or SERVE_ARTIFACT)
     if what == "elasticity":
         return elasticity_ok(arg or ELASTICITY_ARTIFACT)
     if what == "all":

@@ -38,9 +38,11 @@ the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
 rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
-bit-identity/speculative-frontier/tp_serving document, per-row validated
-the same way incl. accept_rate ∈ [0,1] on every frontier row and the
-TP-degree + shared-prefix rows of the ISSUE 13 section), and the
+bit-identity/speculative-frontier/tp_serving/serve_resilience document,
+per-row validated the same way incl. accept_rate ∈ [0,1] on every
+frontier row, the TP-degree + shared-prefix rows of the ISSUE 13
+section and the crash-matrix/slow/drain/rejoin rows of the ISSUE 14
+replica-plane section), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
 document; timeline rows are strict-validated per row).
@@ -201,7 +203,7 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
     decode; sampled speculative == the same per-request PRNG stream)."""
     errors = []
     for key in ("meta", "decode", "prefill_share", "bit_identity",
-                "speculative", "tp_serving"):
+                "speculative", "tp_serving", "serve_resilience"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -323,6 +325,76 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
             if not (_finite_number(ratio) and ratio > 0):
                 errors.append(f"{path}: tp_serving.prefix.prefix_mem_ratio "
                               "must be a finite positive number")
+    sr = doc.get("serve_resilience")
+    if sr is not None and not isinstance(sr, dict):
+        errors.append(f"{path}: 'serve_resilience' must be an object")
+    elif isinstance(sr, dict):
+        marks = sr.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: serve_resilience.markers must be an "
+                          "object")
+        else:
+            for k in ("migrated_identity_greedy",
+                      "migrated_identity_sampled",
+                      "migrated_identity_speculative",
+                      "migrated_identity_prefix_cache",
+                      "zero_token_loss", "drain_completes_residents",
+                      "slow_detected_and_routed", "rejoin_serves"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(
+                        f"{path}: serve_resilience.markers.{k} must be a "
+                        "bool")
+        rows = sr.get("crash_matrix")
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: serve_resilience.crash_matrix must be "
+                          "a non-empty list")
+            rows = []
+        for i, row in enumerate(rows):
+            where = f"{path}: serve_resilience.crash_matrix[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where} is not an object")
+                continue
+            for k in ("crash_tick", "migrated", "tokens_lost",
+                      "recovery_latency_ticks"):
+                if not (isinstance(row.get(k), int)
+                        and not isinstance(row.get(k), bool)
+                        and row[k] >= 0):
+                    errors.append(f"{where}.{k} must be a non-negative int")
+            if not isinstance(row.get("identical"), bool):
+                errors.append(f"{where}.identical must be a bool")
+        slow = sr.get("slow")
+        if not isinstance(slow, dict):
+            errors.append(f"{path}: serve_resilience.slow must be an "
+                          "object")
+        else:
+            for k in ("p99_ms_slow_replica", "p99_ms_clean_replica",
+                      "p99_ms_clean_run"):
+                if not _finite_number(slow.get(k)):
+                    errors.append(f"{path}: serve_resilience.slow.{k} is "
+                                  "not finite")
+            for k in ("slow_ms", "admissions_slow", "admissions_fast"):
+                if not (isinstance(slow.get(k), int)
+                        and not isinstance(slow.get(k), bool)
+                        and slow[k] >= 0):
+                    errors.append(f"{path}: serve_resilience.slow.{k} must "
+                                  "be a non-negative int")
+            for k in ("detected", "identical"):
+                if not isinstance(slow.get(k), bool):
+                    errors.append(f"{path}: serve_resilience.slow.{k} must "
+                                  "be a bool")
+        for section, bool_keys in (
+                ("drain", ("identical", "drained_departed")),
+                ("rejoin", ("rejoined", "served_after_rejoin",
+                            "identical"))):
+            sec = sr.get(section)
+            if not isinstance(sec, dict):
+                errors.append(f"{path}: serve_resilience.{section} must be "
+                              "an object")
+                continue
+            for k in bool_keys:
+                if not isinstance(sec.get(k), bool):
+                    errors.append(f"{path}: serve_resilience.{section}.{k} "
+                                  "must be a bool")
     return errors
 
 

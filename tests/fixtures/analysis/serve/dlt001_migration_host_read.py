@@ -1,0 +1,37 @@
+"""Fixture: the migration path's one forbidden shortcut — PER-TOKEN host
+reads inside a jitted migration re-prefill (replaying a migrated
+request's committed history by host-reading each token's logits/draw
+inside the compiled dispatch would pay len(committed) device→host round
+trips per migration and serialize the survivor's whole rolling batch).
+The real path (serve/engine._admit via serve/replica_plane) prefills the
+committed history as ONE bucketed dispatch and host-reads exactly one
+sampled token at the dispatch boundary — the resumed stream's first draw.
+Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py), path-scoped under fixtures/analysis/serve/
+like the other serving fixtures."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def migration_reprefill(params, pages, tables, committed, start):
+    logits = (params["w"] * committed[:, None]).sum(-1)
+    resumed = int(committed[0])   # DLT001: per-committed-token host read
+    #                               inside the jitted re-prefill
+    if float(logits.max()) > 0:   # DLT001: host-side resume branch in the
+        start = start + 1         # compiled dispatch
+    return logits, start, resumed
+
+
+def host_migration(fleet, record):
+    # NOT traced scope: the recovery record is host state — building the
+    # resumption Request (prompt + committed + seed) is pure list math,
+    # and the one host read happens at the prefill dispatch boundary
+    return record.to_request()
+
+
+def boundary_faults(tick):
+    # NOT traced scope: the serve fault schedule is consumed between
+    # fleet ticks (resilience.consume_due), never inside a dispatch
+    sink = jnp.zeros((int(tick),))
+    return sink

@@ -273,6 +273,24 @@ def test_tp_serve_fixtures_and_serve_parallel_modules_clean():
             assert lint.lint_file(path) == [], f"{sub}/{name}"
 
 
+def test_migration_fixture_and_replica_plane_clean():
+    """ISSUE 14 satellite: a migration re-prefill must never host-read
+    per committed token — replaying a migrated request's history with an
+    `int(tok)`/logits branch inside the jitted dispatch pays
+    len(committed) round trips per migration and serializes the
+    survivor's batch. The fixture shows the forbidden shape (DLT001 fires
+    twice); serve/replica_plane.py (pure host-side scheduling) and the
+    engine's resumption path lint zero-finding by file path — the real
+    re-prefill is ONE bucketed dispatch with one boundary host read."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt001_migration_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for rel in ("serve/replica_plane.py", "serve/engine.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel
+
+
 def test_speculate_fixture_and_module_clean():
     """ISSUE 11 satellite: the speculative verify dispatch must never
     host-read per DRAFT token — an `int(accept[i])` acceptance branch

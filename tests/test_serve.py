@@ -238,8 +238,23 @@ def test_block_tables_refcount_fuzz_vs_reference():
 
     for _ in range(600):
         op = rng.choice(["grow", "shrink", "free", "share", "cow",
-                         "cache_ref", "cache_drop"])
+                         "cache_ref", "cache_drop", "crash"])
         s = int(rng.integers(0, 4))
+        if op == "crash":
+            # mid-fuzz replica crash (ISSUE 14): a random subset of slots
+            # — the dead replica's residents — mass-free at once, the way
+            # a migration releases them. Pages the SURVIVORS still hold
+            # (other slots' shared runs, the cache's refs) must survive
+            # the mass free; the post-op check pins exact refcounts, no
+            # live page on the free list, and page conservation.
+            victims = [v for v in range(4) if rng.integers(0, 2)]
+            for v in victims:
+                for p in slot_pages[v]:
+                    refs[p] -= 1
+                bt.free_slot(v)
+                slot_pages[v] = []
+            check()
+            continue
         if op == "grow":
             n = int(rng.integers(1, bt.max_blocks_per_seq * bt.block_size))
             before = [int(p) for p in bt.tables[s, :bt.owned[s]]]

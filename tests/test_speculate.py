@@ -177,7 +177,7 @@ class _ScriptedDrafter:
     def __init__(self, k, script, wrong_at=1):
         self.k, self.script, self.wrong_at = k, dict(script), wrong_at
 
-    def admit(self, slot, tokens):
+    def admit(self, slot, tokens, n_committed=0):
         pass
 
     def evict(self, slot):
