@@ -40,6 +40,12 @@ class GenerateArguments:
     top_p: Optional[float] = None  # nucleus sampling mass (e.g. 0.95)
     seed: int = 0
     vocab_size: Optional[int] = None
+    moe_experts: int = 0  # > 0: the checkpoint is Switch-MoE (gpt2 only;
+    # must match the training --moe_experts/--moe_every — model.npz holds
+    # no config stamp, and the serve engine's expert-parallel and
+    # capacity-aware paths key off the declared config). HF-dir
+    # checkpoints ignore it (no MoE export format).
+    moe_every: int = 2
 
 
 def _is_hf_dir(path: Optional[str]) -> bool:
@@ -94,9 +100,12 @@ def build(args: GenerateArguments):
             GPT2Config, gpt2_decode, gpt2_init, gpt2_init_cache,
         )
 
+        moe_kw = ({"moe_experts": args.moe_experts,
+                   "moe_every": args.moe_every}
+                  if args.moe_experts > 0 else {})
         cfg = hf_cfg or (
             GPT2Config.tiny if args.model_name == "tiny" else GPT2Config.gpt2_124m
-        )(vocab_size=vocab)
+        )(vocab_size=vocab, **moe_kw)
         params = (hf_params if hf_params is not None
                   else load_pytree(args.model_path) if args.model_path
                   else gpt2_init(jax.random.key(args.seed), cfg))

@@ -16,9 +16,11 @@ a smoke mode mirroring run_generate. ``--journal_dir`` records
 
 ``--serve_tp N`` shards the decode path (weights per the Megatron specs,
 page pools over kv heads) across the first N local devices — how the
-NF4 Llama-2-7B artifact serves on a v5e slice (ISSUE 13); ``--prefix_cache``
-shares prompt-prefix KV pages across requests with copy-on-write
-semantics. Both are pinned output-identical to the plain engine.
+NF4 Llama-2-7B artifact serves on a v5e slice (ISSUE 13); ``--serve_ep N``
+shards a MoE checkpoint's expert banks over the expert axis (composes
+with --serve_tp, ISSUE 15); ``--prefix_cache`` shares prompt-prefix KV
+pages across requests with copy-on-write semantics. All are pinned
+output-identical to the plain engine.
 
 ``--replicas N`` serves through the elastic fleet
 (serve/replica_plane.py, ISSUE 14): N engines over the one loaded
@@ -57,11 +59,19 @@ class ServeArguments:
     # pools over kv heads across the first N local devices, one
     # shard_map'd dispatch per tick. tp=1 is pinned bit-identical to the
     # single-device engine; heads/kv-heads/d_ff must divide N.
+    serve_ep: int = 0                # expert-parallel serving degree
+    # (ISSUE 15): 0 = no expert axis; N >= 1 needs a MoE checkpoint
+    # (moe_experts % N == 0) and shards the expert FFN banks over the
+    # expert axis of a (data=1, expert=N, tensor=max(tp,1)) mesh — two
+    # all_to_all hops per MoE block per tick, page pools untouched.
+    # Composes with --serve_tp (N x tp devices). ep=1 is pinned
+    # bit-identical to the unsharded engine; ep>1 token-identical.
     prefix_cache: bool = False       # share prompt-prefix KV pages across
     # requests (copy-on-write block tables, serve/kv_cache.PrefixCache):
     # N requests carrying the same system prompt hold ONE physical copy
-    # of its pages. Outputs pinned identical to the unshared engine.
-    # Refused for MoE checkpoints (shared capacity accounting unproven).
+    # of its pages. Outputs pinned identical to the unshared engine —
+    # MoE checkpoints included (no-drop per-token inference routing means
+    # shared pages cannot change any expert assignment).
     speculate: str = ""              # '<drafter>:<k>' — speculative decode
     # (serve/speculate.py): 'ngram:4' self-drafts from each request's own
     # history (zero extra device memory); 'draft:2' proposes with a small
@@ -119,17 +129,6 @@ def build_engine_factory(gen_args, serve_args: "ServeArguments"):
                 "pays the draft dispatch plus the k+1-wide verify for "
                 "nothing, silently slower than plain decode)")
     tok, cfg, params, _, _ = build(gen_args)
-    if serve_args.prefix_cache and getattr(cfg, "moe_experts", 0) > 0:
-        # the engine refuses MoE wholesale already (ServeModel build);
-        # name the prefix-cache-specific reason FIRST so the operator
-        # learns which flag to drop — same loud family as the PR 9 gates
-        raise ValueError(
-            "--prefix_cache is not supported for MoE checkpoints: shared "
-            "prefix pages change how many real tokens reach each expert's "
-            "fixed-capacity buffer across sharers, and that capacity "
-            "accounting is unproven — serve the MoE checkpoint without "
-            "--prefix_cache (and without the paged engine, which refuses "
-            "MoE outright)")
     model = as_serve_model(params, cfg)
     draft_model = None
     if serve_args.speculate.startswith("draft"):
@@ -150,7 +149,8 @@ def build_engine_factory(gen_args, serve_args: "ServeArguments"):
         temperature=gen_args.temperature, top_k=gen_args.top_k,
         top_p=gen_args.top_p, quant=serve_args.quant,
         quant_block=serve_args.quant_block,
-        tp=serve_args.serve_tp, prefix_cache=serve_args.prefix_cache,
+        tp=serve_args.serve_tp, ep=serve_args.serve_ep,
+        prefix_cache=serve_args.prefix_cache,
         speculate=serve_args.speculate,
         eos_id=getattr(tok, "eos_id", None))
 

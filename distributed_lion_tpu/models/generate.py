@@ -97,7 +97,11 @@ def generate(decode_fn, init_cache_fn, params, prompt: jnp.ndarray,
     from the first REAL token, so each row ATTENDS with solo semantics
     (greedy outputs match solo runs exactly; sampled draws still share
     one PRNG stream over the batch — per-request streams are the serving
-    engine's job, serve/engine.py).
+    engine's job, serve/engine.py). MoE checkpoints compose (ISSUE 15 —
+    the PR 9 refusal lifted): pad lanes are valid-masked out of expert
+    routing and inference routing is no-drop per-token
+    (models/gpt2._decode_mlp), so batched greedy MoE output equals solo
+    runs too (tests/test_moe_serve.py pins it).
     """
     B, T = prompt.shape
     total = max_len or (T + max_new_tokens)

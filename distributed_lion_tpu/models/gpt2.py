@@ -553,24 +553,43 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos, offset=None):
     return out, {"k": k_cache, "v": v_cache}
 
 
-def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None):
+def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None, valid=None,
+                ep_axis=None, moe_stats=None):
     """The post-attention half of a decode block (dense MLP or the MoE
     FFN with decode-friendly capacity) — shared by the dense-cache and
     paged decode paths so their numerics cannot drift. ``tp_axis`` runs
-    the dense MLP Megatron-split (the TP serving engine; MoE checkpoints
-    are refused upstream of every paged/TP path)."""
-    if "moe" in p:  # MoE checkpoint: single-device routing, no collectives
+    the dense MLP (and, with ``ep_axis``×tp, each expert's FFN)
+    Megatron-split — the TP serving engine's path.
+
+    MoE at inference is NO-DROP: ``capacity_override = B*S`` for every
+    decode-path call (single-token ticks AND prefill/verify windows), so
+    routing is an exact per-token function — no batchmate, padding bucket
+    or speculation window can displace another token's expert slot. That
+    is what makes paged==dense, batched==solo and speculative==plain hold
+    bit-for-bit for MoE (training keeps the Switch capacity bound; the
+    inference trade is a [E, B*S, D] dispatch buffer — bounded by the
+    page-geometry bucket, ephemeral, and tiny next to the KV pages).
+    ``valid`` ([B, S] bool) masks pad/sentinel lanes out of routing
+    (parallel/expert.moe_ffn) so dead lanes consume zero expert capacity;
+    ``ep_axis`` shards the expert banks over the serving mesh's expert
+    axis (two all_to_all hops); ``moe_stats`` (a list) collects this
+    block's routing-load scalars when the engine benchmarks capacity
+    utilization."""
+    if "moe" in p:
         from distributed_lion_tpu.parallel.expert import moe_ffn
 
         B2, S2, D2 = x.shape
         h = _layer_norm(x, p["ln_2"]).reshape(B2 * S2, D2)
-        # single-token decode steps (S=1) get no-drop capacity (a cap of
-        # ~B*1.25/E would drop colliding tokens systematically); prefill
-        # keeps the training capacity bound — cap=n there would size
-        # every expert's buffer to the full prompt (E x the memory)
-        y, _ = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                       axis_name=None,
-                       capacity_override=B2 * S2 if S2 == 1 else None)
+        v = None if valid is None else valid.reshape(B2 * S2)
+        out = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
+                      axis_name=ep_axis, capacity_override=B2 * S2,
+                      tp_axis=tp_axis, valid=v,
+                      return_stats=moe_stats is not None)
+        if moe_stats is not None:
+            y, _, st = out
+            moe_stats.append(st)
+        else:
+            y, _ = out
         return x + y.reshape(B2, S2, D2)
     return x + _mlp(_layer_norm(x, p["ln_2"]), p["mlp"], tp_axis)
 
@@ -613,21 +632,22 @@ def gpt2_decode(params: dict, tokens: jnp.ndarray, cfg: GPT2Config, cache: list,
     logits position-for-position (pinned by tests/test_generate.py).
     ``offset`` [B]: per-row left-pad width for batched variable-length
     prompts — row b's real tokens sit at slots >= offset[b] and get
-    position ids ``slot - offset[b]`` (solo semantics, shifted)."""
-    if offset is not None and any("moe" in p for p in params["blocks"]):
-        # left-pad tokens would be routed and consume expert capacity,
-        # displacing real tokens a solo run keeps — the batched outputs
-        # would silently diverge from solo runs
-        raise ValueError(
-            "left-padded batched decode is not supported for MoE "
-            "checkpoints (pad tokens would consume expert capacity); "
-            "generate MoE prompts one at a time")
+    position ids ``slot - offset[b]`` (solo semantics, shifted). MoE
+    checkpoints compose with the offset path: the left-pad lanes are
+    masked out of expert routing (``valid`` below) and inference routing
+    is no-drop per-token (see _decode_mlp), so batched greedy output
+    equals solo runs for MoE exactly as it does for dense models."""
+    valid = None
+    if offset is not None:
+        # lane (b, s) sits at absolute cache slot pos + s; slots below the
+        # row's left-pad width are dead lanes for expert routing
+        valid = (pos + jnp.arange(tokens.shape[1]))[None, :] >= offset[:, None]
     x = _decode_embed(params, tokens, cfg, pos, offset)
     new_cache = []
     for p, c in zip(params["blocks"], cache):
         a, c = _decode_attention(_layer_norm(x, p["ln_1"]), p["attn"], cfg, c,
                                  pos, offset)
-        x = _decode_mlp(x + a, p, cfg)
+        x = _decode_mlp(x + a, p, cfg, valid=valid)
         new_cache.append(c)
     x = _layer_norm(x, params["ln_f"])
     return _tied_logits(x, params, cfg), new_cache
@@ -668,7 +688,8 @@ def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid,
 
 def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
                       pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
-                      valid=None, tp_axis=None):
+                      valid=None, tp_axis=None, ep_axis=None,
+                      return_moe_stats=False):
     """Block-table decode (the serving engine's model hook): ``tokens``
     [B, S] where row b's tokens sit at absolute positions
     ``pos[b] .. pos[b]+S-1`` of its own sequence; ``pages`` is the
@@ -683,24 +704,35 @@ def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
     attention/MLP weights and the page pool's kv-head axis are expected
     pre-sharded per ``parallel.tensor_parallel.gpt2_param_specs``;
     embeddings and the tied head stay replicated, so the returned logits
-    are identical on every tensor rank."""
-    if any("moe" in p for p in params["blocks"]):
-        # see ServeModel.for_gpt2: a padded prefill routes pad tokens
-        # through expert capacity, silently breaking bit-identity
-        raise ValueError(
-            "paged decode does not support MoE checkpoints yet (pad tokens "
-            "would consume expert capacity in the bucketed prefill)")
+    are identical on every tensor rank.
+
+    MoE checkpoints serve through this path (ISSUE 15 — the PR 9 refusal
+    lifted): ``valid`` masks pad/sentinel lanes out of expert routing and
+    inference routing is no-drop (see _decode_mlp), so paged MoE decode
+    is bit-identical to the dense-KV MoE path at matched attended length.
+    ``ep_axis`` (inside the serving engine's shard_map) shards the expert
+    banks over the mesh's expert axis — two all_to_all hops per MoE block,
+    the page pools untouched. ``return_moe_stats`` additionally returns a
+    dict of routing-load scalars summed over the MoE blocks (the bench's
+    capacity-utilization columns; {} for a dense checkpoint)."""
     pos_ids = jnp.clip(pos[:, None] + jnp.arange(tokens.shape[1])[None, :],
                        0, cfg.n_ctx - 1)
     from distributed_lion_tpu.models.lora import lora_embed
 
     x = lora_embed(params["wte"], tokens, cfg.compute_dtype)
     x = x + lora_embed(params["wpe"], pos_ids, cfg.compute_dtype)
+    stats = [] if return_moe_stats else None
     new_pages = []
     for p, c in zip(params["blocks"], pages):
         a, c = _paged_attention_block(_layer_norm(x, p["ln_1"]), p["attn"],
                                       cfg, c, tables, pos, valid, tp_axis)
-        x = _decode_mlp(x + a, p, cfg, tp_axis)
+        x = _decode_mlp(x + a, p, cfg, tp_axis, valid, ep_axis, stats)
         new_pages.append(c)
     x = _layer_norm(x, params["ln_f"])
-    return _tied_logits(x, params, cfg), new_pages
+    logits = _tied_logits(x, params, cfg)
+    if return_moe_stats:
+        agg = ({k: sum(s[k] for s in stats)
+                for k in ("valid", "kept", "capacity_slots")}
+               if stats else {})
+        return logits, new_pages, agg
+    return logits, new_pages

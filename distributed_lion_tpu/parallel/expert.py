@@ -77,6 +77,8 @@ def moe_ffn(
     axis_name: Optional[str] = EXPERT_AXIS,
     capacity_override: Optional[int] = None,
     tp_axis: Optional[str] = None,
+    valid: Optional[jnp.ndarray] = None,
+    return_stats: bool = False,
 ):
     """Apply the MoE FFN to local tokens ``x [N, D]``.
 
@@ -92,22 +94,58 @@ def moe_ffn(
     see the full D on every tensor rank (x is replicated over tensor), so
     the gate decisions and the expert all_to_all are identical across tp.
 
-    Returns ``(y [N, D], aux_loss scalar)``; add ``aux`=0.01*aux_loss`` to
-    the train loss to balance expert load (Switch Transformer recipe).
+    ``valid`` (optional ``[N]`` bool) marks the lanes that carry real
+    tokens — the serving engine's pad/sentinel lanes (right-padded bucketed
+    prefill tails, inactive decode slots, left-pad offsets in batched
+    generate) pass False. Invalid lanes are masked out of the gate
+    assignment BEFORE the capacity one-hot, so a dead lane never occupies
+    an expert-capacity slot and never perturbs which real tokens get
+    dropped: a padded batch's routed assignment for its real tokens equals
+    the unpadded batch's assignment at the same capacity (pinned by
+    tests/test_moe_serve.py), and invalid lanes produce exact-zero output
+    rows. ``valid=None`` (training) keeps every lane, bit-identical to the
+    pre-mask code path.
+
+    ``return_stats`` additionally returns a dict of routing-load scalars
+    measured over the VALID lanes against the ``capacity_factor`` budget
+    ``capacity(n, E, capacity_factor)`` — regardless of any
+    ``capacity_override`` in effect, so the serving engine's no-drop
+    override still reports how its traffic loads the Switch capacity
+    budget: ``valid`` (real lanes routed), ``kept`` (of those, how many
+    fit the per-expert budget), ``capacity_slots`` (E × budget). All f32
+    scalars computable on-device with zero host syncs.
+
+    Returns ``(y [N, D], aux_loss scalar)`` (plus the stats dict when
+    requested); add ``aux`=0.01*aux_loss`` to the train loss to balance
+    expert load (Switch Transformer recipe).
     """
+    # NF4/int8 frozen-weight serving (ops/quant): QuantizedTensor expert
+    # banks dequantize into their einsum's producer fusion; dense leaves
+    # (and every training call) pass through maybe_dequant untouched.
+    # Dequant FIRST: under shard_map a quantized leaf's static .shape is
+    # the GLOBAL shape, while the dequantized array has this shard's
+    # local expert count — the only honest source for e_local.
+    from distributed_lion_tpu.ops.quant import maybe_dequant
+
+    w_in = maybe_dequant(params["w_in"], x.dtype)
+    w_out = maybe_dequant(params["w_out"], x.dtype)
+    b_in = maybe_dequant(params["b_in"], x.dtype)
+    b_out = maybe_dequant(params["b_out"], x.dtype)
+
     n, d = x.shape
     ep = 1 if axis_name is None else lax.psum(1, axis_name)
-    e_local = params["w_in"].shape[0]
+    e_local = w_in.shape[0]
     n_experts = e_local * ep
     # capacity_override: incremental decode calls with tiny per-step token
     # counts (n = batch) would otherwise compute cap ≈ 1 and systematically
     # drop colliding tokens that training/prefill (n = B*T) never drops —
-    # decode passes cap = n so no token is ever dropped at generation time.
+    # the decode paths pass cap = n so no token is ever dropped at
+    # generation time (models/gpt2._decode_mlp documents the trade).
     cap = (capacity_override if capacity_override is not None
            else capacity(n, n_experts, capacity_factor))
 
     # --- route (every device scores the full expert set) ---
-    logits = x @ params["gate"]  # [N, E]
+    logits = x @ maybe_dequant(params["gate"], x.dtype)  # [N, E]
     probs = jax.nn.softmax(logits, axis=-1)
     expert_idx = jnp.argmax(probs, axis=-1)  # [N]
     gate_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
@@ -117,6 +155,11 @@ def moe_ffn(
     # ranks once an expert sees > 256 local tokens (tokens silently summed
     # into one dispatch slot). Only the final masks are cast to x.dtype.
     one_hot_i = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.int32)  # [N, E]
+    if valid is not None:
+        # dead lanes leave the assignment BEFORE the capacity cumsum: they
+        # take no queue position, so real tokens' slots (and therefore
+        # which real tokens overflow) are exactly the unpadded batch's
+        one_hot_i = one_hot_i * valid.astype(jnp.int32)[:, None]
     pos = jnp.cumsum(one_hot_i, axis=0) * one_hot_i - 1  # slot in expert queue
     keep = (pos >= 0) & (pos < cap)
     slot = jax.nn.one_hot(pos.max(axis=-1), cap, dtype=x.dtype)  # [N, C]
@@ -124,9 +167,29 @@ def moe_ffn(
     mask = one_hot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None].astype(x.dtype)
 
     # --- load-balance aux loss (computed on pre-drop assignments) ---
-    frac_tokens = one_hot_i.astype(jnp.float32).mean(axis=0)  # [E]
-    frac_probs = probs.mean(axis=0)
+    if valid is None:
+        frac_tokens = one_hot_i.astype(jnp.float32).mean(axis=0)  # [E]
+        frac_probs = probs.mean(axis=0)
+    else:
+        # averages over the REAL lanes only — pads must not dilute the
+        # load estimate (inference-only today, but the mask must not make
+        # the auxiliary silently wrong if it is ever consumed)
+        v32 = valid.astype(jnp.float32)
+        nv = jnp.maximum(v32.sum(), 1.0)
+        frac_tokens = one_hot_i.astype(jnp.float32).sum(axis=0) / nv
+        frac_probs = (probs * v32[:, None]).sum(axis=0) / nv
     aux = n_experts * jnp.sum(frac_tokens * frac_probs)
+
+    stats = None
+    if return_stats:
+        budget = capacity(n, n_experts, capacity_factor)
+        counts = one_hot_i.sum(axis=0).astype(jnp.float32)  # [E] real lanes
+        kept = jnp.minimum(counts, jnp.float32(budget)).sum()
+        stats = {
+            "valid": counts.sum(),
+            "kept": kept,
+            "capacity_slots": jnp.float32(n_experts * budget),
+        }
 
     # --- dispatch: [E, C, D] buffers, tokens in their expert's slots ---
     dispatch = jnp.einsum("nec,nd->ecd", mask, x)
@@ -149,16 +212,15 @@ def moe_ffn(
 
         dispatch = copy_to_tp_region(dispatch, tp_axis)
     h = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", dispatch, params["w_in"])
-        + params["b_in"][:, None, :]
+        jnp.einsum("ecd,edf->ecf", dispatch, w_in) + b_in[:, None, :]
     )
-    out = jnp.einsum("ecf,efd->ecd", h, params["w_out"])
+    out = jnp.einsum("ecf,efd->ecd", h, w_out)
     if tp_axis is not None:
         # g-operator: row-parallel partials psum to the full output; b_out
         # is replicated over tensor and must be added exactly once — AFTER
         # the psum (adding per rank would scale it by tp)
         out = reduce_from_tp_region(out, tp_axis)
-    out = out + params["b_out"][:, None, :]
+    out = out + b_out[:, None, :]
 
     if axis_name is not None and ep > 1:
         # inverse: [E_local, S*C, D] -> [E, C, D] back on the token's shard
@@ -168,4 +230,6 @@ def moe_ffn(
 
     # --- combine: weight each token's slot by its gate probability ---
     y = jnp.einsum("nec,ecd->nd", mask * gate_p[:, None, None], out)
+    if return_stats:
+        return y, aux, stats
     return y, aux

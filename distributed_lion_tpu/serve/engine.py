@@ -46,6 +46,24 @@ bit-for-bit the pre-TP engine; ``tp=1`` runs the sharded program on a
 HBM per chip and is pinned token-identical on CPU mesh emulation
 (tests/test_tp_serve.py).
 
+**Expert-parallel MoE serving** (``ServeConfig.ep`` — ISSUE 15, the PR 9
+refusals lifted): MoE checkpoints serve through the paged engine. Pad and
+sentinel lanes carry a ``valid`` mask into expert routing
+(parallel/expert.moe_ffn) so they consume zero expert capacity, and
+inference routing is NO-DROP (models/gpt2._decode_mlp) — an exact
+per-token function, which is what makes paged MoE decode bit-identical to
+the dense-KV MoE path, batched identical to solo, and the prefix-cache /
+n-gram-speculation compositions hold unchanged. ``ep >= 1`` shards the
+expert FFN banks over the expert axis of a ``(data=1, expert=ep,
+tensor=max(tp,1))`` mesh via the SAME ``moe_param_specs`` trees the
+trainer uses — two ``all_to_all`` hops per MoE block per tick, page pools
+untouched (attention stays shard-local exactly as TP left it). NF4/int8
+expert banks shard with the dense specs. ``ep=1`` is pinned bit-identical
+to the unsharded program; ``ep in {2,4}`` and ep×tp are pinned
+token-identical on CPU mesh emulation (tests/test_moe_serve.py).
+``draft:<k>`` speculation keeps its loud MoE refusal (the mirror-pool
+residual, serve/speculate.py).
+
 **Prefix sharing** (``ServeConfig.prefix_cache``): a prompt-prefix →
 page-run cache with per-page refcounts (serve/kv_cache.PrefixCache). An
 admitted request shares the cached pages covering its prompt prefix (one
@@ -107,7 +125,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+from distributed_lion_tpu.parallel.mesh import EXPERT_AXIS, TENSOR_AXIS
 from distributed_lion_tpu.serve.kv_cache import (
     BlockTables,
     PrefixCache,
@@ -151,11 +169,27 @@ class ServeConfig:
     # per chip and is pinned token-identical (tests/test_tp_serve.py).
     # kv_heads/n_head/d_ff must divide (parallel.tensor_parallel.
     # validate_tp — the same rule the trainer enforces).
+    ep: int = 0                  # expert-parallel serving degree
+    # (ISSUE 15): 0 = no expert axis. N >= 1 requires a MoE checkpoint
+    # (moe_experts % N == 0) and shards the expert FFN banks over the
+    # expert axis of a (data=1, expert=N, tensor=max(tp,1)) mesh — two
+    # all_to_all hops per MoE block per tick, page pools untouched
+    # (attention stays shard-local exactly as TP left it; under ep-only
+    # the pools are replicated). Composes with tp (ep x tp devices).
+    # ep=1 is pinned bit-identical to the unsharded engine; ep in {2,4}
+    # (and ep x tp) pinned token-identical on CPU mesh emulation
+    # (tests/test_moe_serve.py).
+    moe_stats: bool = False      # accumulate MoE routing-load scalars
+    # (valid/kept tokens vs the capacity_factor budget) into engine.stats
+    # after every dispatch — the bench's capacity-utilization and
+    # dropped-rate columns. Off by default: it adds per-tick host reads.
     prefix_cache: bool = False   # share prompt-prefix KV pages across
     # requests (serve/kv_cache.PrefixCache): refcounted page runs, CoW on
     # the first divergent write, LRU reclaim under pool pressure. Outputs
     # pinned identical to the unshared engine; only the physical page
-    # count (and the prefill work for cache hits) changes.
+    # count (and the prefill work for cache hits) changes. Composes with
+    # MoE checkpoints: inference routing is no-drop per-token, so shared
+    # prefix pages cannot change any expert assignment.
     speculate: str = ""          # '' = one token per decode tick;
     # '<drafter>:<k>' (ngram:4 | draft:2 ...) arms speculative decode
     # (serve/speculate.py): the drafter proposes up to k tokens per slot,
@@ -259,11 +293,13 @@ class _Slot:
 class ServeModel:
     """Family adapter: the paged decode hook + cache geometry the engine
     needs, built from a (params, config) pair. ``decode_paged(params,
-    tokens, pages, tables, pos, valid, tp_axis)`` must return ``(logits
-    [B,S,V] f32, pages')`` — models/gpt2.gpt2_decode_paged and
-    models/llama.llama_decode_paged are the two implementations; with
-    ``tp_axis`` the call runs inside the engine's shard_map and the hook
-    threads the axis into the model's Megatron-split blocks."""
+    tokens, pages, tables, pos, valid, tp_axis, ep_axis,
+    return_moe_stats)`` must return ``(logits [B,S,V] f32, pages')``
+    (plus a MoE routing-stats dict when requested) —
+    models/gpt2.gpt2_decode_paged and models/llama.llama_decode_paged
+    are the two implementations; with ``tp_axis``/``ep_axis`` the call
+    runs inside the engine's shard_map and the hook threads the axes
+    into the model's Megatron-split blocks / expert banks."""
 
     def __init__(self, family: str, cfg: Any, params: Any,
                  decode_paged: Callable, n_layer: int, kv_heads: int,
@@ -282,10 +318,18 @@ class ServeModel:
         # engine refuses a page geometry that would silently alias/exceed
         self.max_positions = max_positions
 
-    def param_specs(self) -> dict:
+    def param_specs(self, tensor: bool = True) -> dict:
         """The Megatron PartitionSpec tree for this family — ONE source of
-        truth with the trainer (parallel/tensor_parallel), so serving and
-        training can never shard the same checkpoint differently."""
+        truth with the trainer (parallel/tensor_parallel and, for MoE
+        checkpoints, models/gpt2.gpt2_moe_param_specs which reuses it), so
+        serving and training can never shard the same checkpoint
+        differently. ``tensor=False`` (an expert-only serving mesh) keeps
+        attention/dense-MLP leaves replicated and shards only the expert
+        banks over the expert axis."""
+        if self.family == "gpt2" and getattr(self.cfg, "moe_experts", 0) > 0:
+            from distributed_lion_tpu.models.gpt2 import gpt2_moe_param_specs
+
+            return gpt2_moe_param_specs(self.cfg, tensor=tensor)
         from distributed_lion_tpu.parallel.tensor_parallel import (
             gpt2_param_specs,
             llama_param_specs,
@@ -298,21 +342,11 @@ class ServeModel:
     def for_gpt2(params: Any, cfg: Any) -> "ServeModel":
         from distributed_lion_tpu.models.gpt2 import gpt2_decode_paged
 
-        if getattr(cfg, "moe_experts", 0) > 0:
-            # a bucketed (right-padded) prefill would route pad tokens
-            # through the experts' fixed-capacity buffers, displacing real
-            # tokens a solo run keeps — silently breaking the engine's
-            # bit-identity guarantees. Refuse until the MoE decode path
-            # masks pads out of routing.
-            raise ValueError(
-                "MoE checkpoints are not supported by the paged serving "
-                "engine yet (pad tokens would consume expert capacity in "
-                "the bucketed prefill); serve a dense checkpoint or use "
-                "single-shot run_generate")
-
-        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None):
+        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None,
+                   ep_axis=None, return_moe_stats=False):
             return gpt2_decode_paged(p, toks, cfg, pages, tables, pos,
-                                     valid, tp_axis)
+                                     valid, tp_axis, ep_axis,
+                                     return_moe_stats)
 
         return ServeModel("gpt2", cfg, params, decode, cfg.n_layer,
                           cfg.n_head, cfg.head_dim, cfg.compute_dtype,
@@ -322,7 +356,11 @@ class ServeModel:
     def for_llama(params: Any, cfg: Any) -> "ServeModel":
         from distributed_lion_tpu.models.llama import llama_decode_paged
 
-        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None):
+        def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None,
+                   ep_axis=None, return_moe_stats=False):
+            # llama has no MoE blocks; the engine refuses --serve_ep for
+            # it at build, so these can never be set here
+            assert ep_axis is None and not return_moe_stats
             return llama_decode_paged(p, toks, cfg, pages, tables, pos,
                                       valid, tp_axis)
 
@@ -433,35 +471,62 @@ class ServingEngine:
                 "--block_size/--max_blocks_per_seq — positions past the "
                 "trained horizon would silently alias")
 
-        # ---- tensor-parallel mesh (tp=0: the single-device program)
+        # ---- tensor/expert-parallel mesh (tp=0, ep=0: the single-device
+        # program, bit for bit)
         self._mesh = None
         self._param_specs = None
         self._pages_spec = None
+        self._tp_axis = TENSOR_AXIS if cfg.tp else None
+        self._ep_axis = EXPERT_AXIS if cfg.ep else None
+        self._moe_stats = bool(cfg.moe_stats
+                               and getattr(model.cfg, "moe_experts", 0) > 0)
         pages_sharding = None
-        if cfg.tp:
+        if cfg.ep:
+            n_experts = getattr(model.cfg, "moe_experts", 0)
+            if n_experts <= 0:
+                raise ValueError(
+                    f"--serve_ep {cfg.ep} needs a MoE checkpoint "
+                    "(moe_experts > 0): the expert axis shards expert FFN "
+                    "banks — dense checkpoints shard with --serve_tp")
+            if n_experts % cfg.ep:
+                raise ValueError(
+                    f"moe_experts ({n_experts}) not divisible by "
+                    f"--serve_ep {cfg.ep}: the expert banks shard over "
+                    "the expert axis")
+        if cfg.tp or cfg.ep:
             from distributed_lion_tpu.parallel.mesh import make_mesh
-            from distributed_lion_tpu.parallel.tensor_parallel import (
-                validate_tp,
-            )
 
-            validate_tp(model.cfg, cfg.tp, model.family)
-            if model.kv_heads % cfg.tp:
-                raise ValueError(
-                    f"kv heads ({model.kv_heads}) not divisible by "
-                    f"--serve_tp {cfg.tp}: the page pool shards over the "
-                    "kv-head axis")
+            if cfg.tp:
+                from distributed_lion_tpu.parallel.tensor_parallel import (
+                    validate_tp,
+                )
+
+                validate_tp(model.cfg, cfg.tp, model.family)
+                if model.kv_heads % cfg.tp:
+                    raise ValueError(
+                        f"kv heads ({model.kv_heads}) not divisible by "
+                        f"--serve_tp {cfg.tp}: the page pool shards over "
+                        "the kv-head axis")
             devices = jax.devices()
-            if len(devices) < cfg.tp:
+            need = max(cfg.tp, 1) * max(cfg.ep, 1)
+            if len(devices) < need:
                 raise ValueError(
-                    f"--serve_tp {cfg.tp} needs {cfg.tp} devices, backend "
-                    f"has {len(devices)}")
-            self._mesh = make_mesh(data=1, tensor=cfg.tp,
-                                   devices=devices[:cfg.tp])
-            specs = model.param_specs()
+                    f"--serve_tp {cfg.tp} x --serve_ep {cfg.ep} needs "
+                    f"{need} devices, backend has {len(devices)}")
+            self._mesh = make_mesh(data=1, tensor=max(cfg.tp, 1),
+                                   expert=max(cfg.ep, 1),
+                                   devices=devices[:need])
+            specs = model.param_specs(tensor=bool(cfg.tp))
             if cfg.quant != "none":
                 from distributed_lion_tpu.ops.quant import validate_quant_tp
 
-                validate_quant_tp(params, specs, cfg.tp, TENSOR_AXIS)
+                if cfg.tp:
+                    validate_quant_tp(params, specs, cfg.tp, TENSOR_AXIS)
+                if cfg.ep > 1:
+                    # expert banks shard their LEADING dim — the shaped
+                    # quant layout keeps leading dims 1:1 with the dense
+                    # weight, so the same validator covers the expert axis
+                    validate_quant_tp(params, specs, cfg.ep, EXPERT_AXIS)
             params = _shard_params(params, specs, self._mesh)
             self._param_specs = specs
             pool_spec = P(None, None, TENSOR_AXIS, None)
@@ -493,33 +558,52 @@ class ServingEngine:
         if self.prefix is not None:
             self.stats.update(prefix_hits=0, shared_tokens=0, cow_copies=0,
                               reclaimed_pages=0)
+        if self._moe_stats:
+            # routing load vs the capacity_factor budget (moe_ffn stats;
+            # serving itself never drops — inference routing is no-drop)
+            self.stats.update(moe_valid_tokens=0.0, moe_kept_tokens=0.0,
+                              moe_capacity_slots=0.0)
 
         samp = (cfg.temperature, cfg.top_k, cfg.top_p)
-        tp_axis = TENSOR_AXIS if self._mesh is not None else None
+        tp_axis, ep_axis = self._tp_axis, self._ep_axis
+        moe_stats = self._moe_stats
 
-        def decode_tick(params, pages, tables, lens, last, seeds, counts):
-            logits, pages = model.decode_paged(params, last[:, None], pages,
-                                               tables, lens, tp_axis=tp_axis)
-            return _sample_rows(logits[:, -1], seeds, counts, *samp), pages
+        def decode_tick(params, pages, tables, lens, last, act, seeds,
+                        counts):
+            # act [S] bool: the engine's valid-lane mask for the tick —
+            # inactive (sentinel) slots are dead lanes for expert routing
+            # and for the scatter (which their sentinel rows drop anyway)
+            out = model.decode_paged(params, last[:, None], pages, tables,
+                                     lens, act[:, None], tp_axis=tp_axis,
+                                     ep_axis=ep_axis,
+                                     return_moe_stats=moe_stats)
+            logits, pages = out[0], out[1]
+            st = out[2] if moe_stats else {}
+            return (_sample_rows(logits[:, -1], seeds, counts, *samp),
+                    st), pages
 
         def prefill(params, pages, tables, toks, start, length, seed, count):
             # toks [1, P] — the prompt SUFFIX not covered by shared prefix
             # pages, scattered at absolute positions start..start+P-1
             # (start == 0 without prefix sharing: the whole prompt)
             valid = jnp.arange(toks.shape[1])[None, :] < length
-            logits, pages = model.decode_paged(params, toks, pages, tables,
-                                               start, valid, tp_axis=tp_axis)
+            out = model.decode_paged(params, toks, pages, tables,
+                                     start, valid, tp_axis=tp_axis,
+                                     ep_axis=ep_axis,
+                                     return_moe_stats=moe_stats)
+            logits, pages = out[0], out[1]
+            st = out[2] if moe_stats else {}
             last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                                 keepdims=False)
             tok = _sample_rows(last[None], seed[None], count[None], *samp)
-            return tok[0], pages
+            return (tok[0], st), pages
 
         def cow_copy(pages, src, dst):
             from distributed_lion_tpu.ops.attention import paged_copy_pages
 
             return paged_copy_pages(pages, src, dst)
 
-        self._decode_tick = self._jit_paged(decode_tick, n_rest=5)
+        self._decode_tick = self._jit_paged(decode_tick, n_rest=6)
         self._prefill = self._jit_paged(prefill, n_rest=6)
         self._cow = self._jit_cow(cow_copy)
 
@@ -570,6 +654,17 @@ class ServingEngine:
             in_specs=(self._pages_spec, rep, rep),
             out_specs=self._pages_spec, check_vma=False)
         return jax.jit(body, donate_argnums=donate)
+
+    def _absorb_moe_stats(self, st) -> None:
+        """Fold a dispatch's MoE routing-load scalars into engine.stats —
+        a no-op ({}) unless ``ServeConfig.moe_stats`` is armed on a MoE
+        checkpoint, so the common tick pays zero extra host reads."""
+        if not st:
+            return
+        self.stats["moe_valid_tokens"] += float(np.asarray(st["valid"]))
+        self.stats["moe_kept_tokens"] += float(np.asarray(st["kept"]))
+        self.stats["moe_capacity_slots"] += float(
+            np.asarray(st["capacity_slots"]))
 
     # ------------------------------------------------------------- intake
     def submit(self, req: Request, deadline_at: Optional[float] = None
@@ -737,13 +832,14 @@ class ServingEngine:
                 # the sample index resumes at len(committed): the key for
                 # this draw is fold_in(key(seed), len(committed)) — the
                 # exact key the pre-migration engine would use next
-                tok, self.pages = self._prefill(
+                (tok, st), self.pages = self._prefill(
                     self.params, self.pages,
                     jnp.asarray(self.tables.tables[slot:slot + 1]),
                     jnp.asarray(toks), jnp.full((1,), covered, jnp.int32),
                     jnp.int32(len(suffix)),
                     jnp.uint32(req.seed), jnp.int32(len(req.committed)))
                 first = int(tok)  # ONE host sync per prefill dispatch
+                self._absorb_moe_stats(st)
             budget -= P
             admitted += 1
             self.stats["prefill_dispatches"] += 1
@@ -823,20 +919,23 @@ class ServingEngine:
         S = self.cfg.max_seqs
         lens = np.zeros((S,), np.int32)
         last = np.zeros((S,), np.int32)
+        act = np.zeros((S,), bool)
         seeds = np.zeros((S,), np.uint32)
         counts = np.zeros((S,), np.int32)
         for i in active:
             s = self.slots[i]
             lens[i] = s.cache_len
             last[i] = s.last_tok
+            act[i] = True
             seeds[i] = s.req.seed
             counts[i] = len(s.gen)  # index of the token being sampled
         with journal.active().span("serve/decode_tick", batch=len(active)):
-            toks, self.pages = self._decode_tick(
+            (toks, st), self.pages = self._decode_tick(
                 self.params, self.pages, jnp.asarray(self.tables.tables),
-                jnp.asarray(lens), jnp.asarray(last), jnp.asarray(seeds),
-                jnp.asarray(counts))
+                jnp.asarray(lens), jnp.asarray(last), jnp.asarray(act),
+                jnp.asarray(seeds), jnp.asarray(counts))
             toks = np.asarray(toks)  # ONE host sync for the whole batch
+            self._absorb_moe_stats(st)
         self.stats["decode_ticks"] += 1
         self.stats["decode_tokens"] += len(active)
         for i in active:

@@ -58,7 +58,10 @@ def bucket_tokens(n: int, block_size: int, max_blocks_per_seq: int) -> int:
     pages, so prompt-length variety costs O(log(max)) compiles, not one
     per length. The ONE bucketing rule — the serving engine's prefill and
     the draft-model mirror's prefill (serve/speculate.py) must pad
-    identically or the mirror desyncs."""
+    identically or the mirror desyncs. For MoE checkpoints the bucket
+    also sizes the no-drop expert dispatch buffer ([E, bucket, D] per MoE
+    block, models/gpt2._decode_mlp): pad lanes are valid-masked out of
+    routing, so the bucket choice changes memory, never an output."""
     blocks = 1
     while blocks * block_size < n:
         blocks *= 2

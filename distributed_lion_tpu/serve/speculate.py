@@ -63,10 +63,15 @@ Drafters (one :class:`Drafter` protocol):
   first scan step of the NEXT round, and the rejected tail rolls back
   with the same ``shrink`` math.
 
-MoE checkpoints refuse the whole path loudly at ``ServeModel`` build
-(the PR 9 rationale: pad/draft tokens would consume expert capacity and
-silently break these bit-identity pins) — speculation rides the same
-engine, so there is no side door.
+MoE checkpoints (ISSUE 15): ``ngram:<k>`` composes — the verify window is
+just a wider decode dispatch, MoE inference routing is no-drop per-token
+with draft lanes valid-masked (models/gpt2._decode_mlp), and rollback
+over MoE pages is attention-side only, so speculative == plain holds
+unchanged (tests/test_moe_serve.py pins it). ``draft:<k>`` keeps a loud
+refusal: the draft mirror holds its OWN page pool and block tables, and
+an expert-parallel target would leave that mirror pool unsharded on the
+mesh — the mirror-pool residual (ROADMAP item 3/4) has no honest sharded
+budget yet.
 """
 
 from __future__ import annotations
@@ -381,9 +386,12 @@ class Speculator:
             engine.stats.setdefault(key, 0)
         samp = (engine.cfg.temperature, engine.cfg.top_k, engine.cfg.top_p)
         model = engine.model
-        from distributed_lion_tpu.parallel.mesh import TENSOR_AXIS
+        # the engine's resolved mesh axes (None off-mesh): an ep-only mesh
+        # must NOT bind the tensor axis here — the verify window shards
+        # exactly like the engine's own decode tick
+        tp_axis, ep_axis = engine._tp_axis, engine._ep_axis
 
-        tp_axis = TENSOR_AXIS if engine._mesh is not None else None
+        moe_stats = engine._moe_stats
 
         def verify(params, pages, tables, lens, window, vcounts, seeds,
                    counts):
@@ -392,9 +400,12 @@ class Speculator:
             # drops, the draws are garbage the host never reads).
             W = window.shape[1]
             valid = jnp.arange(W)[None, :] < vcounts[:, None]
-            logits, pages = model.decode_paged(params, window, pages,
-                                               tables, lens, valid,
-                                               tp_axis=tp_axis)
+            out = model.decode_paged(params, window, pages, tables, lens,
+                                     valid, tp_axis=tp_axis,
+                                     ep_axis=ep_axis,
+                                     return_moe_stats=moe_stats)
+            logits, pages = out[0], out[1]
+            st = out[2] if moe_stats else {}
             B, _, V = logits.shape
             # the pinned per-request stream: position s of row b draws
             # with fold_in(key(seed_b), counts_b + s) — exactly the key
@@ -404,7 +415,7 @@ class Speculator:
                         + jnp.arange(W, dtype=counts.dtype)[None, :])
             draws = _sample_rows(logits.reshape(B * W, V), seeds_r,
                                  counts_r.reshape(-1), *samp)
-            return draws.reshape(B, W), pages
+            return (draws.reshape(B, W), st), pages
 
         # the engine's dispatch wrapper: plain jit at tp=0, shard_map'd
         # over the serving mesh under TP (ISSUE 13) — the verify window
@@ -493,12 +504,13 @@ class Speculator:
 
         with jrnl.span("serve/verify", batch=len(active),
                        proposed=int(sum(desired[i] for i in active))):
-            draws, eng.pages = self._verify(
+            (draws, st), eng.pages = self._verify(
                 eng.params, eng.pages, jnp.asarray(tables.tables),
                 jnp.asarray(lens), jnp.asarray(window),
                 jnp.asarray(vcounts), jnp.asarray(seeds),
                 jnp.asarray(gcounts))
             draws = np.asarray(draws)  # ONE host sync for the whole batch
+            eng._absorb_moe_stats(st)
 
         accepted_total = committed_total = 0
         with jrnl.span("serve/commit", batch=len(active)) as commit_span:
@@ -550,6 +562,16 @@ def build_speculator(engine, spec: str,
     if name == "ngram":
         drafter = NGramDrafter(k)
     else:
+        if getattr(engine.model.cfg, "moe_experts", 0) > 0 or (
+                draft_model is not None
+                and getattr(draft_model.cfg, "moe_experts", 0) > 0):
+            raise ValueError(
+                "--speculate draft:<k> does not support MoE checkpoints "
+                "yet: the draft MIRROR keeps its own page pool and block "
+                "tables, and that mirror pool has no sharded budget under "
+                "expert parallelism — the mirror-pool residual (ROADMAP "
+                "items 3/4); use ngram:<k> (pinned speculative==plain for "
+                "MoE) or serve without speculation")
         if engine._mesh is not None:
             raise ValueError(
                 "--speculate draft:<k> does not compose with --serve_tp "

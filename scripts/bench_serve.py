@@ -46,6 +46,16 @@ ROADMAP item 4):
   by check_evidence's ``tp_serving`` stage (runbook stage 5k). The tp>1
   markers/rows need ≥2 devices — on CPU run under
   ``DLION_PLATFORM=cpu8`` (the bench honors it via force_cpu_platform).
+- **moe_serving section** (ISSUE 15) — the dense-vs-MoE-vs-MoE+ep decode
+  matrix at the standard batches (tokens/s/CHIP, expert-capacity
+  utilization and dropped-token-rate columns measured from the engine's
+  on-device MoE routing stats against the capacity_factor budget), plus
+  six live-recomputed identity markers on the tiny MoE config: paged MoE
+  decode == dense-KV MoE generate, engine batched == solo, left-padded
+  batched generate == solo, ep=1 bit-identical to the unsharded engine,
+  ep>=2 and ep×tp token-identical. Judged by check_evidence's
+  ``moe_serving`` stage (runbook stage 5m). The ep>=2 rows/markers need
+  enough devices — on CPU run under ``DLION_PLATFORM=cpu8``.
 
 CPU-produced artifacts are first-class smoke evidence (tiny model — the
 engine mechanism, not chip throughput); ``meta.backend`` records what
@@ -89,8 +99,16 @@ def _serve_model(model_name: str, family: str):
         if family == "gpt2":
             from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
 
-            cfg = (GPT2Config.tiny() if model_name == "tiny"
-                   else GPT2Config.gpt2_124m())
+            # "<base>_moe": the base architecture with a Switch-MoE FFN in
+            # every other block (tiny: 4 experts, gpt2_124m: 8) — the
+            # moe_serving matrix's MoE arm (ISSUE 15)
+            base = (model_name[:-4] if model_name.endswith("_moe")
+                    else model_name)
+            moe = {}
+            if model_name.endswith("_moe"):
+                moe = dict(moe_experts=4 if base == "tiny" else 8)
+            cfg = (GPT2Config.tiny(**moe) if base == "tiny"
+                   else GPT2Config.gpt2_124m(**moe))
             params = gpt2_init(jax.random.key(0), cfg)
             model = ServeModel.for_gpt2(params, cfg)
         else:
@@ -106,8 +124,9 @@ def _serve_model(model_name: str, family: str):
 def _build(model_name: str, family: str, quant: str, max_seqs: int,
            block_size: int, max_blocks_per_seq: int,
            prefill_cap: int = 1 << 30, temperature: float = 0.0,
-           top_k=None, speculate: str = "", tp: int = 0,
-           prefix_cache: bool = False, num_blocks: int = 0):
+           top_k=None, speculate: str = "", tp: int = 0, ep: int = 0,
+           prefix_cache: bool = False, num_blocks: int = 0,
+           moe_stats: bool = False):
     from distributed_lion_tpu.serve.engine import ServeConfig, ServingEngine
 
     model, params, cfg = _serve_model(model_name, family)
@@ -116,8 +135,8 @@ def _build(model_name: str, family: str, quant: str, max_seqs: int,
                        num_blocks=num_blocks,
                        prefill_cap_tokens=prefill_cap,
                        temperature=temperature, top_k=top_k, quant=quant,
-                       tp=tp, prefix_cache=prefix_cache,
-                       speculate=speculate)
+                       tp=tp, ep=ep, prefix_cache=prefix_cache,
+                       speculate=speculate, moe_stats=moe_stats)
     draft = model if speculate.startswith("draft") else None
     return ServingEngine(model, scfg, draft_model=draft), params, cfg
 
@@ -321,10 +340,12 @@ def bench_speculative(model_name: str, family: str, quant: str,
     }
 
 
-def bit_identity_markers(family: str) -> dict:
+def bit_identity_markers(family: str, model_name: str = "tiny") -> dict:
     """Live recompute of the two serving bit-identity claims on the tiny
     model (cheap on any backend) — the artifact must EARN its markers at
-    capture time, not copy them from a test run."""
+    capture time, not copy them from a test run. ``model_name``
+    parameterizes the tiny architecture so the moe_serving section reuses
+    the exact same recipe on the tiny MoE config (ISSUE 15)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -334,7 +355,8 @@ def bit_identity_markers(family: str) -> dict:
 
     block_size, nblk = 4, 8                  # paged horizon = 32 tokens
     new_tokens = 8
-    engine, params, cfg = _build("tiny", family, "none", 4, block_size, nblk)
+    engine, params, cfg = _build(model_name, family, "none", 4, block_size,
+                                 nblk)
     if family == "gpt2":
         from distributed_lion_tpu.models.gpt2 import gpt2_decode, gpt2_init_cache
 
@@ -368,11 +390,13 @@ def bit_identity_markers(family: str) -> dict:
                                                             length=12, seed=13))]
     reqs = [Request(req_id=i, tokens=t, max_new_tokens=new_tokens, seed=i)
             for i, t in enumerate(varied)]
-    eng2, _, _ = _build("tiny", family, "none", 4, block_size, nblk)
+    eng2, _, _ = _build(model_name, family, "none", 4, block_size,
+                        nblk)
     stag = eng2.run(reqs, arrivals={0: 0, 1: 1, 2: 1, 3: 4})
     ok = True
     for r in reqs:
-        solo_eng, _, _ = _build("tiny", family, "none", 4, block_size, nblk)
+        solo_eng, _, _ = _build(model_name, family, "none", 4,
+                                block_size, nblk)
         solo = solo_eng.run([Request(r.req_id, list(r.tokens),
                                      r.max_new_tokens, r.seed)])
         ok = ok and solo[r.req_id].tokens == stag[r.req_id].tokens
@@ -531,6 +555,192 @@ def bench_tp_serving(model_name: str, family: str, quant: str,
     markers = {k: bool(v) for k, v in markers.items()}
     return {"markers": markers, "tp_degree_max_measured": int(tpn),
             "rows": rows, "prefix": prefix}
+
+
+def bench_moe_serving(model_name: str, quant: str, block_size: int,
+                      ticks: int, warmup: int, batches, eps) -> dict:
+    """The ISSUE 15 evidence: the dense-vs-MoE-vs-MoE+ep decode matrix
+    (tokens/s/CHIP at the standard batches, with expert-capacity
+    utilization and dropped-token-rate columns measured from the engine's
+    on-device MoE routing stats against the config's capacity_factor
+    budget — serving itself never drops: inference routing is no-drop),
+    plus the live-recomputed identity markers on the tiny MoE config:
+    paged MoE == dense-KV MoE generate, batched == solo (engine AND
+    left-padded batched generate), ep=1 bit-identical to the unsharded
+    engine, ep>=2 and ep×tp token-identical on the measuring mesh. MoE
+    is a gpt2 architecture; the section always measures the gpt2 family."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_lion_tpu.serve.engine import Request
+
+    family = "gpt2"
+    moe_name = model_name + "_moe"
+    _, _, mcfg = _serve_model(moe_name, family)
+    E = mcfg.moe_experts
+    n_dev = len(jax.devices())
+
+    # feasible ep degrees — dropped degrees reported, never silently
+    # skipped (no-silent-caps)
+    feasible, dropped = [], []
+    for e in eps:
+        if e < 2:
+            dropped.append((e, "matrix rows measure ep >= 2 (ep=1 is the "
+                               "bit-identity marker)"))
+        elif e > n_dev:
+            dropped.append((e, f"{e} > {n_dev} devices"))
+        elif E % e:
+            dropped.append((e, f"moe_experts {E} % {e}"))
+        else:
+            feasible.append(e)
+    for e, why in dropped:
+        print(json.dumps({"dropped_ep_degree": e, "why": why},
+                         allow_nan=False), flush=True)
+
+    rows = []
+
+    def routing_cols(batch: int) -> dict:
+        """The capacity columns, measured in a SEPARATE UNTIMED pass with
+        ``moe_stats`` armed — the per-tick stats host reads must never
+        ride the timed throughput window (they would bias the
+        dense-vs-MoE delta with instrumentation cost). Measured once per
+        batch at ep=0: routing is pinned token-identical across
+        ep/sharding, so one measurement honestly serves every MoE row of
+        that batch."""
+        stat_ticks = 8
+        need = PROMPT_LEN + stat_ticks + 2
+        nblocks = -(-need // block_size)
+        eng, _, cfg = _build(moe_name, family, quant, batch, block_size,
+                             nblocks, moe_stats=True)
+        for i, toks in enumerate(_prompts(batch, cfg.vocab_size)):
+            eng.submit(Request(req_id=i, tokens=toks, max_new_tokens=need,
+                               seed=i))
+        while eng.pending:
+            eng.step()
+        assert all(s is not None for s in eng.slots), "slots did not fill"
+        v0, k0 = (eng.stats["moe_valid_tokens"],
+                  eng.stats["moe_kept_tokens"])
+        c0 = eng.stats["moe_capacity_slots"]
+        for _ in range(stat_ticks):
+            eng.step()
+        vv = eng.stats["moe_valid_tokens"] - v0
+        kk = eng.stats["moe_kept_tokens"] - k0
+        cc = eng.stats["moe_capacity_slots"] - c0
+        return {
+            # routing load vs the capacity_factor budget (what-if columns:
+            # the no-drop serving path drops nothing, these say how the
+            # traffic would load the Switch training budget)
+            "capacity_utilization": round(min(kk / cc, 1.0), 4) if cc
+            else 0.0,
+            "dropped_rate": round(max(vv - kk, 0.0) / vv, 4) if vv else 0.0,
+        }
+
+    def timed(config: str, m_name: str, batch: int, ep: int,
+              cols: dict) -> None:
+        need = PROMPT_LEN + warmup + ticks + 2
+        nblocks = -(-need // block_size)
+        is_moe = m_name == moe_name
+        # moe_stats stays OFF here: every row (dense and MoE) times the
+        # identical un-instrumented engine — apples to apples
+        eng, _, cfg = _build(m_name, family, quant, batch, block_size,
+                             nblocks, ep=ep)
+        for i, toks in enumerate(_prompts(batch, cfg.vocab_size)):
+            eng.submit(Request(req_id=i, tokens=toks, max_new_tokens=need,
+                               seed=i))
+        while eng.pending:
+            eng.step()
+        assert all(s is not None for s in eng.slots), "slots did not fill"
+        for _ in range(warmup):
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            eng.step()  # host-syncs its token batch: fully retired
+        dt = time.perf_counter() - t0
+        row = {
+            "config": config, "experts": E if is_moe else 0, "ep": ep,
+            "batch": batch, "decode_ticks": ticks,
+            "ms_per_tick": round(dt / ticks * 1e3, 4),
+            "tokens_per_sec_per_chip": round(
+                batch * ticks / dt / max(ep, 1), 2),
+            "capacity_utilization": cols["capacity_utilization"] if is_moe
+            else 0.0,
+            "dropped_rate": cols["dropped_rate"] if is_moe else 0.0,
+        }
+        rows.append(row)
+        print(json.dumps(row, allow_nan=False), flush=True)
+
+    for batch in batches:
+        cols = routing_cols(batch)
+        timed("dense", model_name, batch, 0, cols)
+        timed("moe", moe_name, batch, 0, cols)
+        for e in feasible:
+            timed(f"moe_ep{e}", moe_name, batch, e, cols)
+
+    # ---- identity markers, recomputed live on the tiny MoE config
+    # (identity is backend/scale-independent; capture stays cheap)
+    tiny = "tiny_moe"
+    _, tparams, tcfg = _serve_model(tiny, family)
+    bits = bit_identity_markers(family, model_name=tiny)
+
+    # batched left-padded generate == solo (the lifted models/generate
+    # refusal): greedy, varied prompt lengths
+    from distributed_lion_tpu.models.generate import generate
+    from distributed_lion_tpu.models.gpt2 import (
+        gpt2_decode,
+        gpt2_init_cache,
+    )
+
+    def dec(p, t, c, pos, off=None):
+        return gpt2_decode(p, t, tcfg, c, pos, off)
+
+    def ic(b, m):
+        return gpt2_init_cache(tcfg, b, m)
+
+    grng = np.random.default_rng(23)
+    lens = [3, 7, 5, 9]
+    prompts = [list(map(int, grng.integers(1, tcfg.vocab_size, L)))
+               for L in lens]
+    T = max(lens)
+    padded = np.zeros((len(prompts), T), np.int32)
+    for i, p in enumerate(prompts):
+        padded[i, T - len(p):] = p
+    batched = np.asarray(generate(
+        dec, ic, tparams, jnp.asarray(padded), 8,
+        prompt_lens=jnp.asarray(lens, jnp.int32)))
+    gen_ok = True
+    for i, p in enumerate(prompts):
+        solo = np.asarray(generate(dec, ic, tparams,
+                                   jnp.asarray([p], jnp.int32), 8))
+        gen_ok = gen_ok and (batched[i] == solo[0]).all()
+
+    # ep identity: engine outputs across sharding degrees
+    def outputs(kw=None, samp=None):
+        eng, _, _ = _build(tiny, family, "none", 4, 4, 8, **(kw or {}),
+                           **(samp or {}))
+        trng = np.random.default_rng(29)
+        pr = [list(map(int, trng.integers(1, tcfg.vocab_size, 3 + 2 * i)))
+              for i in range(4)]
+        done = eng.run([Request(req_id=i, tokens=list(t), max_new_tokens=8,
+                                seed=i) for i, t in enumerate(pr)])
+        return {r: c.tokens for r, c in done.items()}
+
+    plain = outputs()
+    e_tiny = tcfg.moe_experts
+    epn = max([e for e in (4, 2) if e <= n_dev and e_tiny % e == 0] or [0])
+    can_ep_tp = n_dev >= 4 and tcfg.n_head % 2 == 0 and e_tiny % 2 == 0
+    markers = {
+        "paged_vs_dense": bits["paged_vs_dense"],
+        "batched_vs_solo": bits["batched_vs_solo"],
+        "batched_generate_vs_solo": bool(gen_ok),
+        "ep1_vs_unsharded": outputs({"ep": 1}) == plain,
+        "epN_vs_unsharded": epn >= 2 and outputs({"ep": epn}) == plain,
+        "ep_tp_vs_unsharded": can_ep_tp
+        and outputs({"ep": 2, "tp": 2}) == plain,
+    }
+    markers = {k: bool(v) for k, v in markers.items()}
+    return {"markers": markers, "ep_degree_max_measured": int(epn),
+            "rows": rows}
 
 
 def bench_serve_resilience(model_name: str, family: str, quant: str,
@@ -733,6 +943,10 @@ def main() -> int:
                     help="decode batch of the TP rows")
     ap.add_argument("--prefix_requests", type=int, default=256,
                     help="requests in the shared-system-prompt memory leg")
+    ap.add_argument("--moe_eps", default="2,4",
+                    help="expert-parallel degrees for the moe_serving "
+                         "matrix rows (infeasible degrees dropped LOUDLY; "
+                         "ep=1 is covered by the bit-identity marker)")
     args = ap.parse_args()
 
     import jax
@@ -778,6 +992,13 @@ def main() -> int:
         [int(t) for t in args.tps.split(",") if t], args.prefix_requests)
     serve_resilience = bench_serve_resilience(
         model_name, args.family, args.quant, args.block_size)
+    # MoE is a gpt2 architecture; a llama bench still measures the MoE
+    # matrix against the default gpt2 model at this scale
+    moe_base = (model_name if args.family == "gpt2"
+                else ("gpt2_124m" if backend == "tpu" else "tiny"))
+    moe_serving = bench_moe_serving(
+        moe_base, args.quant, args.block_size, args.ticks, args.warmup,
+        batches, [int(e) for e in args.moe_eps.split(",") if e])
 
     doc = {
         "meta": {
@@ -798,6 +1019,7 @@ def main() -> int:
         "speculative": spec,
         "tp_serving": tp_serving,
         "serve_resilience": serve_resilience,
+        "moe_serving": moe_serving,
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "serving.json")
@@ -813,6 +1035,8 @@ def main() -> int:
                          for k, v in tp_serving["markers"].items()},
                       **{f"sr_{k}": v
                          for k, v in serve_resilience["markers"].items()},
+                      **{f"moe_{k}": v
+                         for k, v in moe_serving["markers"].items()},
                       "prefix_mem_ratio":
                           tp_serving["prefix"]["prefix_mem_ratio"],
                       "best_tokens_per_sec_per_chip": max(
@@ -820,7 +1044,8 @@ def main() -> int:
                      allow_nan=False), flush=True)
     return 0 if (all(bits.values()) and all(spec["markers"].values())
                  and all(tp_serving["markers"].values())
-                 and all(serve_resilience["markers"].values())) else 1
+                 and all(serve_resilience["markers"].values())
+                 and all(moe_serving["markers"].values())) else 1
 
 
 if __name__ == "__main__":

@@ -586,6 +586,34 @@ else
     || echo "$(stamp) serve_resilience section FAILED validation" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5m. MoE serving (ISSUE 15, ~4 min): the moe_serving section of
+# the SAME runs/serving/serving.json — the dense-vs-MoE-vs-MoE+ep decode
+# matrix (tokens/s/CHIP at the standard batches with expert-capacity
+# utilization + dropped-rate columns from the engine's on-device routing
+# stats) and the six live-recomputed identity markers (paged MoE ==
+# dense-KV MoE generate, engine batched == solo, left-padded batched
+# generate == solo, ep=1 bit-identical, ep>=2 and ep×tp
+# token-identical). bench_serve writes it alongside stages 5h/5j/5k/5l's
+# sections, so a fresh 5h capture already carries it — this stage only
+# re-runs the bench when the banked artifact predates ISSUE 15 (or a
+# marker/row failed). check_evidence's 'moe_serving' stage judges it
+# (strict schema, all six markers, dense + moe + moe_ep>=2 rows with the
+# MoE rows above the tokens/s floor and [0,1] capacity columns).
+if python scripts/check_evidence.py moe_serving \
+    && [ "$(python -c 'import json;print(json.load(open("runs/serving/serving.json"))["meta"]["backend"])' 2>/dev/null)" = "tpu" ]; then
+  echo "$(stamp) moe_serving section already captured on chip — skip" | tee -a "$OUT/log.txt"
+else
+  timeout -k 60 1800 python scripts/bench_serve.py --out runs/serving \
+      >> "$OUT/serving.log" 2>&1
+  rc=$?
+  python scripts/validate_metrics.py runs/serving/serving.json \
+      >> "$OUT/serving.log" 2>&1 || rc=$?
+  echo "$(stamp) moe_serving rc=$rc" | tee -a "$OUT/log.txt"
+  python scripts/check_evidence.py moe_serving \
+    && echo "$(stamp) moe_serving section captured" | tee -a "$OUT/log.txt" \
+    || echo "$(stamp) moe_serving section FAILED validation" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

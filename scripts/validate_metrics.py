@@ -38,11 +38,13 @@ the DCN-overlap evidence artifact (``dcn_overlap.json`` —
 scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
 rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
-bit-identity/speculative-frontier/tp_serving/serve_resilience document,
-per-row validated the same way incl. accept_rate ∈ [0,1] on every
-frontier row, the TP-degree + shared-prefix rows of the ISSUE 13
-section and the crash-matrix/slow/drain/rejoin rows of the ISSUE 14
-replica-plane section), and the
+bit-identity/speculative-frontier/tp_serving/serve_resilience/
+moe_serving document, per-row validated the same way incl.
+accept_rate ∈ [0,1] on every frontier row, the TP-degree +
+shared-prefix rows of the ISSUE 13 section, the
+crash-matrix/slow/drain/rejoin rows of the ISSUE 14 replica-plane
+section, and capacity_utilization/dropped_rate ∈ [0,1] on every
+dense-vs-MoE-vs-MoE+ep matrix row of the ISSUE 15 section), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
 document; timeline rows are strict-validated per row).
@@ -203,7 +205,8 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
     decode; sampled speculative == the same per-request PRNG stream)."""
     errors = []
     for key in ("meta", "decode", "prefill_share", "bit_identity",
-                "speculative", "tp_serving", "serve_resilience"):
+                "speculative", "tp_serving", "serve_resilience",
+                "moe_serving"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -395,6 +398,45 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
                 if not isinstance(sec.get(k), bool):
                     errors.append(f"{path}: serve_resilience.{section}.{k} "
                                   "must be a bool")
+    moe = doc.get("moe_serving")
+    if moe is not None and not isinstance(moe, dict):
+        errors.append(f"{path}: 'moe_serving' must be an object")
+    elif isinstance(moe, dict):
+        marks = moe.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: moe_serving.markers must be an object")
+        else:
+            for k in ("paged_vs_dense", "batched_vs_solo",
+                      "batched_generate_vs_solo", "ep1_vs_unsharded",
+                      "epN_vs_unsharded", "ep_tp_vs_unsharded"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(
+                        f"{path}: moe_serving.markers.{k} must be a bool")
+        rows = moe.get("rows")
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: moe_serving.rows must be a non-empty "
+                          "list")
+            rows = []
+        for i, row in enumerate(rows):
+            where = f"{path}: moe_serving.rows[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where} is not an object")
+                continue
+            if not isinstance(row.get("config"), str):
+                errors.append(f"{where}.config must be a string")
+            for k in ("experts", "ep", "batch", "decode_ticks"):
+                if not (isinstance(row.get(k), int)
+                        and not isinstance(row.get(k), bool)
+                        and row[k] >= 0):
+                    errors.append(f"{where}.{k} must be a non-negative int")
+            for k in ("ms_per_tick", "tokens_per_sec_per_chip"):
+                if not _finite_number(row.get(k)):
+                    errors.append(f"{where}.{k} is not finite")
+            for k in ("capacity_utilization", "dropped_rate"):
+                v = row.get(k)
+                if not (_finite_number(v) and 0.0 <= v <= 1.0):
+                    errors.append(f"{where}.{k} must be a finite number "
+                                  "in [0, 1]")
     return errors
 
 

@@ -1,0 +1,26 @@
+"""Fixture: DLT005 in serve-layer EXPERT-AXIS sharding code — hardcoded
+mesh-axis string literals where the parallel.mesh constants belong. The
+MoE serving engine (serve/engine.py, ISSUE 15) threads EXPERT_AXIS from
+parallel/mesh through its shard_map specs and the model hook's
+``ep_axis``; a literal "expert" here silently decouples from the mesh
+axis-naming convention (rename the axis once and the MoE serve path keeps
+compiling against a ghost name while the all_to_alls ride nothing).
+Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def expert_bank_specs(n_experts):
+    # DLT005: the expert-bank leading dim named by a raw string literal
+    return {"w_in": P("expert"), "w_out": P("expert")}
+
+
+def sharded_moe_tick(mesh, fn, param_specs, pages_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=(param_specs, pages_specs),
+                         out_specs=P(), check_vma=False)
+
+
+def ep_degree(axis_name="expert"):                   # DLT005: literal default
+    return axis_name

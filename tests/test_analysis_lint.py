@@ -273,6 +273,26 @@ def test_tp_serve_fixtures_and_serve_parallel_modules_clean():
             assert lint.lint_file(path) == [], f"{sub}/{name}"
 
 
+def test_expert_axis_fixture_and_moe_serve_modules_clean():
+    """ISSUE 15 satellite: MoE serving code must never hardcode the
+    expert mesh-axis string literal — the engine threads parallel.mesh's
+    EXPERT_AXIS through its (data=1, expert=ep, tensor=tp) shard_map mesh
+    and the model hooks' ``ep_axis``, and parallel/expert.moe_ffn binds
+    whatever axis name the caller passes (DLT005 fires 3× on the fixture
+    showing the forbidden shape). parallel/expert.py and every serve-path
+    module the MoE route touches lint zero-finding by file path."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt005_expert_axis_literal.py"))
+    assert [f.rule for f in findings] == ["DLT005"] * 3, (
+        [str(f) for f in findings])
+    for rel in ("parallel/expert.py", "models/gpt2.py",
+                "models/generate.py", "serve/engine.py",
+                "serve/speculate.py", "serve/kv_cache.py",
+                "cli/run_serve.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel
+
+
 def test_migration_fixture_and_replica_plane_clean():
     """ISSUE 14 satellite: a migration re-prefill must never host-read
     per committed token — replaying a migrated request's history with an

@@ -440,13 +440,24 @@ def test_draft_model_vocab_mismatch_refused():
                       draft_model=other)
 
 
-def test_moe_checkpoints_still_refused_with_speculation():
-    """The PR 9 refusal has no speculative side door: an MoE checkpoint
-    fails loudly at ServeModel build — the only gateway into the engine,
-    speculative or not."""
+def test_moe_draft_speculation_refused_ngram_composes():
+    """ISSUE 15: ngram speculation composes with MoE (speculative==plain
+    pinned in tests/test_moe_serve.py), but draft:<k> keeps its loud
+    refusal naming the mirror-pool residual — the draft mirror's own page
+    pool has no sharded budget under expert parallelism."""
     cfg = GPT2Config.tiny(moe_experts=2)
-    with pytest.raises(ValueError, match="MoE"):
-        ServeModel.for_gpt2({"blocks": []}, cfg)
+    params = gpt2_init(jax.random.key(0), cfg)
+    model = ServeModel.for_gpt2(params, cfg)
+    with pytest.raises(ValueError, match="mirror"):
+        ServingEngine(model, ServeConfig(max_seqs=2, block_size=4,
+                                         max_blocks_per_seq=4,
+                                         speculate="draft:2"),
+                      draft_model=model)
+    # ngram builds (and the equivalence pin lives in test_moe_serve)
+    eng = ServingEngine(model, ServeConfig(max_seqs=2, block_size=4,
+                                           max_blocks_per_seq=4,
+                                           speculate="ngram:2"))
+    assert eng._speculator is not None
 
 
 def test_draft_cache_desync_is_loud():
