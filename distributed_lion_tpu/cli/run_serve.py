@@ -66,6 +66,20 @@ class ServeArguments:
     # all_to_all hops per MoE block per tick, page pools untouched.
     # Composes with --serve_tp (N x tp devices). ep=1 is pinned
     # bit-identical to the unsharded engine; ep>1 token-identical.
+    serve_ep_batch: bool = False     # batch-shard the decode/prefill batch
+    # over the expert axis (ISSUE 16): slots and page pools split into
+    # --serve_ep groups (max_seqs and num_blocks must divide ep), per-chip
+    # FLOPs scale with ep, tokens cross chips only inside the two MoE
+    # all_to_all hops. Needs --serve_ep >= 1. ep=1 is pinned bit-identical
+    # to the replicated engine; ep>1 token-identical. Composes with
+    # --serve_tp, --prefix_cache (caches go group-local) and
+    # --speculate ngram:<k>.
+    serve_ep_overlap: bool = False   # split each decode tick into two
+    # software-pipelined microbatches so one half's expert all_to_all is
+    # in flight while the other half runs attention. Needs
+    # --serve_ep_batch and an even per-group slot count >= 2. Pinned
+    # bit-identical to the unoverlapped tick (attention is row-local and
+    # no-drop routing is an exact per-token function).
     prefix_cache: bool = False       # share prompt-prefix KV pages across
     # requests (copy-on-write block tables, serve/kv_cache.PrefixCache):
     # N requests carrying the same system prompt hold ONE physical copy
@@ -150,6 +164,8 @@ def build_engine_factory(gen_args, serve_args: "ServeArguments"):
         top_p=gen_args.top_p, quant=serve_args.quant,
         quant_block=serve_args.quant_block,
         tp=serve_args.serve_tp, ep=serve_args.serve_ep,
+        ep_batch=serve_args.serve_ep_batch,
+        ep_overlap=serve_args.serve_ep_overlap,
         prefix_cache=serve_args.prefix_cache,
         speculate=serve_args.speculate,
         eos_id=getattr(tok, "eos_id", None))

@@ -331,13 +331,20 @@ def _block_remat_for(cfg):
                    policy=_remat_policy(cfg.remat_policy))(_block)
 
 
-def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None, tp_axis=None):
+def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None, tp_axis=None,
+               balance_tokens=None, return_tallies=False,
+               balance_axis=None):
     """Pre-LN block whose FFN is the Switch-MoE layer: tokens flattened to
     [B*T, D], routed/dispatched by parallel/expert.moe_ffn (two all_to_all
     hops when ``expert_axis`` is bound), combined back. ``tp_axis`` runs
     the attention half column/row-parallel and Megatron-splits each
     expert's FFN (ep × tp). Returns ``(x, aux_loss)`` — the load-balance
-    auxiliary to add to the train loss."""
+    auxiliary to add to the train loss. ``balance_tokens`` ([E+1] f32,
+    optional) substitutes a fed-in (global / ring-stale) token-load tally
+    for the local one in the aux (the ``--ep_dcn_pipeline`` wire, see
+    parallel/expert.moe_ffn); ``return_tallies`` additionally returns
+    this block's fresh local tally; ``balance_axis`` is the synchronous
+    depth-0 alternative (psum the tallies in the forward)."""
     from distributed_lion_tpu.parallel.expert import moe_ffn
 
     k1, k2, k3 = (None, None, None) if key is None else jax.random.split(key, 3)
@@ -347,14 +354,24 @@ def _moe_block(x, p, key, cfg: GPT2Config, expert_axis=None, tp_axis=None):
     )
     B, T, D = x.shape
     h = _layer_norm(x, p["ln_2"]).reshape(B * T, D)
-    y, aux = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
-                     axis_name=expert_axis, tp_axis=tp_axis)
+    out = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
+                  axis_name=expert_axis, tp_axis=tp_axis,
+                  balance_tokens=balance_tokens, balance_axis=balance_axis,
+                  return_tallies=return_tallies)
+    if return_tallies:
+        y, aux, tally = out
+    else:
+        (y, aux), tally = out, None
     x = x + _dropout(y.reshape(B, T, D), cfg.dropout, k3)
+    if return_tallies:
+        return x, aux, tally
     return x, aux
 
 
 def _moe_block_remat_for(cfg):
-    return partial(jax.checkpoint, static_argnums=(3, 4, 5),
+    # balance_tokens (argnum 6) is a traced array; return_tallies (7) and
+    # balance_axis (8) are static python values like the axis names
+    return partial(jax.checkpoint, static_argnums=(3, 4, 5, 7, 8),
                    policy=_remat_policy(cfg.remat_policy))(_moe_block)
 
 
@@ -389,12 +406,24 @@ def gpt2_hidden(
     seq_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
     vocab_axis: Optional[str] = None,
+    moe_balance: Optional[jnp.ndarray] = None,
+    moe_balance_axis: Optional[str] = None,
+    return_moe_tallies: bool = False,
 ) -> tuple:
     """Backbone forward: tokens [B, T] → (final hidden [B, T, d] after ln_f,
     MoE aux loss scalar). The tied-logits head is applied by
     :func:`gpt2_apply`, or streamed chunk-wise by ops/xent for the
     memory-lean loss path. With ``vocab_axis``, ``params["wte"]`` is this
-    rank's vocab-row shard (:func:`vocab_parallel_embed`)."""
+    rank's vocab-row shard (:func:`vocab_parallel_embed`).
+
+    ``moe_balance`` ([n_moe_blocks, E+1] f32, optional) feeds each MoE
+    block's aux loss a substituted token-load tally — PER BLOCK, so a
+    size-1 psum of the fresh tallies reproduces the unfed aux bit-for-bit
+    (the ``--ep_dcn_pipeline`` depth-0 pin, train/loop.py).
+    ``moe_balance_axis`` is the synchronous depth-0 form: each MoE block
+    psums its fresh tallies over that axis inside the forward.
+    ``return_moe_tallies`` appends a third output: the stacked fresh local
+    tallies [n_moe_blocks, E+1] (stop-gradient)."""
     B, T = tokens.shape
     if seq_axis is None:
         if T > cfg.n_ctx:
@@ -423,13 +452,28 @@ def gpt2_hidden(
     block = _block_remat_for(cfg) if cfg.remat else _block
     moe_block = _moe_block_remat_for(cfg) if cfg.remat else _moe_block
     aux_total = jnp.float32(0)
+    tallies = []
+    moe_i = 0
     for p, k in zip(params["blocks"], keys[: cfg.n_layer]):
         if "moe" in p:  # static pytree-structure branch, resolved at trace
-            x, aux = moe_block(x, p, k, cfg, expert_axis, tp_axis)
+            bt = None if moe_balance is None else moe_balance[moe_i]
+            out = moe_block(x, p, k, cfg, expert_axis, tp_axis, bt,
+                            return_moe_tallies, moe_balance_axis)
+            if return_moe_tallies:
+                x, aux, tally = out
+                tallies.append(tally)
+            else:
+                x, aux = out
             aux_total = aux_total + aux
+            moe_i += 1
         else:
             x = block(x, p, k, cfg, tp_axis, seq_axis)
-    return _layer_norm(x, params["ln_f"]), aux_total
+    hidden = _layer_norm(x, params["ln_f"])
+    if return_moe_tallies:
+        stacked = (jnp.stack(tallies) if tallies
+                   else jnp.zeros((0, 1), jnp.float32))
+        return hidden, aux_total, stacked
+    return hidden, aux_total
 
 
 def gpt2_apply(
@@ -442,6 +486,9 @@ def gpt2_apply(
     seq_axis: Optional[str] = None,
     expert_axis: Optional[str] = None,
     return_aux: bool = False,
+    moe_balance: Optional[jnp.ndarray] = None,
+    moe_balance_axis: Optional[str] = None,
+    return_moe_tallies: bool = False,
 ) -> jnp.ndarray:
     """Forward pass: int32 tokens [B, T] → logits [B, T, vocab] (f32).
 
@@ -452,10 +499,13 @@ def gpt2_apply(
     contiguous chunk of the full sequence: positions offset by the shard
     index, attention rings over the axis, per-shard dropout keys.
     """
-    x, aux_total = gpt2_hidden(
+    out = gpt2_hidden(
         params, tokens, cfg, dropout_key=dropout_key, tp_axis=tp_axis,
         seq_axis=seq_axis, expert_axis=expert_axis,
+        moe_balance=moe_balance, moe_balance_axis=moe_balance_axis,
+        return_moe_tallies=return_moe_tallies,
     )
+    x, aux_total = out[0], out[1]
     logits = jnp.einsum(
         "btd,vd->btv", x, params["wte"].astype(x.dtype),
         preferred_element_type=jnp.float32,
@@ -464,6 +514,10 @@ def gpt2_apply(
     # columns; slicing back to vocab_size here keeps every downstream
     # consumer (losses, generation, eval) on exact true-vocab semantics
     logits = logits[..., : cfg.vocab_size]
+    if return_moe_tallies:
+        if return_aux:
+            return logits, aux_total, out[2]
+        return logits, out[2]
     if return_aux:
         return logits, aux_total
     return logits
@@ -554,7 +608,8 @@ def _decode_attention(x, p, cfg: GPT2Config, c, pos, offset=None):
 
 
 def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None, valid=None,
-                ep_axis=None, moe_stats=None):
+                ep_axis=None, moe_stats=None, stats_axis=None,
+                stats_lanes=None):
     """The post-attention half of a decode block (dense MLP or the MoE
     FFN with decode-friendly capacity) — shared by the dense-cache and
     paged decode paths so their numerics cannot drift. ``tp_axis`` runs
@@ -574,7 +629,12 @@ def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None, valid=None,
     ``ep_axis`` shards the expert banks over the serving mesh's expert
     axis (two all_to_all hops); ``moe_stats`` (a list) collects this
     block's routing-load scalars when the engine benchmarks capacity
-    utilization."""
+    utilization; ``stats_axis`` (batch-sharded ep serving, ISSUE 16)
+    psums the routing-load counters over the expert axis so the stats
+    stay GLOBAL when each shard routes only its batch slice, and
+    ``stats_lanes`` (static) overrides the budget's lane count for
+    dispatches whose non-owner shards carry fake all-invalid lanes (the
+    batch-sharded batch-1 prefill)."""
     if "moe" in p:
         from distributed_lion_tpu.parallel.expert import moe_ffn
 
@@ -584,7 +644,8 @@ def _decode_mlp(x, p, cfg: GPT2Config, tp_axis=None, valid=None,
         out = moe_ffn(p["moe"], h, capacity_factor=cfg.moe_capacity_factor,
                       axis_name=ep_axis, capacity_override=B2 * S2,
                       tp_axis=tp_axis, valid=v,
-                      return_stats=moe_stats is not None)
+                      return_stats=moe_stats is not None,
+                      stats_axis=stats_axis, stats_lanes=stats_lanes)
         if moe_stats is not None:
             y, _, st = out
             moe_stats.append(st)
@@ -689,7 +750,8 @@ def _paged_attention_block(x, p, cfg: GPT2Config, c, tables, pos, valid,
 def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
                       pages: list, tables: jnp.ndarray, pos: jnp.ndarray,
                       valid=None, tp_axis=None, ep_axis=None,
-                      return_moe_stats=False):
+                      return_moe_stats=False, stats_axis=None,
+                      stats_lanes=None):
     """Block-table decode (the serving engine's model hook): ``tokens``
     [B, S] where row b's tokens sit at absolute positions
     ``pos[b] .. pos[b]+S-1`` of its own sequence; ``pages`` is the
@@ -714,7 +776,18 @@ def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
     banks over the mesh's expert axis — two all_to_all hops per MoE block,
     the page pools untouched. ``return_moe_stats`` additionally returns a
     dict of routing-load scalars summed over the MoE blocks (the bench's
-    capacity-utilization columns; {} for a dense checkpoint)."""
+    capacity-utilization columns; {} for a dense checkpoint); under
+    batch-sharded ep (ISSUE 16) ``stats_axis`` makes those counters
+    global (see _decode_mlp).
+
+    Batch-sharded expert-parallel decode (ISSUE 16): when the engine
+    shards the decode batch over the expert axis, every operand here is
+    this shard's LOCAL slice — B local slots, the page pool's local block
+    span, tables carrying LOCAL page ids (sentinel == local pool size).
+    Attention is row-local so nothing changes; the MoE dispatch
+    all_to_all hops are exactly the training-style layout moe_ffn was
+    written for, and no-drop routing keeps per-token outputs bit-equal
+    to the replicated program."""
     pos_ids = jnp.clip(pos[:, None] + jnp.arange(tokens.shape[1])[None, :],
                        0, cfg.n_ctx - 1)
     from distributed_lion_tpu.models.lora import lora_embed
@@ -726,7 +799,8 @@ def gpt2_decode_paged(params: dict, tokens: jnp.ndarray, cfg: GPT2Config,
     for p, c in zip(params["blocks"], pages):
         a, c = _paged_attention_block(_layer_norm(x, p["ln_1"]), p["attn"],
                                       cfg, c, tables, pos, valid, tp_axis)
-        x = _decode_mlp(x + a, p, cfg, tp_axis, valid, ep_axis, stats)
+        x = _decode_mlp(x + a, p, cfg, tp_axis, valid, ep_axis, stats,
+                        stats_axis, stats_lanes)
         new_pages.append(c)
     x = _layer_norm(x, params["ln_f"])
     logits = _tied_logits(x, params, cfg)

@@ -937,7 +937,8 @@ def squeeze_worker_state(state: LionState) -> LionState:
                      state.rng, state.elected, state.health,
                      None if state.prev_ballot is None
                      else state.prev_ballot[0],
-                     None if state.dcn_ring is None else state.dcn_ring[0])
+                     None if state.dcn_ring is None else state.dcn_ring[0],
+                     None if state.moe_ring is None else state.moe_ring[0])
 
 
 def expand_worker_state(state: LionState) -> LionState:
@@ -947,7 +948,9 @@ def expand_worker_state(state: LionState) -> LionState:
                      None if state.prev_ballot is None
                      else state.prev_ballot[None],
                      None if state.dcn_ring is None
-                     else state.dcn_ring[None])
+                     else state.dcn_ring[None],
+                     None if state.moe_ring is None
+                     else state.moe_ring[None])
 
 
 def remap_worker_momentum(exp_avg, old_world: int, new_world: int):

@@ -58,6 +58,23 @@ class LionState(NamedTuple):
     # sharded over the data axis like exp_avg/prev_ballot. Created by
     # init_global_state (slot width needs the world size); serializes with
     # the checkpoint so crash-resume stays bit-identical mid-flight.
+    moe_ring: Optional[jnp.ndarray] = None  # f32 [world, depth,
+    # n_moe_blocks, E+1] ring of in-flight MoE balance tallies (training
+    # --ep_dcn_pipeline > 0, ISSUE 16): slot (count mod depth) holds the
+    # expert-axis-psummed per-block routing tallies (per-expert token
+    # counts + lane count) this data worker produced at step count − depth,
+    # read by the trainer's step core to feed the aux balance loss d steps
+    # stale, then overwritten with this step's fresh tally. Per-DATA-worker
+    # divergent BY DESIGN (each worker balances against its own batch's
+    # stale load — no data-axis collective is added, preserving the
+    # async-grad contract that the vote is the only optimizer collective),
+    # so stacked [world, ...] and sharded over the data axis like exp_avg.
+    # Created by the Trainer (the tally shape needs the model config, which
+    # the optimizer never sees); the optimizer's step passes it through
+    # untouched. Serializes with the checkpoint so crash-resume keeps the
+    # in-flight staleness bit-identical; an all-zero slot (lane count 0)
+    # is the cold-start sentinel — the aux falls back to the fresh local
+    # load (parallel/expert.moe_ffn balance_tokens).
 
 
 def _validate(lr_init: float, b1: float, b2: float) -> None:

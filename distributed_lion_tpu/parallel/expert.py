@@ -79,6 +79,11 @@ def moe_ffn(
     tp_axis: Optional[str] = None,
     valid: Optional[jnp.ndarray] = None,
     return_stats: bool = False,
+    stats_axis: Optional[str] = None,
+    stats_lanes: Optional[int] = None,
+    balance_tokens: Optional[jnp.ndarray] = None,
+    balance_axis: Optional[str] = None,
+    return_tallies: bool = False,
 ):
     """Apply the MoE FFN to local tokens ``x [N, D]``.
 
@@ -114,6 +119,38 @@ def moe_ffn(
     budget: ``valid`` (real lanes routed), ``kept`` (of those, how many
     fit the per-expert budget), ``capacity_slots`` (E × budget). All f32
     scalars computable on-device with zero host syncs.
+
+    ``stats_axis`` (batch-sharded serving, ISSUE 16): when the TOKEN batch
+    is sharded over a mesh axis, each shard sees only its slice of the
+    tick's lanes — the stats psum the per-expert counts over that axis and
+    size the budget from the GLOBAL lane count, so capacity utilization /
+    dropped rate stay global quantities, bit-equal to the unsharded run.
+    Naively psumming the per-shard scalars is WRONG: ``capacity`` is a
+    ceil, so per-shard budgets don't sum to the global budget.
+    ``stats_lanes`` (static int) overrides that global lane count for
+    dispatches whose shards carry FAKE lanes the unsharded run never had
+    — the batch-sharded batch-1 prefill replays the prompt width on every
+    group with non-owners all-invalid, so its budget must come from the
+    true width, not ``n × shards``. Counts still psum (invalid lanes
+    contribute zero), keeping stats bit-equal to the unsharded engine.
+
+    ``balance_tokens`` (training ``--ep_dcn_pipeline``, ISSUE 16): an
+    ``[E+1]`` f32 vector — per-expert routed-token counts plus the total
+    lane count — substituted for the LOCAL token-load fraction in the aux
+    loss. The differentiable gate-probability factor stays fresh and
+    local; only the non-differentiable load estimate is replaced, which
+    is what lets the trainer feed a globally-psummed (and, at depth > 0,
+    ring-stale) load through the aux without adding a blocking collective
+    to the backward pass. ``return_tallies`` additionally returns this
+    step's fresh local ``[E+1]`` tally (stop-gradient) for the caller to
+    aggregate. ``balance_tokens=None`` is bit-identical to the historical
+    local-fraction aux; an all-zero tally (lane-count entry 0) is the
+    ring's cold-start sentinel and falls back to the local fraction.
+    ``balance_axis`` is the SYNCHRONOUS alternative (``--ep_dcn_pipeline
+    0``): psum the raw tallies over that axis inside the forward before
+    forming the load fraction — blocking, but exactly global-fresh; at
+    axis size 1 it is the local aux bit for bit. Mutually exclusive with
+    ``balance_tokens``.
 
     Returns ``(y [N, D], aux_loss scalar)`` (plus the stats dict when
     requested); add ``aux`=0.01*aux_loss`` to the train loss to balance
@@ -167,23 +204,58 @@ def moe_ffn(
     mask = one_hot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None].astype(x.dtype)
 
     # --- load-balance aux loss (computed on pre-drop assignments) ---
+    counts_f = one_hot_i.astype(jnp.float32).sum(axis=0)  # [E] real lanes
     if valid is None:
-        frac_tokens = one_hot_i.astype(jnp.float32).mean(axis=0)  # [E]
+        n_lanes = jnp.float32(n)
         frac_probs = probs.mean(axis=0)
     else:
         # averages over the REAL lanes only — pads must not dilute the
         # load estimate (inference-only today, but the mask must not make
         # the auxiliary silently wrong if it is ever consumed)
         v32 = valid.astype(jnp.float32)
-        nv = jnp.maximum(v32.sum(), 1.0)
-        frac_tokens = one_hot_i.astype(jnp.float32).sum(axis=0) / nv
-        frac_probs = (probs * v32[:, None]).sum(axis=0) / nv
+        n_lanes = v32.sum()
+        frac_probs = (probs * v32[:, None]).sum(axis=0) \
+            / jnp.maximum(n_lanes, 1.0)
+    local_frac = counts_f / jnp.maximum(n_lanes, 1.0)
+    if balance_tokens is not None:
+        # the fed-in (global, possibly stale) load estimate replaces the
+        # local one; gradients still flow through frac_probs only — the
+        # token-count factor was never differentiable to begin with. An
+        # all-zero tally (lane count 0) is the ring's cold-start sentinel:
+        # until depth steps have launched there is no stale global load
+        # yet, so the aux falls back to the fresh local fraction (every
+        # real tally has lane count > 0 — a training batch is never empty)
+        fed_frac = balance_tokens[:n_experts] \
+            / jnp.maximum(balance_tokens[n_experts], 1.0)
+        frac_tokens = jnp.where(balance_tokens[n_experts] > 0.0,
+                                fed_frac, local_frac)
+    elif balance_axis is not None:
+        # synchronous global balance (--ep_dcn_pipeline 0): psum the raw
+        # token tallies over the expert axis BEFORE forming the fraction —
+        # a blocking collective in the forward, which is exactly what
+        # depth 0 means. At axis size 1 the psums are identity, so this is
+        # the local fraction bit for bit.
+        frac_tokens = lax.psum(counts_f, balance_axis) \
+            / jnp.maximum(lax.psum(n_lanes, balance_axis), 1.0)
+    else:
+        frac_tokens = local_frac
     aux = n_experts * jnp.sum(frac_tokens * frac_probs)
+
+    tallies = None
+    if return_tallies:
+        tallies = lax.stop_gradient(jnp.concatenate(
+            [counts_f, jnp.reshape(jnp.asarray(n_lanes, jnp.float32), (1,))]))
 
     stats = None
     if return_stats:
-        budget = capacity(n, n_experts, capacity_factor)
-        counts = one_hot_i.sum(axis=0).astype(jnp.float32)  # [E] real lanes
+        counts = counts_f
+        n_stats = n
+        if stats_axis is not None:
+            counts = lax.psum(counts, stats_axis)
+            n_stats = n * lax.psum(1, stats_axis)
+        if stats_lanes is not None:
+            n_stats = stats_lanes
+        budget = capacity(n_stats, n_experts, capacity_factor)
         kept = jnp.minimum(counts, jnp.float32(budget)).sum()
         stats = {
             "valid": counts.sum(),
@@ -230,6 +302,10 @@ def moe_ffn(
 
     # --- combine: weight each token's slot by its gate probability ---
     y = jnp.einsum("nec,ecd->nd", mask * gate_p[:, None, None], out)
+    if return_stats and return_tallies:
+        return y, aux, stats, tallies
     if return_stats:
         return y, aux, stats
+    if return_tallies:
+        return y, aux, tallies
     return y, aux

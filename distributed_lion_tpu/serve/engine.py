@@ -179,6 +179,31 @@ class ServeConfig:
     # ep=1 is pinned bit-identical to the unsharded engine; ep in {2,4}
     # (and ep x tp) pinned token-identical on CPU mesh emulation
     # (tests/test_moe_serve.py).
+    ep_batch: bool = False       # batch-sharded expert-parallel decode
+    # (ISSUE 16): shard the decode/prefill/verify BATCH over the expert
+    # axis too — slot s lives on shard s // (max_seqs/ep), the page pools
+    # shard over their block dim (P(expert, None, tensor, None)) and each
+    # shard's tokens reach their experts through moe_ffn's two all_to_all
+    # hops, so per-chip attention+FFN FLOPs divide by ep (a THROUGHPUT
+    # lever, where plain --serve_ep only bought HBM). Host BlockTables
+    # stay replicated numpy partitioned into ep page groups; allocation
+    # never recompiles. Requires --serve_ep >= 1 with max_seqs and
+    # num_blocks divisible by ep. ep_batch at ep=1 is pinned bit-identical
+    # to the replicated-batch program; ep in {2,4} and ep x tp pinned
+    # token-identical on CPU mesh emulation (tests/test_ep_batch_serve.py).
+    # Prefix sharing composes group-locally (a cached page is only
+    # physically present on its group's shard).
+    ep_overlap: bool = False     # two-microbatch software pipelining of
+    # the decode tick (ISSUE 16): the tick splits its slots into two
+    # halves traced back-to-back in ONE dispatch, so microbatch B's
+    # attention (page-local) has no data dependency on microbatch A's
+    # expert-dispatch all_to_all and XLA's async collective scheduler can
+    # overlap the two — the fabric hop hides behind compute. Outputs are
+    # pinned bit-identical to the unsplit tick (attention is row-local,
+    # inference MoE routing is no-drop per-token). Requires an even
+    # per-shard slot count. Works with or without a mesh (off-mesh it is
+    # a scheduling no-op but stays pinned, which is what the CPU tests
+    # drive).
     moe_stats: bool = False      # accumulate MoE routing-load scalars
     # (valid/kept tokens vs the capacity_factor budget) into engine.stats
     # after every dispatch — the bench's capacity-utilization and
@@ -343,10 +368,12 @@ class ServeModel:
         from distributed_lion_tpu.models.gpt2 import gpt2_decode_paged
 
         def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None,
-                   ep_axis=None, return_moe_stats=False):
+                   ep_axis=None, return_moe_stats=False, stats_axis=None,
+                   stats_lanes=None):
             return gpt2_decode_paged(p, toks, cfg, pages, tables, pos,
                                      valid, tp_axis, ep_axis,
-                                     return_moe_stats)
+                                     return_moe_stats, stats_axis,
+                                     stats_lanes)
 
         return ServeModel("gpt2", cfg, params, decode, cfg.n_layer,
                           cfg.n_head, cfg.head_dim, cfg.compute_dtype,
@@ -357,10 +384,12 @@ class ServeModel:
         from distributed_lion_tpu.models.llama import llama_decode_paged
 
         def decode(p, toks, pages, tables, pos, valid=None, tp_axis=None,
-                   ep_axis=None, return_moe_stats=False):
+                   ep_axis=None, return_moe_stats=False, stats_axis=None,
+                   stats_lanes=None):
             # llama has no MoE blocks; the engine refuses --serve_ep for
             # it at build, so these can never be set here
             assert ep_axis is None and not return_moe_stats
+            assert stats_axis is None and stats_lanes is None
             return llama_decode_paged(p, toks, cfg, pages, tables, pos,
                                       valid, tp_axis)
 
@@ -478,8 +507,34 @@ class ServingEngine:
         self._pages_spec = None
         self._tp_axis = TENSOR_AXIS if cfg.tp else None
         self._ep_axis = EXPERT_AXIS if cfg.ep else None
+        self._ep_batch = bool(cfg.ep_batch)
+        self._ep_overlap = bool(cfg.ep_overlap)
         self._moe_stats = bool(cfg.moe_stats
                                and getattr(model.cfg, "moe_experts", 0) > 0)
+        if cfg.ep_batch:
+            if not cfg.ep:
+                raise ValueError(
+                    "--serve_ep_batch shards the decode batch over the "
+                    "expert axis — it needs --serve_ep >= 1")
+            if cfg.max_seqs % cfg.ep:
+                raise ValueError(
+                    f"--serve_ep_batch needs max_seqs ({cfg.max_seqs}) "
+                    f"divisible by --serve_ep {cfg.ep}: slots partition "
+                    "evenly over the expert shards")
+            if cfg.resolved_num_blocks() % cfg.ep:
+                raise ValueError(
+                    f"--serve_ep_batch needs num_blocks "
+                    f"({cfg.resolved_num_blocks()}) divisible by "
+                    f"--serve_ep {cfg.ep}: the page pool shards over its "
+                    "block dim")
+        groups = cfg.ep if cfg.ep_batch else 1
+        if cfg.ep_overlap:
+            local_slots = cfg.max_seqs // groups
+            if local_slots % 2 or local_slots < 2:
+                raise ValueError(
+                    f"--serve_ep_overlap splits each shard's "
+                    f"{local_slots} decode slots into two microbatches — "
+                    "the per-shard slot count must be even (and >= 2)")
         pages_sharding = None
         if cfg.ep:
             n_experts = getattr(model.cfg, "moe_experts", 0)
@@ -529,14 +584,20 @@ class ServingEngine:
                     validate_quant_tp(params, specs, cfg.ep, EXPERT_AXIS)
             params = _shard_params(params, specs, self._mesh)
             self._param_specs = specs
-            pool_spec = P(None, None, TENSOR_AXIS, None)
+            # batch-sharded ep additionally shards the pool over its
+            # BLOCK dim (each shard holds its slot group's pages); the
+            # kv-head axis stays tensor-sharded either way
+            pool_spec = (P(EXPERT_AXIS, None, TENSOR_AXIS, None)
+                         if cfg.ep_batch
+                         else P(None, None, TENSOR_AXIS, None))
             self._pages_spec = [{"k": pool_spec, "v": pool_spec}
                                 for _ in range(model.n_layer)]
             pages_sharding = NamedSharding(self._mesh, pool_spec)
         self.params = params
 
         self.tables = BlockTables(cfg.resolved_num_blocks(), cfg.block_size,
-                                  cfg.max_seqs, cfg.max_blocks_per_seq)
+                                  cfg.max_seqs, cfg.max_blocks_per_seq,
+                                  groups=groups)
         self.pages = init_pages(model.n_layer, cfg.resolved_num_blocks(),
                                 cfg.block_size, model.kv_heads,
                                 model.head_dim, model.cache_dtype)
@@ -544,7 +605,20 @@ class ServingEngine:
             self.pages = [
                 {k: jax.device_put(v, pages_sharding)
                  for k, v in layer.items()} for layer in self.pages]
-        self.prefix = PrefixCache(self.tables) if cfg.prefix_cache else None
+        # one PrefixCache per pool group (sharing is group-local under
+        # batch-sharded ep: a cached page is physically present only on
+        # its group's shard); ``self.prefix`` stays the groups==1 alias
+        # the existing tests/bench read
+        if cfg.prefix_cache:
+            if self.tables.groups == 1:
+                self._prefix_caches = [PrefixCache(self.tables)]
+            else:
+                self._prefix_caches = [PrefixCache(self.tables, g)
+                                       for g in range(self.tables.groups)]
+            self.prefix = self._prefix_caches[0]
+        else:
+            self._prefix_caches = None
+            self.prefix = None
         self.slots: List[Optional[_Slot]] = [None] * cfg.max_seqs
         self.pending: deque = deque()
         # req_id -> absolute time.monotonic() deadline (requests with a
@@ -567,44 +641,100 @@ class ServingEngine:
         samp = (cfg.temperature, cfg.top_k, cfg.top_p)
         tp_axis, ep_axis = self._tp_axis, self._ep_axis
         moe_stats = self._moe_stats
+        # batch-sharded ep: each shard routes only its batch slice, so
+        # the routing-load counters must psum over the expert axis to
+        # stay global (parallel/expert.moe_ffn stats_axis)
+        stats_axis = ep_axis if cfg.ep_batch else None
+        overlap = self._ep_overlap
 
         def decode_tick(params, pages, tables, lens, last, act, seeds,
                         counts):
             # act [S] bool: the engine's valid-lane mask for the tick —
             # inactive (sentinel) slots are dead lanes for expert routing
-            # and for the scatter (which their sentinel rows drop anyway)
-            out = model.decode_paged(params, last[:, None], pages, tables,
-                                     lens, act[:, None], tp_axis=tp_axis,
-                                     ep_axis=ep_axis,
-                                     return_moe_stats=moe_stats)
-            logits, pages = out[0], out[1]
-            st = out[2] if moe_stats else {}
+            # and for the scatter (which their sentinel rows drop anyway).
+            # Under ep_batch every operand here is this shard's LOCAL
+            # slot slice and ``tables`` carries group-local page ids.
+            def run(pages, sl):
+                out = model.decode_paged(
+                    params, last[sl][:, None], pages, tables[sl], lens[sl],
+                    act[sl][:, None], tp_axis=tp_axis, ep_axis=ep_axis,
+                    return_moe_stats=moe_stats, stats_axis=stats_axis)
+                return out[0], (out[2] if moe_stats else {}), out[1]
+
+            if not overlap:
+                logits, st, pages = run(pages, slice(None))
+            else:
+                # two microbatches traced back-to-back in ONE program:
+                # B's attention depends on A only through the page
+                # buffers (disjoint rows), NOT on A's expert all_to_all —
+                # XLA's async collective scheduling overlaps the two.
+                # Bit-identical to the unsplit tick: attention is
+                # row-local and inference MoE routing is no-drop
+                # per-token (capacity_override = the microbatch size
+                # still never drops).
+                n = lens.shape[0]
+                la, sa, pages = run(pages, slice(0, n // 2))
+                lb, sb, pages = run(pages, slice(n // 2, None))
+                logits = jnp.concatenate([la, lb], axis=0)
+                st = {k: sa[k] + sb[k] for k in sa} if moe_stats else {}
             return (_sample_rows(logits[:, -1], seeds, counts, *samp),
                     st), pages
 
         def prefill(params, pages, tables, toks, start, length, seed, count):
             # toks [1, P] — the prompt SUFFIX not covered by shared prefix
             # pages, scattered at absolute positions start..start+P-1
-            # (start == 0 without prefix sharing: the whole prompt)
-            valid = jnp.arange(toks.shape[1])[None, :] < length
+            # (start == 0 without prefix sharing: the whole prompt).
+            # Under ep_batch the batch-1 prefill stays one dispatch: every
+            # shard traces the same program, but only the OWNER group's
+            # shard receives the slot's table row and the true length —
+            # the others see an all-sentinel row and length 0 (all lanes
+            # invalid), so their scatters drop, their lanes consume zero
+            # expert capacity, and their sampled lane is garbage the host
+            # never reads (the token output is expert-sharded [ep]; the
+            # host picks the owner's entry).
+            L = jnp.reshape(length, (-1,))[0]
+            valid = jnp.arange(toks.shape[1])[None, :] < L
+            # stats_lanes: non-owner groups replay the width with every
+            # lane invalid — fake lanes that must not inflate the stats
+            # capacity budget past the unsharded prefill's (ceil is
+            # nonlinear, so the budget can't be corrected after the fact)
             out = model.decode_paged(params, toks, pages, tables,
                                      start, valid, tp_axis=tp_axis,
                                      ep_axis=ep_axis,
-                                     return_moe_stats=moe_stats)
+                                     return_moe_stats=moe_stats,
+                                     stats_axis=stats_axis,
+                                     stats_lanes=(toks.shape[1]
+                                                  if stats_axis else None))
             logits, pages = out[0], out[1]
             st = out[2] if moe_stats else {}
-            last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
-                                                keepdims=False)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], jnp.maximum(L - 1, 0), 0, keepdims=False)
             tok = _sample_rows(last[None], seed[None], count[None], *samp)
-            return (tok[0], st), pages
+            return (tok, st), pages
 
         def cow_copy(pages, src, dst):
             from distributed_lion_tpu.ops.attention import paged_copy_pages
 
-            return paged_copy_pages(pages, src, dst)
+            # src/dst arrive [width] (replicated) or [1, width] (this
+            # shard's row of the grouped layout) — flatten either way
+            return paged_copy_pages(pages, src.reshape(-1), dst.reshape(-1))
 
-        self._decode_tick = self._jit_paged(decode_tick, n_rest=6)
-        self._prefill = self._jit_paged(prefill, n_rest=6)
+        if cfg.ep_batch:
+            from jax.sharding import PartitionSpec as P
+
+            bsp, rep = P(EXPERT_AXIS), P()
+            tab = P(EXPERT_AXIS, None)
+            self._decode_tick = self._jit_paged(
+                decode_tick, n_rest=6,
+                rest_specs=(tab, bsp, bsp, bsp, bsp, bsp),
+                out_spec=(bsp, rep))
+            self._prefill = self._jit_paged(
+                prefill, n_rest=6,
+                rest_specs=(tab, rep, bsp, bsp, rep, rep),
+                out_spec=(bsp, rep))
+        else:
+            self._decode_tick = self._jit_paged(decode_tick, n_rest=6)
+            self._prefill = self._jit_paged(prefill, n_rest=6)
         self._cow = self._jit_cow(cow_copy)
 
         self._speculator = None
@@ -615,13 +745,20 @@ class ServingEngine:
                                                 draft_model)
 
     # ------------------------------------------------------- TP dispatch
-    def _jit_paged(self, fn, n_rest: int):
+    def _jit_paged(self, fn, n_rest: int, rest_specs=None, out_spec=None):
         """jit a dispatch ``fn(params, pages, *rest) -> (out, pages)``;
         under TP the body is shard_map'd over the serving mesh — params
         and pages sharded per their spec trees, every host-built operand
         (tables, lens, tokens, seeds) replicated, the sampled tokens
         replicated back out (each rank computes identical logits: see the
-        model hooks). ``check_vma=False`` mirrors the trainer's usage."""
+        model hooks). ``check_vma=False`` mirrors the trainer's usage.
+
+        Batch-sharded ep (ISSUE 16) passes ``rest_specs`` (one
+        PartitionSpec per rest operand — slot-leading arrays shard
+        ``P(EXPERT_AXIS)``) and ``out_spec`` (the spec-prefix for the
+        first output, e.g. ``(P(EXPERT_AXIS), P())`` for
+        expert-sharded sampled tokens + replicated psummed stats);
+        speculative verify reuses the same hooks (serve/speculate.py)."""
         import jax
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
@@ -630,17 +767,23 @@ class ServingEngine:
         from jax.sharding import PartitionSpec as P
 
         rep = P()
+        if rest_specs is None:
+            rest_specs = (rep,) * n_rest
+        if out_spec is None:
+            out_spec = rep
         body = jax.shard_map(
             fn, mesh=self._mesh,
             in_specs=(self._param_specs, self._pages_spec)
-            + (rep,) * n_rest,
-            out_specs=(rep, self._pages_spec), check_vma=False)
+            + tuple(rest_specs),
+            out_specs=(out_spec, self._pages_spec), check_vma=False)
         return jax.jit(body, donate_argnums=donate)
 
     def _jit_cow(self, fn):
         """jit the CoW page-copy ``fn(pages, src, dst) -> pages`` (pages
         donated; shard-local under TP — page ids are replicated host
-        math, the kv-head axis stays put)."""
+        math, the kv-head axis stays put). Under batch-sharded ep the
+        src/dst ids arrive as the grouped ``[ep, width]`` layout, each
+        shard copying only its own group's rows with LOCAL ids."""
         import jax
 
         donate = (0,) if jax.default_backend() != "cpu" else ()
@@ -649,9 +792,10 @@ class ServingEngine:
         from jax.sharding import PartitionSpec as P
 
         rep = P()
+        idx = P(EXPERT_AXIS) if self._ep_batch else rep
         body = jax.shard_map(
             fn, mesh=self._mesh,
-            in_specs=(self._pages_spec, rep, rep),
+            in_specs=(self._pages_spec, idx, idx),
             out_specs=self._pages_spec, check_vma=False)
         return jax.jit(body, donate_argnums=donate)
 
@@ -705,6 +849,27 @@ class ServingEngine:
         return bucket_tokens(n, self.cfg.block_size,
                              self.cfg.max_blocks_per_seq)
 
+    def _prefix_for(self, slot: int) -> PrefixCache:
+        """The prefix cache serving ``slot``'s pool group (the one cache
+        when the batch is not expert-sharded)."""
+        return self._prefix_caches[self.tables.group_of(slot)]
+
+    def _device_tables(self):
+        """The decode tick's device view of the block tables: the global
+        numpy table as-is, or — under batch-sharded ep — group-LOCAL page
+        ids (sentinel == the local pool size, inert on every shard's
+        scatter/gather exactly like the global sentinel is globally)."""
+        import jax.numpy as jnp
+
+        bt = self.tables
+        if not self._ep_batch:
+            return jnp.asarray(bt.tables)
+        base = (np.arange(bt.max_seqs, dtype=np.int32)
+                // bt.slots_per_group) * bt.blocks_per_group
+        local = np.where(bt.tables == bt.sentinel, bt.blocks_per_group,
+                         bt.tables - base[:, None]).astype(np.int32)
+        return jnp.asarray(local)
+
     # ------------------------------------------------- page bookkeeping
     def _grow(self, slot: int, n_tokens: int) -> bool:
         """``tables.grow`` with prefix-cache reclaim as the fallback: a
@@ -720,7 +885,7 @@ class ServingEngine:
             return False  # width cap, not pool pressure: no reclaim helps
         need = (self.tables.blocks_for(n_tokens)
                 - int(self.tables.owned[slot]))
-        self.stats["reclaimed_pages"] += self.prefix.reclaim(need)
+        self.stats["reclaimed_pages"] += self._prefix_for(slot).reclaim(need)
         return self.tables.grow(slot, n_tokens)
 
     def _cow_if_shared(self, slot: int, pos: int, pairs: List[tuple]) -> bool:
@@ -732,7 +897,7 @@ class ServingEngine:
             return True
         pair = self.tables.cow(slot, pos)
         if pair is None:
-            self.stats["reclaimed_pages"] += self.prefix.reclaim(1)
+            self.stats["reclaimed_pages"] += self._prefix_for(slot).reclaim(1)
             if not self.tables.shared_at(slot, pos):
                 # the reclaim dropped the cache's own ref on this page —
                 # it is private now, no copy needed (retrying cow here
@@ -753,13 +918,33 @@ class ServingEngine:
             return
         import jax.numpy as jnp
 
-        width = self.cfg.max_seqs
-        assert len(pairs) <= width, "more CoW copies than slots"
-        sentinel = self.tables.sentinel
-        src = np.full((width,), sentinel, np.int32)
-        dst = np.full((width,), sentinel, np.int32)
-        for i, (s, d) in enumerate(pairs):
-            src[i], dst[i] = s, d
+        bt = self.tables
+        if self._ep_batch:
+            # grouped layout [ep, width]: each shard receives its group's
+            # row with LOCAL page ids (a CoW pair is always intra-group —
+            # cow() mints from the slot's own group), padded with the
+            # LOCAL sentinel so unused lanes drop on device
+            width = bt.slots_per_group
+            lsent = bt.blocks_per_group
+            src = np.full((bt.groups, width), lsent, np.int32)
+            dst = np.full((bt.groups, width), lsent, np.int32)
+            fill = np.zeros((bt.groups,), np.int32)
+            for s, d in pairs:
+                g = s // bt.blocks_per_group
+                base = g * bt.blocks_per_group
+                i = int(fill[g])
+                fill[g] += 1
+                src[g, i] = s - base
+                dst[g, i] = d - base
+            assert fill.max() <= width, "more CoW copies than group slots"
+        else:
+            width = self.cfg.max_seqs
+            assert len(pairs) <= width, "more CoW copies than slots"
+            sentinel = bt.sentinel
+            src = np.full((width,), sentinel, np.int32)
+            dst = np.full((width,), sentinel, np.int32)
+            for i, (s, d) in enumerate(pairs):
+                src[i], dst[i] = s, d
         with journal.active().span("serve/cow", copies=len(pairs)):
             self.pages = self._cow(self.pages, jnp.asarray(src),
                                    jnp.asarray(dst))
@@ -808,7 +993,7 @@ class ServingEngine:
                 # recency for a request that cannot admit)
             run, covered = ([], 0)
             if self.prefix is not None:
-                run, covered = self.prefix.match(hist)
+                run, covered = self._prefix_for(slot).match(hist)
             P = self._bucket(L - covered)
             if admitted and P > budget:
                 break  # fairness cap — but never starve an empty tick
@@ -829,16 +1014,43 @@ class ServingEngine:
                            shared=covered, resumed=len(req.committed)):
                 toks = np.zeros((1, P), np.int32)
                 toks[0, :len(suffix)] = suffix
+                bt = self.tables
+                g = bt.group_of(slot)
+                if self._ep_batch:
+                    # only the OWNER group's shard gets the real table
+                    # row (LOCAL ids) and the true length — the other
+                    # shards see all-sentinel + length 0 (every lane
+                    # invalid): their scatters drop, their lanes consume
+                    # zero expert capacity, their sampled lane is never
+                    # read (the token output is expert-sharded [ep])
+                    tab = np.full((bt.groups, bt.max_blocks_per_seq),
+                                  bt.blocks_per_group, np.int32)
+                    row = bt.tables[slot]
+                    tab[g] = np.where(row == bt.sentinel,
+                                      bt.blocks_per_group,
+                                      row - bt.group_base(g))
+                    start_h = np.zeros((bt.groups,), np.int32)
+                    start_h[g] = covered
+                    len_h = np.zeros((bt.groups,), np.int32)
+                    len_h[g] = len(suffix)
+                    tab_dev = jnp.asarray(tab)
+                    start_dev = jnp.asarray(start_h)
+                    len_dev = jnp.asarray(len_h)
+                else:
+                    tab_dev = jnp.asarray(bt.tables[slot:slot + 1])
+                    start_dev = jnp.full((1,), covered, jnp.int32)
+                    len_dev = jnp.int32(len(suffix))
                 # the sample index resumes at len(committed): the key for
                 # this draw is fold_in(key(seed), len(committed)) — the
                 # exact key the pre-migration engine would use next
                 (tok, st), self.pages = self._prefill(
-                    self.params, self.pages,
-                    jnp.asarray(self.tables.tables[slot:slot + 1]),
-                    jnp.asarray(toks), jnp.full((1,), covered, jnp.int32),
-                    jnp.int32(len(suffix)),
+                    self.params, self.pages, tab_dev, jnp.asarray(toks),
+                    start_dev, len_dev,
                     jnp.uint32(req.seed), jnp.int32(len(req.committed)))
-                first = int(tok)  # ONE host sync per prefill dispatch
+                # ONE host sync per prefill dispatch (the owner group's
+                # lane under ep_batch; the only lane otherwise)
+                first = int(np.asarray(tok).reshape(-1)[
+                    g if self._ep_batch else 0])
                 self._absorb_moe_stats(st)
             budget -= P
             admitted += 1
@@ -852,7 +1064,7 @@ class ServingEngine:
                 if covered:
                     self.stats["prefix_hits"] += 1
                     self.stats["shared_tokens"] += covered
-                self.prefix.register(slot, hist)
+                self._prefix_for(slot).register(slot, hist)
             slot_state = _Slot(req=req, cache_len=L, last_tok=first,
                                budget=(req.max_new_tokens
                                        or self.cfg.max_new_tokens))
@@ -931,7 +1143,7 @@ class ServingEngine:
             counts[i] = len(s.gen)  # index of the token being sampled
         with journal.active().span("serve/decode_tick", batch=len(active)):
             (toks, st), self.pages = self._decode_tick(
-                self.params, self.pages, jnp.asarray(self.tables.tables),
+                self.params, self.pages, self._device_tables(),
                 jnp.asarray(lens), jnp.asarray(last), jnp.asarray(act),
                 jnp.asarray(seeds), jnp.asarray(counts))
             toks = np.asarray(toks)  # ONE host sync for the whole batch

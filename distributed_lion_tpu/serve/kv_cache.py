@@ -80,19 +80,35 @@ class BlockTables:
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_seqs: int,
-                 max_blocks_per_seq: int):
+                 max_blocks_per_seq: int, groups: int = 1):
         if num_blocks < 1 or block_size < 1:
             raise ValueError(
                 f"need positive pool dims, got num_blocks={num_blocks} "
                 f"block_size={block_size}")
+        if groups < 1 or num_blocks % groups or max_seqs % groups:
+            raise ValueError(
+                f"groups={groups} must divide num_blocks={num_blocks} and "
+                f"max_seqs={max_seqs}")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.max_seqs = int(max_seqs)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
         self.sentinel = self.num_blocks
-        # LIFO free list: recently-freed pages are re-used first, which
-        # keeps the working set of the pool small and cache-warm
-        self._free = list(range(self.num_blocks - 1, -1, -1))
+        # Batch-sharded expert-parallel serving (ISSUE 16) partitions the
+        # pool into ``groups`` contiguous spans: group g owns pages
+        # [g*bpg, (g+1)*bpg) and slots [g*spg, (g+1)*spg) — each device
+        # shard holds exactly one group's pages, so a slot's table entries
+        # (minus the group base) are valid LOCAL page ids on its shard.
+        self.groups = int(groups)
+        self.blocks_per_group = self.num_blocks // self.groups
+        self.slots_per_group = self.max_seqs // self.groups
+        # per-group LIFO free lists: recently-freed pages are re-used
+        # first, which keeps the working set of the pool small and
+        # cache-warm. groups=1 is bit-identical to the historical single
+        # list (same pop/append order).
+        bpg = self.blocks_per_group
+        self._free = [list(range((g + 1) * bpg - 1, g * bpg - 1, -1))
+                      for g in range(self.groups)]
         self.tables = np.full((max_seqs, max_blocks_per_seq), self.sentinel,
                               np.int32)
         self.owned = np.zeros((max_seqs,), np.int32)
@@ -106,7 +122,20 @@ class BlockTables:
     # ------------------------------------------------------------ capacity
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    def group_of(self, slot: int) -> int:
+        """The pool group ``slot`` allocates from (its device shard under
+        batch-sharded ep; group 0 covers everything when groups == 1)."""
+        return int(slot) // self.slots_per_group
+
+    def group_base(self, group: int) -> int:
+        """First page id of ``group``'s pool span — subtract it from a
+        table entry to get the shard-LOCAL page id."""
+        return int(group) * self.blocks_per_group
+
+    def free_blocks_in(self, group: int) -> int:
+        return len(self._free[group])
 
     @property
     def max_tokens_per_seq(self) -> int:
@@ -121,25 +150,26 @@ class BlockTables:
         need = self.blocks_for(n_tokens)
         if need > self.max_blocks_per_seq:
             return False
-        return need - int(self.owned[slot]) <= len(self._free)
+        return need - int(self.owned[slot]) <= len(
+            self._free[self.group_of(slot)])
 
     # ---------------------------------------------------------- alloc/free
-    def _mint(self) -> int:
-        """Pop a fresh page off the free list at ref 1 (counted)."""
-        p = self._free.pop()
+    def _mint(self, group: int = 0) -> int:
+        """Pop a fresh page off ``group``'s free list at ref 1 (counted)."""
+        p = self._free[group].pop()
         assert self.refs[p] == 0, f"page {p} on the free list with refs"
         self.refs[p] = 1
         self.pages_allocated += 1
         return p
 
     def _release(self, page: int) -> int:
-        """Drop one ref; the page returns to the LIFO free list only at
-        ref 0. Returns 1 when the page was physically freed, else 0."""
+        """Drop one ref; the page returns to its group's LIFO free list
+        only at ref 0. Returns 1 when the page was physically freed."""
         page = int(page)
         assert self.refs[page] > 0, f"double free of page {page}"
         self.refs[page] -= 1
         if self.refs[page] == 0:
-            self._free.append(page)
+            self._free[page // self.blocks_per_group].append(page)
             return 1
         return 0
 
@@ -157,8 +187,9 @@ class BlockTables:
             # the tail pages' refs (table entries past owned are invisible
             # to every release path) — the refcount fuzz test caught this
             return True
+        g = self.group_of(slot)
         for i in range(have, need):
-            self.tables[slot, i] = self._mint()
+            self.tables[slot, i] = self._mint(g)
         self.owned[slot] = need
         return True
 
@@ -222,8 +253,13 @@ class BlockTables:
             raise ValueError(
                 f"shared run of {len(pages)} pages exceeds the table "
                 f"width {self.max_blocks_per_seq}")
+        g = self.group_of(slot)
         for i, p in enumerate(pages):
             assert self.refs[p] > 0, f"sharing unowned page {p}"
+            assert int(p) // self.blocks_per_group == g, (
+                f"page {p} belongs to group "
+                f"{int(p) // self.blocks_per_group}, slot {slot} is in "
+                f"group {g} — prefix sharing is group-local")
             self.tables[slot, i] = int(p)
             self.refs[p] += 1
         self.owned[slot] = len(pages)
@@ -250,9 +286,10 @@ class BlockTables:
         src = int(self.tables[slot, idx])
         assert self.refs[src] > 1, \
             f"cow on unshared page {src} (slot {slot} pos {pos})"
-        if not self._free:
+        g = self.group_of(slot)
+        if not self._free[g]:
             return None
-        dst = self._mint()
+        dst = self._mint(g)
         self.refs[src] -= 1  # > 0 by the assert: never returns to the pool
         self.tables[slot, idx] = dst
         return src, dst
@@ -271,7 +308,7 @@ class BlockTables:
     @property
     def physical_pages(self) -> int:
         """Pages currently holding data (refs > 0)."""
-        return self.num_blocks - len(self._free)
+        return self.num_blocks - self.free_blocks
 
 
 class PrefixCache:
@@ -296,8 +333,13 @@ class PrefixCache:
     its last prompt token to produce the logits its first sample needs.
     """
 
-    def __init__(self, tables: BlockTables):
+    def __init__(self, tables: BlockTables, group: Optional[int] = None):
         self.tables = tables
+        # Under batch-sharded ep the engine runs ONE PrefixCache per pool
+        # group (sharing is only physically possible inside a group — the
+        # shards never see each other's pages); ``group`` scopes reclaim's
+        # free-count check to that group's span. None = whole pool.
+        self.group = group
         self.bs = tables.block_size
         # key (token tuple) -> {"page": id, "full": bool, "tick": lru}
         # partial pages appear under EVERY prefix key of their coverage;
@@ -407,7 +449,13 @@ class PrefixCache:
         parent is gone can never be matched again and would leak its ref.
         Returns the count of pages physically freed."""
         freed = 0
-        while self.tables.free_blocks < n_pages and self._entries:
+
+        def _free_now():
+            if self.group is None:
+                return self.tables.free_blocks
+            return self.tables.free_blocks_in(self.group)
+
+        while _free_now() < n_pages and self._entries:
             # distinct entries, oldest first
             oldest = min({id(e): e for e in self._entries.values()}.values(),
                          key=lambda e: e["tick"])

@@ -392,18 +392,22 @@ class Speculator:
         tp_axis, ep_axis = engine._tp_axis, engine._ep_axis
 
         moe_stats = engine._moe_stats
+        stats_axis = ep_axis if engine._ep_batch else None
 
         def verify(params, pages, tables, lens, window, vcounts, seeds,
                    counts):
             # window [B, k+1] = [last_tok, d_1 .. d_k]; row b's first
             # vcounts[b] entries are real (0 = inactive slot: every write
-            # drops, the draws are garbage the host never reads).
+            # drops, the draws are garbage the host never reads). Under
+            # batch-sharded ep every operand is this shard's local slot
+            # slice, tables carry group-local page ids.
             W = window.shape[1]
             valid = jnp.arange(W)[None, :] < vcounts[:, None]
             out = model.decode_paged(params, window, pages, tables, lens,
                                      valid, tp_axis=tp_axis,
                                      ep_axis=ep_axis,
-                                     return_moe_stats=moe_stats)
+                                     return_moe_stats=moe_stats,
+                                     stats_axis=stats_axis)
             logits, pages = out[0], out[1]
             st = out[2] if moe_stats else {}
             B, _, V = logits.shape
@@ -419,8 +423,21 @@ class Speculator:
 
         # the engine's dispatch wrapper: plain jit at tp=0, shard_map'd
         # over the serving mesh under TP (ISSUE 13) — the verify window
-        # is just a wider decode tick, so it shards identically
-        self._verify = engine._jit_paged(verify, n_rest=6)
+        # is just a wider decode tick, so it shards identically; under
+        # batch-sharded ep (ISSUE 16) every slot-leading operand and the
+        # [B, k+1] draws shard over the expert axis like the decode tick
+        if engine._ep_batch:
+            from jax.sharding import PartitionSpec as P
+
+            from distributed_lion_tpu.parallel.mesh import EXPERT_AXIS
+
+            bsp, rep = P(EXPERT_AXIS), P()
+            self._verify = engine._jit_paged(
+                verify, n_rest=6,
+                rest_specs=(P(EXPERT_AXIS, None), bsp, bsp, bsp, bsp, bsp),
+                out_spec=(bsp, rep))
+        else:
+            self._verify = engine._jit_paged(verify, n_rest=6)
 
     # lifecycle relays from the engine
     def on_admit(self, slot: int, tokens: List[int],
@@ -505,7 +522,7 @@ class Speculator:
         with jrnl.span("serve/verify", batch=len(active),
                        proposed=int(sum(desired[i] for i in active))):
             (draws, st), eng.pages = self._verify(
-                eng.params, eng.pages, jnp.asarray(tables.tables),
+                eng.params, eng.pages, eng._device_tables(),
                 jnp.asarray(lens), jnp.asarray(window),
                 jnp.asarray(vcounts), jnp.asarray(seeds),
                 jnp.asarray(gcounts))

@@ -138,6 +138,23 @@ class TrainConfig:
     # 0 = today's synchronous hier wire. Checkpoints carry the ring, so
     # crash-resume stays bit-identical at any depth; a depth toggle on
     # resume errors loudly. See ARCHITECTURE 'DCN overlap'.
+    ep_dcn_pipeline: Optional[int] = None  # MoE balance-feedback staleness
+    # when the EXPERT axis spans DCN (ISSUE 16). None (default) = today's
+    # per-shard local aux, bit for bit. 0 = synchronous global balance:
+    # each MoE block psums its routing tallies over the expert axis inside
+    # the forward (a blocking DCN collective per MoE block — exact, and at
+    # ep=1 bit-identical to unflagged). d > 0 = pipelined: the aux consumes
+    # the globally-psummed tallies from d steps ago (LionState.moe_ring,
+    # one slot per in-flight step, per-data-worker divergent — no DATA-axis
+    # collective is added, so async_grad's only-collective-is-the-vote
+    # contract holds), and this step's fresh tallies launch into the ring
+    # after the backward — the slow fabric's round trip rides behind d
+    # steps of compute. Token activations stay synchronous (the two MoE
+    # all_to_all hops are exact); ONLY the non-differentiable load
+    # estimate in the aux loss goes stale. First d steps fall back to the
+    # local aux (cold start). Lion-only at d > 0 (the ring rides
+    # LionState); needs MoE blocks; checkpoints carry the ring and a depth
+    # toggle on resume errors loudly, like --dcn_pipeline_depth.
     kernel: str = "auto"  # auto | pallas | xla (ops/pallas_lion fused path)
     row_block: int = 0  # Pallas lion kernel tile rows (multiple of 32).
     # 0 = auto: the Trainer consults the device-keyed autotune cache
@@ -592,6 +609,17 @@ def make_optimizer(cfg: TrainConfig) -> FunctionalOptimizer:
                 f"the hier wire's level-2 (DCN) leg, but the wire here is "
                 f"{cfg.wire!r} — a wire without a DCN leg has nothing to "
                 "overlap; pass --wire hier:<g>")
+    if cfg.ep_dcn_pipeline is not None:
+        if cfg.ep_dcn_pipeline < 0:
+            raise ValueError(
+                f"--ep_dcn_pipeline must be >= 0, got {cfg.ep_dcn_pipeline}")
+        if cfg.ep_dcn_pipeline > 0 and not cfg.lion:
+            raise ValueError(
+                f"--ep_dcn_pipeline {cfg.ep_dcn_pipeline} stores the "
+                "in-flight MoE balance tallies on LionState.moe_ring; the "
+                "AdamW path has no per-worker optimizer state to carry "
+                "them — use --lion, or --ep_dcn_pipeline 0 (the "
+                "synchronous global balance needs no ring)")
     if cfg.lion:
         mom_dtype = jnp.dtype(cfg.mom_dtype) if cfg.mom_dtype else None
         return distributed_lion(
@@ -644,7 +672,10 @@ def _opt_state_specs(cfg: TrainConfig, exp_avg_specs):
                          health=P() if guard_on else None,
                          prev_ballot=P(DATA_AXIS) if guard_on else None,
                          dcn_ring=(P(DATA_AXIS)
-                                   if cfg.dcn_pipeline_depth > 0 else None))
+                                   if cfg.dcn_pipeline_depth > 0 else None),
+                         moe_ring=(P(DATA_AXIS)
+                                   if (cfg.ep_dcn_pipeline or 0) > 0
+                                   else None))
     if cfg.zero1:
         # [world, chunk] m/v sharded over 'data': ZeRO-1 state partitioning
         return Zero1State(count=P(), m=P(DATA_AXIS), v=P(DATA_AXIS))
@@ -902,6 +933,24 @@ class Trainer:
                 self.opt, self.params, self.world,
                 rng=rng if cfg.max_grad_norm is not None else None,
             )
+            if (cfg.ep_dcn_pipeline or 0) > 0:
+                # the MoE balance ring (--ep_dcn_pipeline d > 0): one
+                # [n_moe_blocks, E+1] tally slot per in-flight step, stacked
+                # per data worker like the momenta. Created HERE, not by
+                # init_global_state — the tally shape is model config,
+                # which the optimizer never sees; the loss the MoE trainer
+                # built stamps it on itself (_moe_tally_shape).
+                tshape = getattr(loss_fn, "_moe_tally_shape", None)
+                if tshape is None:
+                    raise ValueError(
+                        f"--ep_dcn_pipeline {cfg.ep_dcn_pipeline} > 0 "
+                        "needs the MoE trainer's loss (make_trainer with "
+                        "--moe_experts), which stamps the balance-tally "
+                        "shape the ring is sized from; this loss carries "
+                        "none")
+                state = state._replace(moe_ring=jnp.zeros(
+                    (self.world, cfg.ep_dcn_pipeline) + tuple(tshape),
+                    jnp.float32))
             self.state = jax.device_put(
                 state,
                 LionState(
@@ -916,6 +965,8 @@ class Trainer:
                     prev_ballot=None if state.prev_ballot is None
                     else NamedSharding(mesh, P(DATA_AXIS)),
                     dcn_ring=None if state.dcn_ring is None
+                    else NamedSharding(mesh, P(DATA_AXIS)),
+                    moe_ring=None if state.moe_ring is None
                     else NamedSharding(mesh, P(DATA_AXIS)),
                 ),
             )
@@ -1276,6 +1327,11 @@ class Trainer:
         guard_on = self._guard is not None
         guard_enforce = guard_on and cfg.vote_guard == "enforce"
         vh_specs = jax.tree.map(lambda _: P(), self.vote_health)
+        # --ep_dcn_pipeline d > 0: the loss takes a stale global balance
+        # tally (read from LionState.moe_ring pre-scan) and returns this
+        # step's fresh local tallies on the metrics dict under the
+        # reserved 'moe_tallies' key (popped in-trace below, never logged)
+        ring_on = getattr(self.loss_fn, "_wants_moe_balance", False)
 
         @partial(
             jax.shard_map,
@@ -1286,8 +1342,21 @@ class Trainer:
             check_vma=False,
         )
         def step(params, state, vh, frozen, batch, base_key):
-            call_loss = ((lambda p, b, k: loss_fn(p, frozen, b, k))
+            call_loss = ((lambda p, b, k, *a: loss_fn(p, frozen, b, k, *a))
                          if has_frozen else loss_fn)
+            stale_balance, ring, ring_slot = None, None, None
+            if ring_on:
+                # this data worker's ring of in-flight global tallies:
+                # slot (count mod depth) was written at step count − depth
+                # — read it now (the d-step-stale balance the aux
+                # consumes), overwrite it with this step's fresh tally
+                # after the backward. All-zero slots (cold start) make
+                # moe_ffn fall back to the fresh local aux.
+                ring = state.moe_ring[0]  # [depth, n_moe, E+1]
+                ring_slot = lax.rem(_count_of(state),
+                                    jnp.int32(ring.shape[0]))
+                stale_balance = lax.dynamic_index_in_dim(
+                    ring, ring_slot, 0, keepdims=False)
             # each batch leaf: [accum * local_bs, ...] → [accum, local_bs, ...]
             local = jax.tree.map(
                 lambda b: b.reshape((accum, -1) + b.shape[1:]), batch
@@ -1300,15 +1369,29 @@ class Trainer:
 
             def micro(gsum, inp):
                 microbatch, i = inp
+                extra = (stale_balance,) if ring_on else ()
                 (loss, metrics), g = jax.value_and_grad(
                     call_loss, has_aux=True
-                )(params, microbatch, jax.random.fold_in(key, i))
+                )(params, microbatch, jax.random.fold_in(key, i), *extra)
                 gsum = jax.tree.map(jnp.add, gsum, g)
                 return gsum, metrics
 
             zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
             gsum, metrics = lax.scan(micro, zeros, (local, jnp.arange(accum)))
             grads = jax.tree.map(lambda g: g / accum, gsum)
+
+            new_moe_ring = None
+            if ring_on:
+                # pop the reserved tally key BEFORE the scalarizing pmean
+                # below; counts ADD across microbatches, then the expert
+                # axis psum makes them global. No DATA-axis collective —
+                # each data worker launches its own batch's tally into its
+                # own ring row (async_grad's contract: the vote stays the
+                # only optimizer collective).
+                fresh = metrics.pop("moe_tallies").sum(axis=0)
+                if ep > 1:
+                    fresh = lax.psum(fresh, EXPERT_AXIS)
+                new_moe_ring = ring.at[ring_slot].set(fresh)
 
             if sp > 1:
                 # sequence parallelism: each seq shard computed the grad of
@@ -1421,6 +1504,11 @@ class Trainer:
                 new_state = expand_zero_state(new_st)
             else:
                 new_state = new_st
+            if new_moe_ring is not None:
+                # the optimizer's step passes the balance ring through
+                # untouched (it constructs its result state without it) —
+                # re-attach this step's launch here, re-stacked [1, ...]
+                new_state = new_state._replace(moe_ring=new_moe_ring[None])
 
             mean_metrics = {k: lax.pmean(v.mean(), DATA_AXIS) for k, v in metrics.items()}
             if gnorm is not None:
@@ -1868,6 +1956,7 @@ class Trainer:
                 "has_guard": self._guard is not None,
                 "wire": self.cfg.wire, "vote_every": self.cfg.vote_every,
                 "dcn_pipeline_depth": self.cfg.dcn_pipeline_depth,
+                "ep_dcn_pipeline": int(self.cfg.ep_dcn_pipeline or 0),
                 "control_plane": self._cplane is not None,
                 **self.data_meta}
         if self._cplane is not None:
@@ -2077,7 +2166,8 @@ class Trainer:
             if self.cfg.lion:
                 legacy_state = legacy_state._replace(health=None,
                                                      prev_ballot=None,
-                                                     dcn_ring=None)
+                                                     dcn_ring=None,
+                                                     moe_ring=None)
             tries.append({"params": self.params,
                           "opt_state": legacy_state,
                           "step": np.asarray(self.step_count, np.int64)})
@@ -2195,6 +2285,18 @@ class Trainer:
                         "Resume with the matching depth (then change it at "
                         "the NEXT fresh start), or point --output_dir "
                         "elsewhere")
+                # the MoE balance ring has the same no-remap property: its
+                # slot count IS the staleness (None and 0 both mean no
+                # ring, so toggling between those is fine)
+                ckpt_ep = int(meta.get("ep_dcn_pipeline", 0) or 0)
+                run_ep = int(self.cfg.ep_dcn_pipeline or 0)
+                if ckpt_ep != run_ep:
+                    raise ValueError(
+                        f"checkpoint step {step} was written at "
+                        f"--ep_dcn_pipeline {ckpt_ep} but this run uses "
+                        f"{run_ep}: the in-flight MoE balance ring does "
+                        "not survive a depth change. Resume with the "
+                        "matching depth, or point --output_dir elsewhere")
             if ckpt_world != self.world:
                 # a mismatched world is an operator decision, not a bad
                 # checkpoint — never silently fall back past it
@@ -2217,6 +2319,13 @@ class Trainer:
                         "functions of the world size. Resume at the "
                         "original world (drain the pipeline), or restart "
                         "with --dcn_pipeline_depth 0")
+                if (self.cfg.ep_dcn_pipeline or 0) > 0:
+                    raise NotImplementedError(
+                        "--elastic_resume cannot remap the MoE balance "
+                        "ring: its rows are per-data-worker stale tallies "
+                        "of batches the new world never routed. Resume at "
+                        "the original world, or restart with "
+                        "--ep_dcn_pipeline 0")
             try:
                 self._restore_step(step, meta, ckpt_world)
             except Exception as e:
@@ -2380,6 +2489,11 @@ class Trainer:
                 "(--moe_experts); a dense model would silently duplicate all "
                 "compute across the axis"
             )
+        if cfg.ep_dcn_pipeline is not None and model_cfg.moe_experts == 0:
+            raise ValueError(
+                "--ep_dcn_pipeline schedules the MoE balance feedback; a "
+                "dense model (--moe_experts 0) has no routing to balance. "
+                "Drop the flag or add --moe_experts")
         if model_cfg.moe_experts > 0:
             from distributed_lion_tpu.models.gpt2 import gpt2_moe_param_specs
             from distributed_lion_tpu.models.loss import (
@@ -2409,27 +2523,65 @@ class Trainer:
             moe_specs = (gpt2_moe_param_specs(model_cfg, tensor=tp > 1)
                          if (ep > 1 or tp > 1) else None)
 
-            def moe_apply(params, tokens, dropout_key):
+            ep_depth = cfg.ep_dcn_pipeline
+            # depth 0 = synchronous fed balance: psum the routing tallies
+            # over the expert axis INSIDE the forward (at ep=1 the axis
+            # psum is the identity, so the aux stays bit-identical to the
+            # unflagged local path — the depth-0 pin). depth > 0 feeds the
+            # stale ring tally instead (4th loss arg, below).
+            balance_axis = (EXPERT_AXIS
+                            if (ep_depth == 0 and ep > 1) else None)
+
+            def moe_apply(params, tokens, dropout_key, moe_balance=None,
+                          return_tallies=False):
                 return gpt2_apply(params, tokens, model_cfg,
                                   dropout_key=dropout_key,
                                   expert_axis=expert_axis,
-                                  tp_axis=moe_tp_axis, return_aux=True)
+                                  tp_axis=moe_tp_axis, return_aux=True,
+                                  moe_balance=moe_balance,
+                                  moe_balance_axis=balance_axis,
+                                  return_moe_tallies=return_tallies)
 
             if ep > 1:
-                def moe_loss(params, batch, dropout_key):
-                    logits, aux = moe_apply(params, batch, dropout_key)
-                    return clm_loss_sharded_rows(logits, batch, EXPERT_AXIS,
-                                                 aux=aux)
+                def moe_loss(params, batch, dropout_key, moe_balance=None):
+                    if moe_balance is None:
+                        logits, aux = moe_apply(params, batch, dropout_key)
+                        tallies = None
+                    else:
+                        logits, aux, tallies = moe_apply(
+                            params, batch, dropout_key, moe_balance, True)
+                    loss, metrics = clm_loss_sharded_rows(
+                        logits, batch, EXPERT_AXIS, aux=aux)
+                    if tallies is not None:
+                        metrics["moe_tallies"] = tallies
+                    return loss, metrics
 
                 moe_batch_spec = P((DATA_AXIS, EXPERT_AXIS))
             else:
-                def moe_loss(params, batch, dropout_key):
-                    logits, aux = moe_apply(params, batch, dropout_key)
+                def moe_loss(params, batch, dropout_key, moe_balance=None):
+                    if moe_balance is None:
+                        logits, aux = moe_apply(params, batch, dropout_key)
+                        tallies = None
+                    else:
+                        logits, aux, tallies = moe_apply(
+                            params, batch, dropout_key, moe_balance, True)
                     loss, metrics = clm_loss_and_metrics(logits, batch)
                     metrics["aux_loss"] = aux
+                    if tallies is not None:
+                        metrics["moe_tallies"] = tallies
                     return loss + 0.01 * aux, metrics
 
                 moe_batch_spec = None
+            if (ep_depth or 0) > 0:
+                from distributed_lion_tpu.models.gpt2 import is_moe_block
+                n_moe = sum(1 for i in range(model_cfg.n_layer)
+                            if is_moe_block(model_cfg, i))
+                # consumed by Trainer.__init__ (ring sizing) and the step
+                # core (ring read/feed/write); the tally row is per-expert
+                # token counts + the lane count in the last entry
+                moe_loss._wants_moe_balance = True
+                moe_loss._moe_tally_shape = (n_moe,
+                                             model_cfg.moe_experts + 1)
             n_active = count_params(params) - sum(
                 p.size for b in params["blocks"] if "moe" in b
                 for p in jax.tree.leaves(b["moe"])

@@ -125,6 +125,7 @@ def _build(model_name: str, family: str, quant: str, max_seqs: int,
            block_size: int, max_blocks_per_seq: int,
            prefill_cap: int = 1 << 30, temperature: float = 0.0,
            top_k=None, speculate: str = "", tp: int = 0, ep: int = 0,
+           ep_batch: bool = False, ep_overlap: bool = False,
            prefix_cache: bool = False, num_blocks: int = 0,
            moe_stats: bool = False):
     from distributed_lion_tpu.serve.engine import ServeConfig, ServingEngine
@@ -135,7 +136,8 @@ def _build(model_name: str, family: str, quant: str, max_seqs: int,
                        num_blocks=num_blocks,
                        prefill_cap_tokens=prefill_cap,
                        temperature=temperature, top_k=top_k, quant=quant,
-                       tp=tp, ep=ep, prefix_cache=prefix_cache,
+                       tp=tp, ep=ep, ep_batch=ep_batch,
+                       ep_overlap=ep_overlap, prefix_cache=prefix_cache,
                        speculate=speculate, moe_stats=moe_stats)
     draft = model if speculate.startswith("draft") else None
     return ServingEngine(model, scfg, draft_model=draft), params, cfg
@@ -636,15 +638,17 @@ def bench_moe_serving(model_name: str, quant: str, block_size: int,
             "dropped_rate": round(max(vv - kk, 0.0) / vv, 4) if vv else 0.0,
         }
 
+    dense_pc = {}  # batch -> dense tokens/s/chip (the per-chip yardstick)
+
     def timed(config: str, m_name: str, batch: int, ep: int,
-              cols: dict) -> None:
+              cols: dict, ep_batch: bool = False) -> None:
         need = PROMPT_LEN + warmup + ticks + 2
         nblocks = -(-need // block_size)
         is_moe = m_name == moe_name
         # moe_stats stays OFF here: every row (dense and MoE) times the
         # identical un-instrumented engine — apples to apples
         eng, _, cfg = _build(m_name, family, quant, batch, block_size,
-                             nblocks, ep=ep)
+                             nblocks, ep=ep, ep_batch=ep_batch)
         for i, toks in enumerate(_prompts(batch, cfg.vocab_size)):
             eng.submit(Request(req_id=i, tokens=toks, max_new_tokens=need,
                                seed=i))
@@ -657,12 +661,22 @@ def bench_moe_serving(model_name: str, quant: str, block_size: int,
         for _ in range(ticks):
             eng.step()  # host-syncs its token batch: fully retired
         dt = time.perf_counter() - t0
+        pc = round(batch * ticks / dt / max(ep, 1), 2)
+        if not is_moe:
+            dense_pc[batch] = pc
         row = {
             "config": config, "experts": E if is_moe else 0, "ep": ep,
+            # how the batch meets the expert axis: 'none' (no axis),
+            # 'replicated' (every shard decodes the whole batch — ep is an
+            # HBM lever only), 'batch' (rows sharded over the axis — each
+            # shard decodes batch/ep rows, ISSUE 16's throughput lever)
+            "sharding": ("batch" if ep_batch
+                         else ("replicated" if ep else "none")),
             "batch": batch, "decode_ticks": ticks,
             "ms_per_tick": round(dt / ticks * 1e3, 4),
-            "tokens_per_sec_per_chip": round(
-                batch * ticks / dt / max(ep, 1), 2),
+            "tokens_per_sec_per_chip": pc,
+            "beats_dense_per_chip": bool(is_moe and batch in dense_pc
+                                         and pc > dense_pc[batch]),
             "capacity_utilization": cols["capacity_utilization"] if is_moe
             else 0.0,
             "dropped_rate": cols["dropped_rate"] if is_moe else 0.0,
@@ -676,6 +690,14 @@ def bench_moe_serving(model_name: str, quant: str, block_size: int,
         timed("moe", moe_name, batch, 0, cols)
         for e in feasible:
             timed(f"moe_ep{e}", moe_name, batch, e, cols)
+            if batch % e == 0:
+                timed(f"moe_ep{e}_batch", moe_name, batch, e, cols,
+                      ep_batch=True)
+            else:
+                print(json.dumps(
+                    {"dropped_row": f"moe_ep{e}_batch",
+                     "why": f"batch {batch} % ep {e}"},
+                    allow_nan=False), flush=True)
 
     # ---- identity markers, recomputed live on the tiny MoE config
     # (identity is backend/scale-independent; capture stays cheap)
@@ -737,6 +759,21 @@ def bench_moe_serving(model_name: str, quant: str, block_size: int,
         "epN_vs_unsharded": epn >= 2 and outputs({"ep": epn}) == plain,
         "ep_tp_vs_unsharded": can_ep_tp
         and outputs({"ep": 2, "tp": 2}) == plain,
+        # ISSUE 16: the batch-sharded rows are only admissible if the
+        # sharding is a pure re-schedule — token-identical to the
+        # unsharded engine, alone, with tp, and with the microbatch
+        # overlap split
+        "ep_batch1_vs_unsharded":
+        outputs({"ep": 1, "ep_batch": True}) == plain,
+        "ep_batchN_vs_unsharded": epn >= 2
+        and outputs({"ep": epn, "ep_batch": True}) == plain,
+        "ep_batch_tp_vs_unsharded": can_ep_tp
+        and outputs({"ep": 2, "tp": 2, "ep_batch": True}) == plain,
+        # overlap needs an even per-shard slot count: ep=2 on the 4-slot
+        # identity engine (2 slots/shard, one per microbatch half)
+        "ep_batch_overlap_vs_unsharded": epn >= 2 and e_tiny % 2 == 0
+        and outputs({"ep": 2, "ep_batch": True,
+                     "ep_overlap": True}) == plain,
     }
     markers = {k: bool(v) for k, v in markers.items()}
     return {"markers": markers, "ep_degree_max_measured": int(epn),

@@ -729,18 +729,26 @@ def tp_serving_ok(path: str = SERVE_ARTIFACT) -> bool:
 # serving.json artifact (bench_serve writes it; runbook stage 5m
 # re-captures on chip) — (a) the whole artifact passes the strict schema
 # (validate_metrics: matrix rows per-row validated incl.
-# capacity_utilization/dropped_rate ∈ [0,1]), (b) ALL SIX live-recomputed
+# capacity_utilization/dropped_rate ∈ [0,1] and the ISSUE 16
+# sharding/beats_dense_per_chip columns), (b) ALL TEN live-recomputed
 # identity markers hold (paged MoE decode == dense-KV MoE generate,
 # engine batched == solo, left-padded batched generate == solo — the
-# lifted PR 9 refusals — plus ep=1 bit-identical to the unsharded engine
-# and ep>=2 / ep×tp token-identical on the measuring mesh), and (c) the
-# matrix actually covers the claim: a dense baseline row, a MoE row, and
-# a MoE+ep row at ep >= 2, every MoE row carrying a measured
-# tokens/s/chip above the serving floor with its capacity-utilization
-# and dropped-rate columns.
+# lifted PR 9 refusals — plus ep=1 bit-identical to the unsharded engine,
+# ep>=2 / ep×tp token-identical on the measuring mesh, and the four
+# batch-sharded markers: ep_batch at ep=1 bit-identical, ep>=2 / ep×tp /
+# microbatch-overlap token-identical), and (c) the matrix actually
+# covers the claim: a dense baseline row, a MoE row, a replicated MoE+ep
+# row at ep >= 2, AND a batch-sharded row at the same (batch, ep) whose
+# per-chip tokens/s is STRICTLY above the replicated row's — ep as a
+# throughput lever, not just an HBM lever — with every MoE row carrying
+# a measured tokens/s/chip above the serving floor and its
+# capacity-utilization and dropped-rate columns.
 MOE_SERVE_MARKERS = ("paged_vs_dense", "batched_vs_solo",
                      "batched_generate_vs_solo", "ep1_vs_unsharded",
-                     "epN_vs_unsharded", "ep_tp_vs_unsharded")
+                     "epN_vs_unsharded", "ep_tp_vs_unsharded",
+                     "ep_batch1_vs_unsharded", "ep_batchN_vs_unsharded",
+                     "ep_batch_tp_vs_unsharded",
+                     "ep_batch_overlap_vs_unsharded")
 
 
 def moe_serving_ok(path: str = SERVE_ARTIFACT) -> bool:
@@ -769,6 +777,23 @@ def moe_serving_ok(path: str = SERVE_ARTIFACT) -> bool:
     if not any(r.get("ep", 0) >= 2 and r.get("experts", 0) > 0
                for r in rows):
         return False  # no expert-parallel measurement: the section's point
+    # ISSUE 16: at least one (batch, ep>=2) pair must carry BOTH a
+    # replicated and a batch-sharded row, and the batch-sharded row's
+    # per-chip throughput must be STRICTLY above the replicated one —
+    # otherwise 'ep is a throughput lever' is an unmeasured claim
+    lever = False
+    for r in rows:
+        if r.get("sharding") != "batch" or r.get("ep", 0) < 2:
+            continue
+        rep = [x for x in rows
+               if x.get("sharding") == "replicated"
+               and x.get("ep") == r.get("ep")
+               and x.get("batch") == r.get("batch")]
+        if rep and all(r.get("tokens_per_sec_per_chip", 0)
+                       > x.get("tokens_per_sec_per_chip", 0) for x in rep):
+            lever = True
+    if not lever:
+        return False
     for r in rows:
         if r.get("experts", 0) <= 0:
             continue  # dense baseline rows judge only by presence

@@ -586,19 +586,24 @@ else
     || echo "$(stamp) serve_resilience section FAILED validation" | tee -a "$OUT/log.txt"
 fi
 
-# ---- 5m. MoE serving (ISSUE 15, ~4 min): the moe_serving section of
-# the SAME runs/serving/serving.json — the dense-vs-MoE-vs-MoE+ep decode
-# matrix (tokens/s/CHIP at the standard batches with expert-capacity
-# utilization + dropped-rate columns from the engine's on-device routing
-# stats) and the six live-recomputed identity markers (paged MoE ==
-# dense-KV MoE generate, engine batched == solo, left-padded batched
-# generate == solo, ep=1 bit-identical, ep>=2 and ep×tp
-# token-identical). bench_serve writes it alongside stages 5h/5j/5k/5l's
-# sections, so a fresh 5h capture already carries it — this stage only
-# re-runs the bench when the banked artifact predates ISSUE 15 (or a
-# marker/row failed). check_evidence's 'moe_serving' stage judges it
-# (strict schema, all six markers, dense + moe + moe_ep>=2 rows with the
-# MoE rows above the tokens/s floor and [0,1] capacity columns).
+# ---- 5m. MoE serving (ISSUE 15 + 16, ~5 min): the moe_serving section
+# of the SAME runs/serving/serving.json — the dense-vs-MoE-vs-MoE+ep
+# decode matrix (tokens/s/CHIP at the standard batches with
+# expert-capacity utilization + dropped-rate columns from the engine's
+# on-device routing stats), with each ep degree measured BOTH replicated
+# and batch-sharded (ISSUE 16's throughput-lever rows, sharding =
+# 'replicated' | 'batch' + the beats_dense_per_chip column), and the TEN
+# live-recomputed identity markers (paged MoE == dense-KV MoE generate,
+# engine batched == solo, left-padded batched generate == solo, ep=1
+# bit-identical, ep>=2 and ep×tp token-identical, and the four ep_batch
+# markers incl. the microbatch-overlap split). bench_serve writes it
+# alongside stages 5h/5j/5k/5l's sections, so a fresh 5h capture already
+# carries it — this stage only re-runs the bench when the banked
+# artifact predates ISSUE 16 (or a marker/row failed). check_evidence's
+# 'moe_serving' stage judges it (strict schema, all ten markers, dense +
+# moe + moe_ep>=2 rows, a batch-sharded row STRICTLY above the
+# replicated row at a matched (batch, ep), MoE rows above the tokens/s
+# floor, [0,1] capacity columns).
 if python scripts/check_evidence.py moe_serving \
     && [ "$(python -c 'import json;print(json.load(open("runs/serving/serving.json"))["meta"]["backend"])' 2>/dev/null)" = "tpu" ]; then
   echo "$(stamp) moe_serving section already captured on chip — skip" | tee -a "$OUT/log.txt"

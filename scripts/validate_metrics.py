@@ -408,7 +408,10 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
         else:
             for k in ("paged_vs_dense", "batched_vs_solo",
                       "batched_generate_vs_solo", "ep1_vs_unsharded",
-                      "epN_vs_unsharded", "ep_tp_vs_unsharded"):
+                      "epN_vs_unsharded", "ep_tp_vs_unsharded",
+                      "ep_batch1_vs_unsharded", "ep_batchN_vs_unsharded",
+                      "ep_batch_tp_vs_unsharded",
+                      "ep_batch_overlap_vs_unsharded"):
                 if not isinstance(marks.get(k), bool):
                     errors.append(
                         f"{path}: moe_serving.markers.{k} must be a bool")
@@ -432,6 +435,12 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
             for k in ("ms_per_tick", "tokens_per_sec_per_chip"):
                 if not _finite_number(row.get(k)):
                     errors.append(f"{where}.{k} is not finite")
+            if row.get("sharding") not in ("none", "replicated", "batch"):
+                errors.append(f"{where}.sharding must be one of "
+                              "'none' | 'replicated' | 'batch'")
+            if not isinstance(row.get("beats_dense_per_chip"), bool):
+                errors.append(f"{where}.beats_dense_per_chip must be a "
+                              "bool")
             for k in ("capacity_utilization", "dropped_rate"):
                 v = row.get(k)
                 if not (_finite_number(v) and 0.0 <= v <= 1.0):

@@ -1,0 +1,29 @@
+"""Fixture: DLT005 in batch-sharded expert-parallel decode code —
+hardcoded mesh-axis string literals where the parallel.mesh constants
+belong. ISSUE 16 shards the engine's decode/prefill/verify BATCH over
+the expert axis (slots ``P(EXPERT_AXIS)``, page pools
+``P(EXPERT_AXIS, None, TENSOR_AXIS, None)``) and threads the same
+constant into the training wire's balance-ring psum; a literal "expert"
+in any of these specs silently decouples from the mesh axis-naming
+convention — rename the axis once and the batch sharding keeps compiling
+against a ghost name while every shard quietly decodes the full batch
+again. Never imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py)."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def batch_sharded_specs(n_rest):
+    # DLT005: the slot/batch dim of the decode operands named by a raw
+    # string literal instead of parallel.mesh.EXPERT_AXIS
+    return [P("expert")] * n_rest
+
+
+def pool_spec():
+    # DLT005: the page-pool block dim literal-named
+    return P("expert", None, "tensor", None)
+
+
+def balance_psum(tallies, axis="expert"):             # DLT005: literal default
+    return jax.lax.psum(tallies, axis)

@@ -293,6 +293,25 @@ def test_expert_axis_fixture_and_moe_serve_modules_clean():
         assert lint.lint_file(path) == [], rel
 
 
+def test_ep_batch_axis_fixture_and_touched_modules_clean():
+    """ISSUE 16 satellite: the batch-sharded decode path (slots
+    ``P(EXPERT_AXIS)``, page pools sharded on their block dim) and the
+    training balance-ring psum must never hardcode the mesh-axis string —
+    the fixture shows the forbidden shapes (DLT005 fires 4×: slot spec,
+    pool spec with both axes literal-named, psum default). Every module
+    ISSUE 16 touched lints zero-finding by file path."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt005_ep_batch_axis_literal.py"))
+    assert [f.rule for f in findings] == ["DLT005"] * 4, (
+        [str(f) for f in findings])
+    for rel in ("parallel/expert.py", "parallel/mesh.py",
+                "models/gpt2.py", "serve/engine.py", "serve/speculate.py",
+                "train/loop.py", "cli/run_serve.py", "optim/lion.py",
+                "optim/distributed_lion.py"):
+        path = os.path.join(PKG, rel)
+        assert lint.lint_file(path) == [], rel
+
+
 def test_migration_fixture_and_replica_plane_clean():
     """ISSUE 14 satellite: a migration re-prefill must never host-read
     per committed token — replaying a migrated request's history with an

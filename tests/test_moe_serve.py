@@ -476,10 +476,12 @@ def _load_ce():
 
 def test_banked_artifact_passes_moe_serving_stage():
     """The committed CPU artifact (captured under DLION_PLATFORM=cpu8 so
-    the ep>=2 legs exist) satisfies the ISSUE 15 moe_serving stage:
-    strict schema, all six identity markers, dense + moe + moe_ep>=2
-    matrix rows with measured tokens/s/chip and [0,1] capacity columns —
-    the gate runbook stage 5m re-judges after the on-chip recapture."""
+    the ep>=2 legs exist) satisfies the ISSUE 15+16 moe_serving stage:
+    strict schema, all ten identity markers, dense + moe + moe_ep>=2
+    matrix rows with measured tokens/s/chip and [0,1] capacity columns,
+    and at least one batch-sharded row strictly above the replicated row
+    at a matched (batch, ep) — the gate runbook stage 5m re-judges after
+    the on-chip recapture."""
     ce = _load_ce()
     assert ce.moe_serving_ok()
     with open(ce.SERVE_ARTIFACT) as f:
@@ -492,6 +494,20 @@ def test_banked_artifact_passes_moe_serving_stage():
         if r["experts"]:
             assert 0.0 <= r["capacity_utilization"] <= 1.0
             assert 0.0 <= r["dropped_rate"] <= 1.0
+    # ISSUE 16: the banked matrix carries the throughput-lever evidence —
+    # EVERY batch-sharded row beats its replicated twin per chip
+    pairs = 0
+    for r in sec["rows"]:
+        if r["sharding"] != "batch":
+            continue
+        twins = [x for x in sec["rows"] if x["sharding"] == "replicated"
+                 and x["ep"] == r["ep"] and x["batch"] == r["batch"]]
+        assert twins, r
+        for x in twins:
+            assert r["tokens_per_sec_per_chip"] \
+                > x["tokens_per_sec_per_chip"], (r, x)
+        pairs += 1
+    assert pairs >= 1
 
 
 def test_moe_serving_stage_rejects_bad_artifacts(tmp_path):
@@ -531,6 +547,29 @@ def test_moe_serving_stage_rejects_bad_artifacts(tmp_path):
                 r["capacity_utilization"] = 1.5
                 break
     reject(bad_util)
+    # ISSUE 16: no batch-sharded row at all — 'throughput lever' unmeasured
+    reject(lambda d: d["moe_serving"].update(
+        rows=[r for r in d["moe_serving"]["rows"]
+              if r["sharding"] != "batch"]))
+    # batch-sharded rows that tie (not STRICTLY beat) the replicated twin
+    def lever_lost(d):
+        rows = d["moe_serving"]["rows"]
+        for r in rows:
+            if r["sharding"] != "batch":
+                continue
+            for x in rows:
+                if (x["sharding"] == "replicated" and x["ep"] == r["ep"]
+                        and x["batch"] == r["batch"]):
+                    r["tokens_per_sec_per_chip"] = \
+                        x["tokens_per_sec_per_chip"]
+    reject(lever_lost)
+    # schema: the sharding / beats_dense_per_chip columns are mandatory
+    def bad_sharding(d):
+        d["moe_serving"]["rows"][0]["sharding"] = "sideways"
+    reject(bad_sharding)
+    def no_beats_col(d):
+        d["moe_serving"]["rows"][0].pop("beats_dense_per_chip")
+    reject(no_beats_col)
     # the untouched artifact still passes from the tmp copy
     p.write_text(json.dumps(good))
     assert ce.moe_serving_ok(str(p))
