@@ -30,6 +30,14 @@ and — given ``--baseline`` — diffs the bucket fractions against a
 ``BENCH_*.json`` / ``last_tpu_measurement.json`` row's
 ``journal_attribution`` summary to NAME the regressing bucket.
 
+``--serve`` switches to the serve-side view (ISSUE 17): per-request
+lifecycle waterfalls (queue → prefill → decode, from the engine's
+``serve_finish`` events joined with ``serve/prefill`` spans — every
+terminal status, timeouts and failures included) and the drain-cadence
+metrics timeline (``serve_metrics``/``serve_stats``/``fleet_stats``/
+``slo_breach`` events, serve/metrics.py) — the same numbers the serving
+bench banks into serving.json.
+
 Stdlib-only at import (no jax, no package imports), loadable by file path
 — the same dependency-light contract as ``train/resilience``'s manifest
 verifier, so ``scripts/check_evidence.py`` validates journal artifacts on
@@ -445,6 +453,162 @@ def analyze_dir(directory: str, rank: Optional[int] = None,
     return report
 
 
+# ------------------------------------------------------------- serve mode
+def serve_waterfalls(events: list, rank: Optional[int] = None) -> list:
+    """Per-request lifecycle rows from the serve journal: one row per
+    terminal ``serve_finish`` event (every status — timeout/failed rows
+    are exactly the ones an incident report needs), joined with the
+    request's ``serve/prefill`` span when it reached one. Tick-domain
+    columns come from the engine's request clocks (serve/metrics.
+    RequestTimes); wall columns appear when the metrics plane was on."""
+    if rank is None:
+        ranks = {int(r.get("rank", 0)) for r in events}
+        rank = min(ranks) if ranks else 0
+    mine = [r for r in events if int(r.get("rank", 0)) == rank]
+    prefills: dict = {}
+    for r in mine:
+        if (r.get("kind") == "span" and r.get("name") == "serve/prefill"
+                and "req_id" in r and isinstance(r.get("dur"),
+                                                 (int, float))):
+            prefills.setdefault(str(r["req_id"]), r)
+    rows = []
+    for r in mine:
+        if r.get("kind") != "event" or r.get("name") != "serve_finish":
+            continue
+        rid = str(r.get("req_id"))
+        row = {"req_id": rid, "reason": r.get("reason", "?")}
+        for k in ("queue_ticks", "ttft_ticks", "decode_ticks", "ttft_ms"):
+            if isinstance(r.get(k), (int, float)):
+                row[k] = r[k]
+        p = prefills.get(rid)
+        if p is not None:
+            row["prefill_ms"] = float(p["dur"]) * 1e3
+            row["prompt_len"] = p.get("prompt_len")
+            row["shared"] = p.get("shared")
+        row["finish_tw"] = r.get("tw")
+        rows.append(row)
+    rows.sort(key=lambda x: (x.get("finish_tw") or 0.0, x["req_id"]))
+    return rows
+
+
+def serve_metrics_timeline(events: list,
+                           rank: Optional[int] = None) -> list:
+    """The drain-cadence fleet/engine metrics timeline: one row per
+    ``serve_metrics`` journal event (sketch summaries + gauges + SLO
+    counters, already flat strict-JSON fields) plus the matching
+    ``serve_stats``/``fleet_stats`` counter snapshots."""
+    if rank is None:
+        ranks = {int(r.get("rank", 0)) for r in events}
+        rank = min(ranks) if ranks else 0
+    out = []
+    for r in events:
+        if int(r.get("rank", 0)) != rank or r.get("kind") != "event":
+            continue
+        if r.get("name") in ("serve_metrics", "serve_stats",
+                             "fleet_stats", "serve_fleet_metrics",
+                             "serve_done", "slo_breach"):
+            row = {k: v for k, v in r.items()
+                   if k not in ("kind", "t", "rank")}
+            row["event"] = row.pop("name")
+            out.append(row)
+    return out
+
+
+def serve_report(directory: str, rank: Optional[int] = None
+                 ) -> Optional[dict]:
+    """The --serve report: waterfalls + metrics timeline, or None when
+    the directory holds no journal."""
+    loaded = load_journals(directory)
+    if loaded is None:
+        return None
+    return {
+        "directory": directory,
+        "ranks": loaded["ranks"],
+        "schema_errors": loaded["schema_errors"],
+        "requests": serve_waterfalls(loaded["events"], rank),
+        "timeline": serve_metrics_timeline(loaded["events"], rank),
+        "replicas": replica_timeline(loaded["events"], rank),
+    }
+
+
+_WATERFALL_MAX_ROWS = 40
+_WATERFALL_MAX_BAR = 48
+
+
+def _waterfall_bar(row: dict) -> str:
+    """Tick-domain lifecycle bar: '.' per queued tick, 'P' for the
+    prefill/first-token tick, '#' per decode tick — truncated with '~'
+    past the display budget (long decodes must not wrap the report)."""
+    q = int(row.get("queue_ticks", 0) or 0)
+    d = int(row.get("decode_ticks", 0) or 0)
+    bar = "." * q + ("P" if "ttft_ticks" in row else "") + "#" * d
+    if len(bar) > _WATERFALL_MAX_BAR:
+        bar = bar[:_WATERFALL_MAX_BAR - 1] + "~"
+    return bar
+
+
+def render_serve(report: dict) -> str:
+    lines = [f"serve journal: {report['directory']} "
+             f"(ranks {report['ranks']}, "
+             f"{report['schema_errors']} schema error(s))"]
+    rows = report.get("requests") or []
+    by_reason: dict = {}
+    for r in rows:
+        by_reason[r["reason"]] = by_reason.get(r["reason"], 0) + 1
+    lines.append(f"{len(rows)} request(s): " + ", ".join(
+        f"{k}={v}" for k, v in sorted(by_reason.items())) if rows
+        else "no serve_finish events (was the run journaled with "
+             "--journal_dir?)")
+    if rows:
+        lines.append("request waterfalls (queue '.' -> prefill 'P' -> "
+                     "decode '#'; ticks):")
+        for r in rows[:_WATERFALL_MAX_ROWS]:
+            cols = [f"  {r['req_id']:<8}"]
+            cols.append(f"q{r.get('queue_ticks', '?'):>4}")
+            cols.append(f"d{r.get('decode_ticks', '?'):>4}")
+            cols.append(f"ttft {r['ttft_ms']:7.1f} ms"
+                        if isinstance(r.get("ttft_ms"), (int, float))
+                        else "ttft       -")
+            cols.append(f"{r['reason']:<8}")
+            cols.append(_waterfall_bar(r))
+            lines.append(" ".join(cols))
+        if len(rows) > _WATERFALL_MAX_ROWS:
+            lines.append(f"  ... {len(rows) - _WATERFALL_MAX_ROWS} more "
+                         "(full set in --json-out)")
+    tl = report.get("timeline") or []
+    if tl:
+        lines.append("metrics timeline (drain cadence):")
+        for row in tl:
+            ev = row["event"]
+            if ev == "serve_metrics":
+                lines.append(
+                    f"  tick {row.get('tick', '?'):>6}  "
+                    f"ttft p50/p99 {row.get('ttft_ms_p50', 0):.1f}/"
+                    f"{row.get('ttft_ms_p99', 0):.1f} ms  "
+                    f"tok p99 {row.get('tok_ms_p99', 0):.1f} ms  "
+                    f"queue {row.get('gauge_queue_depth', 0):.0f}  "
+                    f"slots {row.get('gauge_active_slots', 0):.0f}  "
+                    f"pages {row.get('gauge_pages_allocated', 0):.0f}")
+            elif ev == "slo_breach":
+                lines.append(
+                    f"  tick {row.get('tick', '?'):>6}  SLO BREACH: "
+                    f"burn rate {row.get('burn_rate', 0):.2f} "
+                    f"({row.get('window_violations', '?')}/"
+                    f"{row.get('window', '?')} in window)")
+            else:
+                keep = {k: v for k, v in row.items()
+                        if k not in ("event", "tw") and
+                        isinstance(v, (int, float))}
+                short = ", ".join(f"{k}={v}" for k, v in
+                                  sorted(keep.items())[:8])
+                lines.append(f"  {ev}: {short}")
+    if report.get("replicas"):
+        lines.append("replica timeline: "
+                     f"{len(report['replicas'])} event(s) "
+                     "(full view without --serve)")
+    return "\n".join(lines)
+
+
 def _fmt_s(v: float) -> str:
     return f"{v * 1e3:8.1f} ms" if v < 10 else f"{v:8.2f} s "
 
@@ -550,7 +714,27 @@ def main(argv: Optional[list] = None) -> int:
                          "bucket fractions against")
     ap.add_argument("--json-out", default=None,
                     help="also write the full report as strict JSON")
+    ap.add_argument("--serve", action="store_true",
+                    help="serve-side view: per-request waterfalls "
+                         "(queue->prefill->decode from serve_finish + "
+                         "serve/prefill records) and the drain-cadence "
+                         "metrics timeline, instead of step attribution")
     args = ap.parse_args(argv)
+    if args.serve:
+        report = serve_report(args.directory, rank=args.rank)
+        if report is None:
+            print(f"no journal files under {args.directory}",
+                  file=sys.stderr)
+            return 1
+        print(render_serve(report))
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(report, f, indent=1, allow_nan=False)
+                f.write("\n")
+        # the leg closed iff at least one request reached a terminal
+        # record — a journaled serve run with zero serve_finish events
+        # means the workload silently never finished
+        return 0 if report["requests"] else 1
     report = analyze_dir(args.directory, rank=args.rank,
                          baseline=args.baseline)
     if report is None:

@@ -11,8 +11,14 @@ engine (serve/engine.py), and drains a request file:
         --quant nf4 --max_seqs 32 --block_size 16
 
 With no --requests, --prompt strings (repeatable) become the workload —
-a smoke mode mirroring run_generate. ``--journal_dir`` records
-``serve/*`` spans (train/journal) for ``cli/run_analyze``.
+a smoke mode mirroring run_generate (scripts/workload_gen.py emits
+seeded open-loop request files in the same schema). ``--journal_dir``
+records ``serve/*`` spans (train/journal) for ``cli/run_analyze
+--serve``. ``--serve_metrics`` arms the request-lifecycle metrics plane
+(serve/metrics.py: TTFT/per-token sketches, gauges, drain-cadence
+journal events); ``--slo_ttft_ms``/``--slo_tok_ms``/``--slo_p99`` add
+the SLO monitor with burn-rate ``slo_breach`` accounting. Both are
+pinned inert — token streams are bit-identical with or without them.
 
 ``--serve_tp N`` shards the decode path (weights per the Megatron specs,
 page pools over kv heads) across the first N local devices — how the
@@ -108,6 +114,20 @@ class ServeArguments:
     # slow_tick:<r>:<ms> | replica_rejoin:<r>:<tick> — consumed by the
     # fleet at tick boundaries. Needs --replicas >= 2 to mean anything
     # (a 1-replica fleet with a crash has nowhere to migrate).
+    serve_metrics: bool = False      # arm the request-lifecycle metrics
+    # plane (serve/metrics.ServeMetrics): TTFT/per-token latency
+    # sketches, live gauges, drain-cadence serve_metrics/serve_stats
+    # journal events. Pinned inert — token streams are bit-identical
+    # with the plane on or off. Implied by any --slo_* flag.
+    slo_ttft_ms: Optional[float] = None   # SLO: time-to-first-token
+    # bound (wall ms). Setting it arms the metrics plane + SLO monitor;
+    # violations count per request, rolling-window burn rate journals
+    # edge-triggered slo_breach events (serve/metrics.SLOMonitor).
+    slo_tok_ms: Optional[float] = None    # SLO: mean per-token decode
+    # latency bound (wall ms per generated token)
+    slo_p99: float = 0.99            # SLO quantile target: the error
+    # budget is 1 - slo_p99 (the violation fraction the SLO tolerates);
+    # burn rate = window violation fraction / budget
     journal_dir: Optional[str] = None
 
 
@@ -168,10 +188,28 @@ def build_engine_factory(gen_args, serve_args: "ServeArguments"):
         ep_overlap=serve_args.serve_ep_overlap,
         prefix_cache=serve_args.prefix_cache,
         speculate=serve_args.speculate,
+        metrics=(serve_args.serve_metrics
+                 or serve_args.slo_ttft_ms is not None
+                 or serve_args.slo_tok_ms is not None),
         eos_id=getattr(tok, "eos_id", None))
+    slo_armed = (serve_args.slo_ttft_ms is not None
+                 or serve_args.slo_tok_ms is not None)
 
     def factory() -> ServingEngine:
-        return ServingEngine(model, scfg, draft_model=draft_model)
+        engine = ServingEngine(model, scfg, draft_model=draft_model)
+        if slo_armed:
+            # each engine (each fleet replica) gets its own monitor —
+            # burn rate is a per-replica signal; the fleet aggregate
+            # rides metrics_snapshot()'s sketch merge
+            from distributed_lion_tpu.serve.metrics import (
+                ServeMetrics, SLOMonitor)
+
+            engine.metrics = ServeMetrics(
+                engine.times,
+                slo=SLOMonitor(ttft_ms=serve_args.slo_ttft_ms,
+                               tok_ms=serve_args.slo_tok_ms,
+                               p99=serve_args.slo_p99))
+        return engine
 
     return tok, factory
 
@@ -241,7 +279,18 @@ def main(argv=None):
                 print(json.dumps({"prompt": p, **rec}, allow_nan=False),
                       flush=True)
         journal_mod.active().event("serve_done", **{
-            k: int(v) for k, v in engine.stats.items()})
+            k: (float(v) if isinstance(v, float) else int(v))
+            for k, v in engine.stats.items()})
+        # final metrics drain: the end-of-run snapshot lands in the
+        # journal even when the run was shorter than one drain cadence
+        if args.replicas > 1:
+            snap = engine.metrics_snapshot()
+            if snap is not None:
+                journal_mod.active().event("serve_fleet_metrics", **{
+                    f"{sec}_{k}": v for sec, d in snap.items()
+                    if isinstance(d, dict) for k, v in d.items()})
+        elif engine.metrics is not None:
+            engine.metrics.drain(engine.stats["ticks"])
         return records
     finally:
         if args.inject_serve:

@@ -25,7 +25,16 @@ finish reason (``eos | length | overflow | rejected | timeout | failed``
 — the last two from deadlines and the fleet's retry budget)::
 
     {"id": "r1", "text": "...", "tokens": [...], "reason": "eos",
-     "prompt_len": 5, "n_generated": 12}
+     "prompt_len": 5, "n_generated": 12, "queue_ticks": 1,
+     "ttft_ticks": 1, "decode_ticks": 11}
+
+Timing columns come from the engine's always-on tick-domain request
+clocks (serve/metrics.RequestTimes): ``queue_ticks`` on every terminal
+status — including ``timeout``/``failed``/``overflow``, and including
+queue-side deaths stamped by the fleet that never reached an engine —
+``ttft_ticks``/``decode_ticks`` once a first token existed, and wall
+``ttft_ms`` when the request was served with the metrics plane armed
+(ServeConfig.metrics / --serve_metrics).
 
 A socket mode can ride the same :func:`handle_requests` core later; the
 offline mode is what CI and the decode bench gate on.
@@ -95,6 +104,18 @@ def load_request_file(path: str, tokenizer=None
 def completion_record(c: Completion, tokenizer=None) -> dict:
     rec = {"id": c.req_id, "tokens": list(c.tokens), "reason": c.reason,
            "prompt_len": c.prompt_len, "n_generated": len(c.tokens)}
+    if c.timing:
+        # request-lifecycle clocks (serve/metrics.RequestTimes — stamped
+        # by the engine, or by the fleet for queue-side deaths):
+        # queue_ticks on EVERY terminal status, ttft_ticks/decode_ticks
+        # once a first token existed, wall ttft_ms when the metrics
+        # plane was armed. Validated strictly by validate_metrics.py's
+        # responses schema, same discipline as prefix_group.
+        for k in ("ttft_ticks", "queue_ticks", "decode_ticks"):
+            if k in c.timing:
+                rec[k] = int(c.timing[k])
+        if "ttft_ms" in c.timing:
+            rec["ttft_ms"] = float(c.timing["ttft_ms"])
     if tokenizer is not None:
         rec["text"] = tokenizer.decode([int(t) for t in c.tokens])
     return rec

@@ -132,6 +132,7 @@ from distributed_lion_tpu.serve.kv_cache import (
     bucket_tokens,
     init_pages,
 )
+from distributed_lion_tpu.serve.metrics import RequestTimes, ServeMetrics
 from distributed_lion_tpu.train import journal
 
 
@@ -225,6 +226,14 @@ class ServeConfig:
     # sampled token-identical to the same per-request PRNG stream — the
     # knob only changes tokens per dispatch. 'draft:<k>' additionally
     # needs ServingEngine(draft_model=...).
+    metrics: bool = False        # arm the request-lifecycle metrics plane
+    # (serve/metrics.ServeMetrics): wall-clock TTFT / per-token sketches,
+    # live gauges, drain-cadence journal events. Pinned INERT — token
+    # streams are bit-identical with metrics on or off (the hooks ride
+    # host work the tick already does; tests/test_serve_metrics.py).
+    # Tick-domain request clocks (RequestTimes) run unconditionally —
+    # they are integer bookkeeping and feed the response-record timing
+    # columns even when the plane is off.
 
     def resolved_num_blocks(self) -> int:
         return self.num_blocks or self.max_seqs * self.max_blocks_per_seq
@@ -259,6 +268,12 @@ class Completion:
     tokens: List[int]    # generated ids (EOS included when emitted)
     reason: str          # eos | length | overflow | rejected | timeout
     #                      (| failed — replica_plane's retry-budget status)
+    timing: Optional[Dict[str, Any]] = None  # tick-domain request clocks
+    # (serve/metrics.RequestTimes): queue_ticks always, ttft_ticks /
+    # decode_ticks once a first token existed, wall ttft_ms when the
+    # metrics plane is on. Echoed on the serve/api response record for
+    # EVERY terminal status — a timeout with no timing would be a
+    # request whose queue wait silently vanished from the books.
 
 
 @dataclasses.dataclass
@@ -637,6 +652,14 @@ class ServingEngine:
             # serving itself never drops — inference routing is no-drop)
             self.stats.update(moe_valid_tokens=0.0, moe_kept_tokens=0.0,
                               moe_capacity_slots=0.0)
+        # tick-domain request clocks: always on (integer bookkeeping on
+        # events the loop already handles); the wall-clock/sketch plane
+        # only when armed. ``self.metrics`` may be replaced before the
+        # first submit with a ServeMetrics carrying an SLOMonitor
+        # (cli/run_serve wires --slo_* that way).
+        self.times = RequestTimes()
+        self.metrics: Optional[ServeMetrics] = (
+            ServeMetrics(self.times) if cfg.metrics else None)
 
         samp = (cfg.temperature, cfg.top_k, cfg.top_p)
         tp_axis, ep_axis = self._tp_axis, self._ep_axis
@@ -821,7 +844,23 @@ class ServingEngine:
             deadline_at = time.monotonic() + float(req.deadline_s)
         if deadline_at is not None:
             self._deadline_at[req.req_id] = float(deadline_at)
+        self.times.submitted(req.req_id, self.stats["ticks"])
+        if self.metrics is not None:
+            self.metrics.on_submit(req.req_id)
         self.pending.append(req)
+
+    def _finish_timing(self, req_id, status: str) -> Dict[str, Any]:
+        """Retire the request's clocks into a timing dict (fed through
+        the metrics plane when armed, which adds wall ``ttft_ms``) and
+        journal the terminal ``serve_finish`` event — the per-request
+        record run_analyze --serve builds waterfalls from."""
+        timing = self.times.finished(req_id, self.stats["ticks"])
+        if self.metrics is not None:
+            timing = self.metrics.on_finish(req_id, timing, status,
+                                            tick=self.stats["ticks"])
+        journal.active().event("serve_finish", req_id=str(req_id),
+                               reason=status, **timing)
+        return timing
 
     def has_work(self) -> bool:
         return bool(self.pending) or any(s is not None for s in self.slots)
@@ -970,7 +1009,8 @@ class ServingEngine:
                 self._deadline_at.pop(req.req_id, None)
                 completions.append(Completion(
                     req.req_id, len(req.tokens), list(req.committed),
-                    "rejected"))
+                    "rejected", timing=self._finish_timing(
+                        req.req_id, "rejected")))
                 continue
             if L > cap:
                 # a resumption already past the horizon: the uninterrupted
@@ -983,7 +1023,8 @@ class ServingEngine:
                 self._deadline_at.pop(req.req_id, None)
                 completions.append(Completion(
                     req.req_id, len(req.tokens), list(req.committed),
-                    "overflow"))
+                    "overflow", timing=self._finish_timing(
+                        req.req_id, "overflow")))
                 continue
             slot = self.tables.find_free_slot()
             if slot is None:
@@ -1070,6 +1111,9 @@ class ServingEngine:
                                        or self.cfg.max_new_tokens))
             slot_state.gen = list(req.committed) + [first]
             self.slots[slot] = slot_state
+            self.times.first_token(req.req_id, self.stats["ticks"])
+            if self.metrics is not None:
+                self.metrics.on_first_token(req.req_id)
             if self._speculator is not None:
                 self._speculator.on_admit(slot, hist, len(req.committed))
             self._maybe_finish(slot, completions)
@@ -1104,8 +1148,9 @@ class ServingEngine:
             if self._speculator is not None:
                 self._speculator.on_evict(slot)
         self._deadline_at.pop(s.req.req_id, None)
-        completions.append(
-            Completion(s.req.req_id, len(s.req.tokens), list(s.gen), reason))
+        completions.append(Completion(
+            s.req.req_id, len(s.req.tokens), list(s.gen), reason,
+            timing=self._finish_timing(s.req.req_id, reason)))
 
     def _decode(self, completions: List[Completion]) -> None:
         import jax.numpy as jnp
@@ -1179,7 +1224,8 @@ class ServingEngine:
                            n_generated=len(req.committed))
                 completions.append(Completion(
                     req.req_id, len(req.tokens), list(req.committed),
-                    "timeout"))
+                    "timeout", timing=self._finish_timing(
+                        req.req_id, "timeout")))
             else:
                 keep.append(req)
         self.pending = keep
@@ -1200,11 +1246,50 @@ class ServingEngine:
         with journal.active().span("serve/admit",
                                    pending=len(self.pending)):
             self._admit(completions)
+        if self.metrics is not None:
+            # per-token decode interval = the decode dispatch's wall time
+            # over however many tokens it committed (1/slot plain, up to
+            # k+1/slot speculative) — host clock reads only, the
+            # dispatch itself is untouched
+            t0 = time.monotonic()
+            tok0 = self.stats["decode_tokens"]
         if self._speculator is not None:
             self._speculator.decode_tick(completions)
         else:
             self._decode(completions)
+        if self.metrics is not None:
+            made = self.stats["decode_tokens"] - tok0
+            if made > 0:
+                self.metrics.on_decode_tick(
+                    (time.monotonic() - t0) * 1e3 / made, made)
+            self.metrics.set_gauges(**self._gauge_snapshot())
+            if self.metrics.maybe_drain(self.stats["ticks"]) is not None:
+                # the SAME counters the bench banks, at the same cadence
+                # the sketches drain — crash bundles and run_analyze
+                # --serve read these, not a private in-memory dict
+                journal.active().event("serve_stats", **self.stats)
         return completions
+
+    def _gauge_snapshot(self) -> Dict[str, float]:
+        """Live gauges for the metrics drain — every value is already a
+        host scalar (queue/slot/table bookkeeping and stats counters);
+        nothing here may touch a device buffer (the DLT001 rule)."""
+        g = {"queue_depth": len(self.pending),
+             "active_slots": sum(s is not None for s in self.slots),
+             "pages_allocated": self.tables.pages_allocated,
+             "free_blocks": self.tables.free_blocks,
+             "evictions": self.stats["evictions"],
+             "timeouts": self.stats["timeouts"]}
+        if self.prefix is not None:
+            hits, disp = self.stats["prefix_hits"], max(
+                self.stats["prefill_dispatches"], 1)
+            g["prefix_hit_rate"] = hits / disp
+            g["cow_copies"] = self.stats["cow_copies"]
+        if "spec_proposed" in self.stats:
+            g["spec_accept_rate"] = (
+                self.stats["spec_accepted"]
+                / max(self.stats["spec_proposed"], 1))
+        return g
 
     # ---------------------------------------------------------- the driver
     def run(self, requests: List[Request],

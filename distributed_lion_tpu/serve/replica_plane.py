@@ -77,6 +77,8 @@ from distributed_lion_tpu.serve.engine import (
     Request,
     ServingEngine,
 )
+from distributed_lion_tpu.serve.metrics import (
+    RequestTimes, ServeMetrics, TickLatencyWindow)
 from distributed_lion_tpu.train import journal, resilience
 
 REPLICA_STATES = ("healthy", "draining", "departed", "rejoining")
@@ -145,10 +147,21 @@ class ServingFleet:
         self._attempts: Dict[Any, int] = {}
         self._home: Dict[str, int] = {}        # prefix_group -> replica
         self.migration_latency_ticks: List[int] = []
-        # full per-replica tick-latency history (ms) — bench/diagnostic
-        # only (unbounded), the watch itself reads the bounded window
-        self.tick_latency_log: Optional[Dict[int, List[float]]] = (
-            {i: [] for i in range(replicas)} if record_latency else None)
+        # per-replica tick-latency diagnostic — BOUNDED: a recency window
+        # of raw samples (exact percentiles for the bench) plus a
+        # mergeable log-bin sketch for full-history queries
+        # (serve/metrics.TickLatencyWindow). A soak no longer grows a
+        # float per tick per replica forever; the watch itself reads the
+        # engine-side _Replica.tick_ms window as before.
+        self.tick_latency_log: Optional[Dict[int, TickLatencyWindow]] = (
+            {i: TickLatencyWindow() for i in range(replicas)}
+            if record_latency else None)
+        # queue-domain request clocks (fleet ticks): the timing columns
+        # for completions the fleet itself emits — queue-side timeouts
+        # and retry-budget failures never touch an engine, and their
+        # queue wait must not vanish from the response records
+        self.times = RequestTimes()
+        self.metrics_drain_every = 64
         self.stats = {"ticks": 0, "migrations": 0, "failed": 0,
                       "timeouts": 0, "replica_crashes": 0,
                       "replica_drains": 0, "replica_rejoins": 0,
@@ -179,6 +192,7 @@ class ServingFleet:
         migrations inherit the stamp, they never reset it."""
         deadline_at = (time.monotonic() + float(req.deadline_s)
                        if req.deadline_s is not None else None)
+        self.times.submitted(req.req_id, self.tick_no)
         self.queue.append(_QueueItem(req=req, not_before=self.tick_no,
                                      deadline_at=deadline_at))
 
@@ -209,7 +223,8 @@ class ServingFleet:
                         from_replica=rep, attempts=attempt, cause=cause,
                         committed=len(rec.committed))
             completions.append(Completion(
-                rid, len(rec.tokens), list(rec.committed), "failed"))
+                rid, len(rec.tokens), list(rec.committed), "failed",
+                timing=self.times.finished(rid, tick)))
             return
         backoff = (self.backoff_ticks * (2 ** max(attempt - 1, 0))
                    if count_attempt else 0)
@@ -334,7 +349,7 @@ class ServingFleet:
                             committed=len(item.req.committed))
                 completions.append(Completion(
                     rid, len(item.req.tokens), list(item.req.committed),
-                    "timeout"))
+                    "timeout", timing=self.times.finished(rid, tick)))
                 continue
             if item.not_before > tick:
                 later.append(item)
@@ -418,6 +433,10 @@ class ServingFleet:
                 rep.assigned.discard(rid)
                 self._records.pop(rid, None)
                 self._attempts.pop(rid, None)
+                # retire the fleet-side clock (the record keeps the
+                # serving engine's own timing — the honest one: it saw
+                # the prefill/decode ticks, the fleet only saw routing)
+                self.times.finished(rid, tick)
                 if c.reason == "timeout":
                     # a resident/engine-side deadline miss must show on
                     # the replica timeline like a queue-side one — an
@@ -431,7 +450,7 @@ class ServingFleet:
             ms = (time.perf_counter() - t0) * 1e3
             rep.tick_ms.append(ms)
             if self.tick_latency_log is not None:
-                self.tick_latency_log[i].append(ms)
+                self.tick_latency_log[i].add(ms)
             # refresh the shadow from the replica's host-side state: what
             # the fleet holds here is what a crash NEXT tick can recover,
             # which is every token accepted up to and including this tick
@@ -448,8 +467,36 @@ class ServingFleet:
             elif rep.state == "rejoining" and \
                     tick - rep.rejoined_at >= self.rejoin_probe_ticks:
                 rep.state = "healthy"
+        if self.stats["ticks"] % self.metrics_drain_every == 0:
+            # the fleet counters ride the journal at the same drain
+            # cadence as the engine planes — crash bundles and
+            # run_analyze --serve read the numbers the bench banks
+            self._event("fleet_stats", tick=tick,
+                        queue_depth=len(self.queue), **self.stats)
         self.tick_no += 1
         return completions
+
+    def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
+        """Fleet-level metrics aggregate: fold every LIVE replica's
+        sketch plane into one (pure bin-count merges — raw samples never
+        leave a replica) plus the fleet's own gauges. None when no live
+        replica runs with metrics armed. A departed replica's sketches
+        die with its engine — the fleet-side diagnostics that must
+        survive a crash (tick_latency_log, migration/timeout counters)
+        live on the fleet, not the engine."""
+        agg = ServeMetrics(RequestTimes())
+        merged = False
+        for rep in self.replicas:
+            if rep.engine is not None and rep.engine.metrics is not None:
+                agg.merge_from(rep.engine.metrics)
+                merged = True
+        if not merged:
+            return None
+        agg.set_gauges(queue_depth=len(self.queue), alive=self.alive(),
+                       migrations=self.stats["migrations"],
+                       failed=self.stats["failed"],
+                       timeouts=self.stats["timeouts"])
+        return agg.snapshot()
 
     # ------------------------------------------------------------ driver
     def run(self, requests: List[Request],

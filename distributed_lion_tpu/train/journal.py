@@ -91,7 +91,11 @@ def journal_filename(rank: int) -> str:
 def _safe_fields(fields: dict) -> dict:
     """Strict-JSON view of free-form record fields: non-finite floats become
     ``null`` + ``<k>_repr`` (the MetricsLogger convention); non-scalar
-    values are repr'd rather than risking a non-serializable record."""
+    values are repr'd rather than risking a non-serializable record.
+    One-level dicts of scalars flatten to dotted keys (``stats.ticks``) —
+    the serve metrics drain emits grouped counters and a nested object
+    would otherwise collapse to an unqueryable repr string; deeper
+    nesting still falls through to repr."""
     out: dict = {}
     for k, v in fields.items():
         if isinstance(v, float) and not math.isfinite(v):
@@ -107,6 +111,13 @@ def _safe_fields(fields: dict) -> dict:
             # (the control plane's mask_before/mask_after fields); anything
             # nested or non-finite still falls through to repr
             out[k] = list(v)
+        elif isinstance(v, dict) and all(
+                isinstance(kk, str) and (
+                    e is None or isinstance(e, (str, int, bool))
+                    or (isinstance(e, float) and math.isfinite(e)))
+                for kk, e in v.items()):
+            for kk, e in v.items():
+                out[f"{k}.{kk}"] = e
         else:
             out[k] = repr(v)
     return out

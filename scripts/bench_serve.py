@@ -56,6 +56,13 @@ ROADMAP item 4):
   ep>=2 and ep×tp token-identical. Judged by check_evidence's
   ``moe_serving`` stage (runbook stage 5m). The ep>=2 rows/markers need
   enough devices — on CPU run under ``DLION_PLATFORM=cpu8``.
+- **slo section** (ISSUE 17) — the seeded scripts/workload_gen.py soak
+  through the serve/metrics.py plane: TTFT and per-token decode latency
+  p50/p95/p99 read from the LogHistogram sketches, goodput (in-SLO
+  tokens/s), terminal status counts, token-loss accounting, breach
+  count, and the ``metrics_inert`` marker (metrics-ON token streams
+  byte-identical to metrics-OFF — the plane is observationally free).
+  Judged by check_evidence's ``slo`` stage (runbook stage 5n).
 
 CPU-produced artifacts are first-class smoke evidence (tiny model — the
 engine mechanism, not chip throughput); ``meta.backend`` records what
@@ -127,7 +134,7 @@ def _build(model_name: str, family: str, quant: str, max_seqs: int,
            top_k=None, speculate: str = "", tp: int = 0, ep: int = 0,
            ep_batch: bool = False, ep_overlap: bool = False,
            prefix_cache: bool = False, num_blocks: int = 0,
-           moe_stats: bool = False):
+           moe_stats: bool = False, metrics: bool = False):
     from distributed_lion_tpu.serve.engine import ServeConfig, ServingEngine
 
     model, params, cfg = _serve_model(model_name, family)
@@ -138,7 +145,8 @@ def _build(model_name: str, family: str, quant: str, max_seqs: int,
                        temperature=temperature, top_k=top_k, quant=quant,
                        tp=tp, ep=ep, ep_batch=ep_batch,
                        ep_overlap=ep_overlap, prefix_cache=prefix_cache,
-                       speculate=speculate, moe_stats=moe_stats)
+                       speculate=speculate, moe_stats=moe_stats,
+                       metrics=metrics)
     draft = model if speculate.startswith("draft") else None
     return ServingEngine(model, scfg, draft_model=draft), params, cfg
 
@@ -790,8 +798,6 @@ def bench_serve_resilience(model_name: str, family: str, quant: str,
     tick latency slow-vs-clean, detection + route-around facts), the
     drain and rejoin legs, and the identity markers recomputed live
     across greedy / sampled / speculative / prefix-cache engines."""
-    import numpy as np
-
     from distributed_lion_tpu.serve.engine import Request
     from distributed_lion_tpu.serve.replica_plane import ServingFleet
     from distributed_lion_tpu.train import resilience
@@ -887,9 +893,11 @@ def bench_serve_resilience(model_name: str, family: str, quant: str,
         Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed)
         for r in slow_reqs], arr=slow_arr, record_latency=True)
 
-    def p99(ms_list):
-        return (round(float(np.percentile(ms_list, 99)), 3)
-                if ms_list else 0.0)
+    def p99(win):
+        # TickLatencyWindow: exact percentile over the bounded recency
+        # window — the first jit-compile tick ages out instead of
+        # dominating p99 on BOTH replicas and masking the straggler
+        return round(win.percentile(99), 3) if len(win) else 0.0
 
     slow_base = {i: c.tokens for i, c in done_c.items()}
     slow = {
@@ -947,6 +955,129 @@ def bench_serve_resilience(model_name: str, family: str, quant: str,
             "drain": drain, "slow": slow, "rejoin": rejoin}
 
 
+def bench_slo(model_name: str, family: str, quant: str, block_size: int,
+              requests: int = 48, seed: int = 0,
+              slo_ttft_ms: float = 30_000.0, slo_tok_ms: float = 5_000.0,
+              slo_p99: float = 0.99) -> dict:
+    """The ISSUE 17 evidence: the seeded workload_gen soak through the
+    metrics plane. One fixed open-loop workload (Poisson + bursts,
+    heavy-tail lengths, shared-prefix populations — scripts/
+    workload_gen.generate, imported by file path like the other script
+    cross-imports) runs twice through identical engines: once with the
+    metrics plane OFF (the baseline token streams) and once with
+    metrics + SLO monitor ON (the measured soak). Banked:
+
+    - TTFT and per-token decode latency p50/p95/p99 — read from the
+      LogHistogram sketches, so the banked numbers exercise the same
+      bounded path a fleet aggregates through;
+    - goodput — tokens/s counted ONLY from requests that finished
+      successfully (eos | length) with TTFT inside the target (the
+      per-token side of the SLO is judged fleet-wide by the banked
+      tok_ms quantiles and the breach counter — per-request wall decode
+      clocks live inside the monitor and are not re-derivable here);
+    - terminal status counts, token-loss accounting, breach count;
+    - the ``metrics_inert`` marker: ON-run token streams byte-identical
+      to the OFF run — the whole plane must be observationally free.
+
+    The wide default targets are deliberate: a shared CI box can stall
+    for seconds, and this leg's regression gate is token loss + schema +
+    inertness, not wall-clock luck. Tight-target burn-rate behavior is
+    pinned deterministically in tests/test_serve_metrics.py with an
+    injected clock."""
+    import importlib.util
+
+    from distributed_lion_tpu.serve.engine import Request
+    from distributed_lion_tpu.serve.metrics import ServeMetrics, SLOMonitor
+
+    wg_path = os.path.join(REPO, "scripts", "workload_gen.py")
+    spec_ = importlib.util.spec_from_file_location("dlt_workload_gen",
+                                                   wg_path)
+    wg = importlib.util.module_from_spec(spec_)
+    spec_.loader.exec_module(wg)
+
+    _, _, cfg = _serve_model(model_name, family)
+    prompt_max, out_max = 24, 24
+    records = wg.generate(
+        requests=requests, seed=seed, rate=1.0, burst_every=10,
+        burst_size=3, vocab=cfg.vocab_size, prompt_median=8.0,
+        prompt_max=prompt_max, out_median=8.0, out_max=out_max)
+    reqs = [Request(req_id=r["id"], tokens=list(r["tokens"]),
+                    max_new_tokens=r["max_new_tokens"], seed=r["seed"],
+                    prefix_group=r.get("prefix_group"))
+            for r in records]
+    arrivals = {r["id"]: r["arrival_tick"] for r in records}
+    nblocks = -(-(prompt_max + out_max + 2) // block_size)
+
+    def fresh(**kw):
+        eng, _, _ = _build(model_name, family, quant, 8, block_size,
+                           nblocks, **kw)
+        return eng
+
+    def clone(rs):
+        return [Request(r.req_id, list(r.tokens), r.max_new_tokens,
+                        r.seed, prefix_group=r.prefix_group) for r in rs]
+
+    base = fresh().run(clone(reqs), dict(arrivals))
+
+    eng = fresh(metrics=True)
+    eng.metrics = ServeMetrics(eng.times, slo=SLOMonitor(
+        ttft_ms=slo_ttft_ms, tok_ms=slo_tok_ms, p99=slo_p99))
+    t0 = time.perf_counter()
+    done = eng.run(clone(reqs), dict(arrivals))
+    wall_s = max(time.perf_counter() - t0, 1e-9)
+
+    inert = (set(done) == set(base) and all(
+        done[i].tokens == base[i].tokens
+        and done[i].reason == base[i].reason for i in base))
+    tokens_lost = int(sum(
+        max(len(base[i].tokens) - len(done.get(i, base[i]).tokens), 0)
+        for i in base))
+    timed = all(
+        isinstance(c.timing, dict)
+        and isinstance(c.timing.get("queue_ticks"), int)
+        and isinstance(c.timing.get("decode_ticks"), int)
+        for c in done.values())
+
+    counts = {k: 0 for k in ("eos", "length", "overflow", "timeout",
+                             "failed")}
+    for c in done.values():
+        counts[c.reason] = counts.get(c.reason, 0) + 1
+    good_tokens = sum(
+        len(c.tokens) for c in done.values()
+        if c.reason in ("eos", "length") and isinstance(c.timing, dict)
+        and c.timing.get("ttft_ms") is not None
+        and c.timing["ttft_ms"] <= slo_ttft_ms)
+
+    snap = eng.metrics.snapshot()
+    quantiles = {
+        sec: {k: round(float(snap[sec][k]), 4)
+              for k in ("p50", "p95", "p99")}
+        for sec in ("ttft_ms", "tok_ms")}
+    markers = {
+        "metrics_inert": bool(inert),
+        "zero_token_loss": bool(tokens_lost == 0),
+        "responses_timed": bool(timed),
+    }
+    out = {
+        "markers": markers,
+        "targets": {"ttft_ms": float(slo_ttft_ms),
+                    "tok_ms": float(slo_tok_ms), "p99": float(slo_p99)},
+        "requests": int(len(done)),
+        "tokens_out": int(sum(len(c.tokens) for c in done.values())),
+        "tokens_lost": tokens_lost,
+        "ticks": int(eng.stats["ticks"]),
+        "breaches": int(eng.metrics.slo.breaches),
+        "ttft_ms": quantiles["ttft_ms"],
+        "tok_ms": quantiles["tok_ms"],
+        "goodput_tokens_per_sec": round(float(good_tokens) / wall_s, 3),
+        "status_counts": counts,
+    }
+    print(json.dumps({"slo": "soak", **{k: v for k, v in out.items()
+                                        if k != "markers"}, **markers},
+                     allow_nan=False), flush=True)
+    return out
+
+
 def main() -> int:
     from distributed_lion_tpu.parallel.mesh import force_cpu_platform
 
@@ -980,6 +1111,15 @@ def main() -> int:
                     help="decode batch of the TP rows")
     ap.add_argument("--prefix_requests", type=int, default=256,
                     help="requests in the shared-system-prompt memory leg")
+    ap.add_argument("--slo_requests", type=int, default=48,
+                    help="requests in the seeded workload_gen soak of "
+                         "the slo section")
+    ap.add_argument("--slo_ttft_ms", type=float, default=30_000.0,
+                    help="banked TTFT target of the slo soak (wide by "
+                         "default: the gate is token loss + schema + "
+                         "metrics inertness, not CI wall-clock luck)")
+    ap.add_argument("--slo_tok_ms", type=float, default=5_000.0,
+                    help="banked per-token latency target of the slo soak")
     ap.add_argument("--moe_eps", default="2,4",
                     help="expert-parallel degrees for the moe_serving "
                          "matrix rows (infeasible degrees dropped LOUDLY; "
@@ -1036,6 +1176,10 @@ def main() -> int:
     moe_serving = bench_moe_serving(
         moe_base, args.quant, args.block_size, args.ticks, args.warmup,
         batches, [int(e) for e in args.moe_eps.split(",") if e])
+    slo = bench_slo(model_name, args.family, args.quant, args.block_size,
+                    requests=args.slo_requests,
+                    slo_ttft_ms=args.slo_ttft_ms,
+                    slo_tok_ms=args.slo_tok_ms)
 
     doc = {
         "meta": {
@@ -1057,6 +1201,7 @@ def main() -> int:
         "tp_serving": tp_serving,
         "serve_resilience": serve_resilience,
         "moe_serving": moe_serving,
+        "slo": slo,
     }
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "serving.json")
@@ -1074,6 +1219,8 @@ def main() -> int:
                          for k, v in serve_resilience["markers"].items()},
                       **{f"moe_{k}": v
                          for k, v in moe_serving["markers"].items()},
+                      **{f"slo_{k}": v
+                         for k, v in slo["markers"].items()},
                       "prefix_mem_ratio":
                           tp_serving["prefix"]["prefix_mem_ratio"],
                       "best_tokens_per_sec_per_chip": max(
@@ -1082,7 +1229,8 @@ def main() -> int:
     return 0 if (all(bits.values()) and all(spec["markers"].values())
                  and all(tp_serving["markers"].values())
                  and all(serve_resilience["markers"].values())
-                 and all(moe_serving["markers"].values())) else 1
+                 and all(moe_serving["markers"].values())
+                 and all(slo["markers"].values())) else 1
 
 
 if __name__ == "__main__":

@@ -910,6 +910,54 @@ def elasticity_ok(path: str = ELASTICITY_ARTIFACT) -> bool:
     return doc.get("parity", {}).get("pass") is True
 
 
+# the serve-SLO stage (ISSUE 17): serving.json's ``slo`` section — the
+# seeded workload_gen soak through the serve/metrics.py plane. Captured
+# means (a) the document passes the strict serving.json schema
+# (including the slo section's ordered non-negative quantiles and
+# required status counts), (b) all three markers hold — metrics_inert
+# (metrics-ON token streams byte-identical to metrics-OFF),
+# zero_token_loss, responses_timed (every terminal status carried its
+# timing columns), (c) the soak actually ran (requests > 0 with
+# tokens_out > 0) and lost NOTHING (tokens_lost == 0 — the token-loss
+# regression gate), and (d) the banked TTFT p99 sits inside the banked
+# target (the SLO regression gate: the target rides the artifact, so a
+# re-bank that quietly widened it is visible in review, not laundered
+# through this check).
+def slo_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    sec = doc.get("slo")
+    if not isinstance(sec, dict):
+        return False
+    marks = sec.get("markers", {})
+    for k in ("metrics_inert", "zero_token_loss", "responses_timed"):
+        if marks.get(k) is not True:
+            return False
+    if not (sec.get("requests", 0) > 0 and sec.get("tokens_out", 0) > 0):
+        return False  # an empty soak proved nothing
+    if sec.get("tokens_lost") != 0:
+        return False
+    targets = sec.get("targets", {})
+    ttft = sec.get("ttft_ms", {})
+    tok = sec.get("tok_ms", {})
+    if not (isinstance(ttft.get("p99"), (int, float))
+            and isinstance(targets.get("ttft_ms"), (int, float))
+            and ttft["p99"] <= targets["ttft_ms"]):
+        return False
+    return (isinstance(tok.get("p99"), (int, float))
+            and isinstance(targets.get("tok_ms"), (int, float))
+            and tok["p99"] <= targets["tok_ms"])
+
+
 def journal_ok(dirname: str = "journal") -> bool:
     base = (dirname if os.path.isabs(dirname)
             else os.path.join(REPO, "runs", dirname))
@@ -953,6 +1001,7 @@ STAGES = [
     ("serve_resilience", serve_resilience_ok),
     ("moe_serving", moe_serving_ok),
     ("elasticity", elasticity_ok),
+    ("slo", slo_ok),
 ]
 
 # automation (the watcher exit condition) judges the parity legs on
@@ -1031,6 +1080,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return moe_serving_ok(arg or SERVE_ARTIFACT)
     if what == "elasticity":
         return elasticity_ok(arg or ELASTICITY_ARTIFACT)
+    if what == "slo":
+        return slo_ok(arg or SERVE_ARTIFACT)
     if what == "all":
         return all(fn() for _, fn in STAGES)
     if what == "automation":

@@ -619,6 +619,35 @@ else
     || echo "$(stamp) moe_serving section FAILED validation" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5n. serve SLO soak (ISSUE 17, ~2 min): the slo section of the
+# SAME runs/serving/serving.json — the seeded scripts/workload_gen.py
+# open-loop soak (Poisson + bursts, heavy-tail lengths, shared-prefix
+# populations, ONE fixed seed) drained through the serve/metrics.py
+# plane with the SLO monitor armed. Banked: TTFT + per-token decode
+# latency p50/p95/p99 read from the LogHistogram sketches, goodput
+# (in-SLO tokens/s), terminal status counts, token-loss accounting,
+# breach count, and the metrics_inert marker (metrics-ON token streams
+# byte-identical to metrics-OFF). bench_serve writes it alongside the
+# other serving sections, so a fresh capture already carries it.
+# check_evidence's 'slo' stage judges it (strict schema incl. ordered
+# quantiles, all three markers, tokens_lost == 0 — the token-loss
+# regression gate — and banked p99s inside the banked targets — the SLO
+# regression gate); this stage FAILS LOUDLY on either regression.
+if python scripts/check_evidence.py slo \
+    && [ "$(python -c 'import json;print(json.load(open("runs/serving/serving.json"))["meta"]["backend"])' 2>/dev/null)" = "tpu" ]; then
+  echo "$(stamp) slo section already captured on chip — skip" | tee -a "$OUT/log.txt"
+else
+  timeout -k 60 1800 python scripts/bench_serve.py --out runs/serving \
+      >> "$OUT/serving.log" 2>&1
+  rc=$?
+  python scripts/validate_metrics.py runs/serving/serving.json \
+      >> "$OUT/serving.log" 2>&1 || rc=$?
+  echo "$(stamp) slo rc=$rc" | tee -a "$OUT/log.txt"
+  python scripts/check_evidence.py slo \
+    && echo "$(stamp) slo section captured" | tee -a "$OUT/log.txt" \
+    || echo "$(stamp) slo section FAILED (SLO or token-loss regression, or schema)" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

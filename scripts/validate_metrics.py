@@ -26,6 +26,14 @@ event | log), a string ``name``, a finite number ``t`` and an integer
 The torn-final-line tolerance applies the same way (a crash mid-write
 tears at most the last record — the journal's documented durability unit).
 
+JSONL basenames starting with ``requests``/``workload`` (the
+scripts/workload_gen.py output) get the serve request line schema —
+tokens-or-prompt plus typed optionals — and basenames starting with
+``responses`` (``run_serve --out``) get the serve response schema:
+id/reason/token accounting plus the ISSUE-17 timing columns, with
+``queue_ticks``/``decode_ticks`` REQUIRED on every terminal status
+including timeout/failed/overflow.
+
 Non-JSONL arguments (``*.json``) are validated as strict single-document
 JSON artifacts, so EVERY JSON artifact the repo writes passes one
 validator: crash bundles (``crash/step_*/bundle.json`` — must carry
@@ -43,8 +51,10 @@ moe_serving document, per-row validated the same way incl.
 accept_rate ∈ [0,1] on every frontier row, the TP-degree +
 shared-prefix rows of the ISSUE 13 section, the
 crash-matrix/slow/drain/rejoin rows of the ISSUE 14 replica-plane
-section, and capacity_utilization/dropped_rate ∈ [0,1] on every
-dense-vs-MoE-vs-MoE+ep matrix row of the ISSUE 15 section), and the
+section, capacity_utilization/dropped_rate ∈ [0,1] on every
+dense-vs-MoE-vs-MoE+ep matrix row of the ISSUE 15 section, and the
+ISSUE 17 ``slo`` section — ordered p50 <= p95 <= p99 non-negative
+latency quantiles, finite goodput, required status counts), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
 document; timeline rows are strict-validated per row).
@@ -123,6 +133,158 @@ _JOURNAL_KINDS = ("meta", "span", "event", "log")  # == train/journal.KINDS
 def _finite_number(v) -> bool:
     return (isinstance(v, (int, float)) and not isinstance(v, bool)
             and v == v and v not in (float("inf"), float("-inf")))
+
+
+def validate_request_file(path: str) -> list[str]:
+    """Strict-schema check for serve request JSONL (the serve/api input
+    schema; scripts/workload_gen.py is the canonical writer): each line a
+    strict-JSON object carrying ``tokens`` (non-empty flat int list) or
+    ``prompt`` (non-empty string), with typed optionals —
+    ``max_new_tokens`` positive int, ``seed`` int, ``arrival_tick``
+    non-negative int, ``prefix_group`` non-empty string, ``deadline_s``
+    positive finite. The same refusals serve/api.load_request_file makes
+    at serve time, made BEFORE a soak burns minutes on a bad file."""
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    n_records = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as e:
+            if i == len(lines) and "constant" not in str(e):
+                continue
+            errors.append(f"{path}:{i}: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: record is {type(rec).__name__}, "
+                          "not an object")
+            continue
+        n_records += 1
+        toks = rec.get("tokens")
+        prompt = rec.get("prompt")
+        if toks is not None:
+            if (not isinstance(toks, list) or not toks or not all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    and t >= 0 for t in toks)):
+                errors.append(f"{path}:{i}: 'tokens' must be a non-empty "
+                              "flat list of non-negative ints")
+        elif not (isinstance(prompt, str) and prompt):
+            errors.append(f"{path}:{i}: request needs 'tokens' or a "
+                          "non-empty 'prompt'")
+        mnt = rec.get("max_new_tokens")
+        if mnt is not None and not (isinstance(mnt, int)
+                                    and not isinstance(mnt, bool)
+                                    and mnt > 0):
+            errors.append(f"{path}:{i}: 'max_new_tokens' must be a "
+                          "positive int when present")
+        seed = rec.get("seed")
+        if seed is not None and (isinstance(seed, bool)
+                                 or not isinstance(seed, int)):
+            errors.append(f"{path}:{i}: 'seed' must be an int when "
+                          "present")
+        at = rec.get("arrival_tick")
+        if at is not None and not (isinstance(at, int)
+                                   and not isinstance(at, bool)
+                                   and at >= 0):
+            errors.append(f"{path}:{i}: 'arrival_tick' must be a "
+                          "non-negative int when present")
+        group = rec.get("prefix_group")
+        if group is not None and (not isinstance(group, str) or not group):
+            errors.append(f"{path}:{i}: 'prefix_group' must be a "
+                          "non-empty string when present")
+        dl = rec.get("deadline_s")
+        if dl is not None and not (_finite_number(dl) and dl > 0):
+            errors.append(f"{path}:{i}: 'deadline_s' must be a positive "
+                          "finite number when present")
+    if n_records == 0:
+        errors.append(f"{path}: no request records")
+    return errors
+
+
+_RESPONSE_REASONS = ("eos", "length", "overflow", "rejected", "timeout",
+                     "failed")
+
+
+def validate_response_file(path: str) -> list[str]:
+    """Strict-schema check for serve response JSONL
+    (serve/api.serve_request_file / cli/run_serve --out): id + reason +
+    token accounting on every line, and the ISSUE-17 timing columns —
+    ``queue_ticks``/``decode_ticks`` REQUIRED on every terminal status
+    (timeout/failed/overflow included: a queue-side death whose wait
+    vanished from the books is the failure mode these columns exist to
+    prevent), ``ttft_ticks``/``ttft_ms`` typed strictly when present
+    (same discipline as ``prefix_group``)."""
+    errors: list[str] = []
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    n_records = 0
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as e:
+            if i == len(lines) and "constant" not in str(e):
+                continue
+            errors.append(f"{path}:{i}: {e}")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"{path}:{i}: record is {type(rec).__name__}, "
+                          "not an object")
+            continue
+        n_records += 1
+        if "id" not in rec:
+            errors.append(f"{path}:{i}: missing 'id'")
+        if rec.get("reason") not in _RESPONSE_REASONS:
+            errors.append(f"{path}:{i}: 'reason' must be one of "
+                          f"{'|'.join(_RESPONSE_REASONS)}, got "
+                          f"{rec.get('reason')!r}")
+        toks = rec.get("tokens")
+        if not (isinstance(toks, list) and all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in toks)):
+            errors.append(f"{path}:{i}: 'tokens' must be a flat int list")
+        for k in ("prompt_len", "n_generated"):
+            v = rec.get(k)
+            if not (isinstance(v, int) and not isinstance(v, bool)
+                    and v >= 0):
+                errors.append(f"{path}:{i}: {k!r} must be a non-negative "
+                              "int")
+        for k in ("queue_ticks", "decode_ticks"):
+            v = rec.get(k)
+            if not (isinstance(v, int) and not isinstance(v, bool)
+                    and v >= 0):
+                errors.append(f"{path}:{i}: missing non-negative int "
+                              f"{k!r} (timing columns are required on "
+                              "every terminal status)")
+        tt = rec.get("ttft_ticks")
+        if tt is not None and not (isinstance(tt, int)
+                                   and not isinstance(tt, bool)
+                                   and tt >= 0):
+            errors.append(f"{path}:{i}: 'ttft_ticks' must be a "
+                          "non-negative int when present")
+        tms = rec.get("ttft_ms")
+        if tms is not None and not (_finite_number(tms) and tms >= 0):
+            errors.append(f"{path}:{i}: 'ttft_ms' must be a non-negative "
+                          "finite number when present")
+        group = rec.get("prefix_group")
+        if group is not None and (not isinstance(group, str) or not group):
+            errors.append(f"{path}:{i}: 'prefix_group' must be a "
+                          "non-empty string when present")
+    if n_records == 0:
+        errors.append(f"{path}: no response records")
+    return errors
 
 
 def validate_journal_file(path: str) -> list[str]:
@@ -206,7 +368,7 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
     errors = []
     for key in ("meta", "decode", "prefill_share", "bit_identity",
                 "speculative", "tp_serving", "serve_resilience",
-                "moe_serving"):
+                "moe_serving", "slo"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -446,6 +608,72 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
                 if not (_finite_number(v) and 0.0 <= v <= 1.0):
                     errors.append(f"{where}.{k} must be a finite number "
                                   "in [0, 1]")
+    slo = doc.get("slo")
+    if slo is not None and not isinstance(slo, dict):
+        errors.append(f"{path}: 'slo' must be an object")
+    elif isinstance(slo, dict):
+        marks = slo.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: slo.markers must be an object")
+        else:
+            for k in ("metrics_inert", "zero_token_loss",
+                      "responses_timed"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(f"{path}: slo.markers.{k} must be a bool")
+        for k in ("requests", "tokens_out", "tokens_lost", "ticks",
+                  "breaches"):
+            if not (isinstance(slo.get(k), int)
+                    and not isinstance(slo.get(k), bool)
+                    and slo[k] >= 0):
+                errors.append(f"{path}: slo.{k} must be a non-negative int")
+        targets = slo.get("targets")
+        if not isinstance(targets, dict):
+            errors.append(f"{path}: slo.targets must be an object")
+        else:
+            for k in ("ttft_ms", "tok_ms"):
+                if not (_finite_number(targets.get(k)) and targets[k] > 0):
+                    errors.append(f"{path}: slo.targets.{k} must be a "
+                                  "finite positive number")
+            p = targets.get("p99")
+            if not (_finite_number(p) and 0.0 < p < 1.0):
+                errors.append(f"{path}: slo.targets.p99 must be a finite "
+                              "number in (0, 1)")
+        # percentile sketches must be non-negative AND ordered: a banked
+        # p50 > p99 means the sketch (or the banking code) is lying, and
+        # a latency can never be negative — both shapes the slo stage
+        # must refuse, not average over
+        for sec in ("ttft_ms", "tok_ms"):
+            q = slo.get(sec)
+            if not isinstance(q, dict):
+                errors.append(f"{path}: slo.{sec} must be an object")
+                continue
+            bad = False
+            for k in ("p50", "p95", "p99"):
+                v = q.get(k)
+                if not (_finite_number(v) and v >= 0):
+                    errors.append(f"{path}: slo.{sec}.{k} must be a "
+                                  "non-negative finite number")
+                    bad = True
+            if not bad and not (q["p50"] <= q["p95"] <= q["p99"]):
+                errors.append(f"{path}: slo.{sec} percentiles must be "
+                              "ordered p50 <= p95 <= p99")
+        gp = slo.get("goodput_tokens_per_sec")
+        if not (_finite_number(gp) and gp >= 0):
+            errors.append(f"{path}: slo.goodput_tokens_per_sec must be a "
+                          "non-negative finite number")
+        counts = slo.get("status_counts")
+        if not isinstance(counts, dict):
+            errors.append(f"{path}: slo.status_counts must be an object")
+        else:
+            for k in ("eos", "length", "overflow", "timeout", "failed"):
+                if k not in counts:
+                    errors.append(f"{path}: slo.status_counts missing "
+                                  f"{k!r}")
+            for k, v in counts.items():
+                if not (isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 0):
+                    errors.append(f"{path}: slo.status_counts.{k} must be "
+                                  "a non-negative int")
     return errors
 
 
@@ -647,10 +875,20 @@ def main(argv: list[str]) -> int:
         if path.endswith(".jsonl"):
             # run-journal files (journal_rank<r>.jsonl + rotations,
             # journal_tail.jsonl in crash bundles) carry the journal
-            # record schema; every other .jsonl is a metrics log
-            journal = os.path.basename(path).startswith("journal")
-            errors = (validate_journal_file(path) if journal
-                      else validate_file(path))
+            # record schema; serve workloads (requests*.jsonl /
+            # workload*.jsonl, the workload_gen output) and serve
+            # responses (responses*.jsonl, the run_serve --out) carry
+            # the serve/api line schemas; every other .jsonl is a
+            # metrics log
+            base = os.path.basename(path)
+            if base.startswith("journal"):
+                errors = validate_journal_file(path)
+            elif base.startswith(("requests", "workload")):
+                errors = validate_request_file(path)
+            elif base.startswith("responses"):
+                errors = validate_response_file(path)
+            else:
+                errors = validate_file(path)
         else:
             errors = validate_json_doc(path)
         if errors:

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Open-loop serving workload generator (ISSUE 17, ROADMAP item 2d).
+
+Emits a request JSONL in the serve/api schema (explicit ``tokens`` ids,
+so no tokenizer is needed downstream) describing an OPEN-LOOP arrival
+process — arrivals do not wait for completions, which is what makes a
+soak honest: a closed loop self-throttles exactly when the engine
+degrades, hiding the queue growth an SLO monitor exists to see.
+
+The process, all from ONE fixed seed (numpy default_rng — the same
+workload byte-for-byte on every run/machine):
+
+- **Poisson arrivals**: exponential inter-arrival gaps at ``--rate``
+  requests per engine tick, cumulated and floored onto the integer
+  ``arrival_tick`` grid the engine's run() driver consumes.
+- **Burst overlay**: every ``--burst_every`` arrivals, ``--burst_size``
+  extra requests land on the SAME tick — the thundering-herd shape that
+  pure Poisson under-represents and admission queues die on.
+- **Heavy-tail lengths**: prompt and output budgets draw from lognormal
+  tails (median/sigma knobs, hard caps) — most requests short, a fat
+  tail of long ones, the mix that makes prefill fairness and page-pool
+  pressure real.
+- **Shared-prefix populations**: ``--prefix_groups`` populations each
+  share a common prompt prefix (tagged ``prefix_group``, matched by
+  TOKENS by the prefix cache; the tag also drives fleet group routing).
+- **Deadlines**: a ``--deadline_frac`` fraction of requests carries
+  ``deadline_s`` so the timeout path is exercised, not just modeled.
+
+    python scripts/workload_gen.py --requests 200 --seed 0 \
+        --out runs/serving/requests.jsonl
+
+The output validates under scripts/validate_metrics.py (the request
+JSONL schema) and drives ``serve/api.serve_request_file``, a
+ServingEngine/ServingFleet ``run()``, or scripts/bench_serve.py's slo
+soak (which imports :func:`generate` by file path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _lognormal_int(rng, median: float, sigma: float, lo: int,
+                   hi: int) -> int:
+    v = int(round(float(rng.lognormal(np.log(median), sigma))))
+    return max(lo, min(v, hi))
+
+
+def generate(requests: int = 100, seed: int = 0, rate: float = 0.5,
+             burst_every: int = 25, burst_size: int = 4,
+             vocab: int = 256, prompt_median: float = 12.0,
+             prompt_sigma: float = 0.6, prompt_max: int = 48,
+             out_median: float = 16.0, out_sigma: float = 0.7,
+             out_max: int = 96, prefix_groups: int = 3,
+             prefix_frac: float = 0.5, prefix_len: int = 8,
+             deadline_frac: float = 0.0, deadline_s: float = 5.0
+             ) -> list:
+    """Build the request records (dicts in the serve/api line schema).
+    Pure function of its arguments — the fixed ``seed`` pins arrivals,
+    lengths, prefix membership and token ids alike."""
+    if requests < 1:
+        raise ValueError(f"need >= 1 request, got {requests!r}")
+    if rate <= 0:
+        raise ValueError(f"--rate must be > 0, got {rate!r}")
+    if not 0.0 <= prefix_frac <= 1.0 or not 0.0 <= deadline_frac <= 1.0:
+        raise ValueError("prefix_frac/deadline_frac must be in [0, 1]")
+    rng = np.random.default_rng(int(seed))
+    prefixes = [
+        [int(t) for t in rng.integers(1, vocab, int(prefix_len))]
+        for _ in range(max(int(prefix_groups), 0))]
+    records = []
+    t = 0.0
+    since_burst = 0
+    i = 0
+    while len(records) < requests:
+        t += float(rng.exponential(1.0 / rate))
+        arrivals_now = 1
+        since_burst += 1
+        if burst_every > 0 and since_burst >= burst_every:
+            since_burst = 0
+            arrivals_now += int(burst_size)
+        for _ in range(arrivals_now):
+            if len(records) >= requests:
+                break
+            plen = _lognormal_int(rng, prompt_median, prompt_sigma, 1,
+                                  prompt_max)
+            group = None
+            toks = [int(x) for x in rng.integers(1, vocab, plen)]
+            if prefixes and float(rng.random()) < prefix_frac:
+                g = int(rng.integers(0, len(prefixes)))
+                group = f"pop{g}"
+                toks = prefixes[g] + toks
+            rec = {"id": f"w{i}", "tokens": toks,
+                   "max_new_tokens": _lognormal_int(
+                       rng, out_median, out_sigma, 1, out_max),
+                   "seed": i, "arrival_tick": int(t)}
+            if group is not None:
+                rec["prefix_group"] = group
+            if deadline_frac > 0 and float(rng.random()) < deadline_frac:
+                rec["deadline_s"] = float(deadline_s)
+            records.append(rec)
+            i += 1
+    return records
+
+
+def write_jsonl(records: list, path: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, allow_nan=False) + "\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--requests", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rate", type=float, default=0.5,
+                    help="mean Poisson arrivals per engine tick")
+    ap.add_argument("--burst_every", type=int, default=25,
+                    help="inject a burst every N arrivals (0 = never)")
+    ap.add_argument("--burst_size", type=int, default=4)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--prompt_median", type=float, default=12.0)
+    ap.add_argument("--prompt_sigma", type=float, default=0.6)
+    ap.add_argument("--prompt_max", type=int, default=48)
+    ap.add_argument("--out_median", type=float, default=16.0)
+    ap.add_argument("--out_sigma", type=float, default=0.7)
+    ap.add_argument("--out_max", type=int, default=96)
+    ap.add_argument("--prefix_groups", type=int, default=3)
+    ap.add_argument("--prefix_frac", type=float, default=0.5)
+    ap.add_argument("--prefix_len", type=int, default=8)
+    ap.add_argument("--deadline_frac", type=float, default=0.0)
+    ap.add_argument("--deadline_s", type=float, default=5.0)
+    ap.add_argument("--out", default=os.path.join(
+        "runs", "serving", "requests.jsonl"))
+    args = ap.parse_args(argv)
+    records = generate(
+        requests=args.requests, seed=args.seed, rate=args.rate,
+        burst_every=args.burst_every, burst_size=args.burst_size,
+        vocab=args.vocab, prompt_median=args.prompt_median,
+        prompt_sigma=args.prompt_sigma, prompt_max=args.prompt_max,
+        out_median=args.out_median, out_sigma=args.out_sigma,
+        out_max=args.out_max, prefix_groups=args.prefix_groups,
+        prefix_frac=args.prefix_frac, prefix_len=args.prefix_len,
+        deadline_frac=args.deadline_frac, deadline_s=args.deadline_s)
+    write_jsonl(records, args.out)
+    last = records[-1]["arrival_tick"]
+    tagged = sum(1 for r in records if "prefix_group" in r)
+    toks = sum(len(r["tokens"]) for r in records)
+    print(f"wrote {len(records)} requests -> {args.out} "
+          f"(arrival span {last} ticks, {toks} prompt tokens, "
+          f"{tagged} prefix-tagged)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Fixture: the metrics plane's one forbidden shortcut — a lifecycle
+hook that reaches INTO the jitted decode tick and host-reads device
+values to stamp a latency (the observability twin of the per-token EOS
+branch: an `int(tok)` / `float(logit)` inside the compiled tick forces a
+device→host round trip per token, so "turning metrics on" would change
+the dispatch pattern the plane exists to observe). The real plane
+(serve/metrics.py) never touches a device value: every stamp rides host
+work the tick loop already does — submit bookkeeping, the one
+`np.asarray` host read per tick at the dispatch boundary, completion
+assembly — which is what keeps metrics-on byte-identical to metrics-off
+(the `metrics_inert` marker of serving.json's slo section). Never
+imported; parsed by graft-check's tier-1 tests
+(tests/test_analysis_lint.py), alongside the other serve/ fixtures."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def metered_decode_tick(params, lens, last_tok, metrics):
+    logits = (params["w"] * last_tok[:, None]).sum(-1)
+    tok = jnp.argmax(logits, axis=-1)
+    # DLT001: stamping TTFT from a device scalar inside the tick —
+    # the hook must read the tick's ONE host array, not the device
+    metrics.on_first_token(int(tok[0]))
+    if float(logits.max()) > 0:    # DLT001: host-side gauge branch
+        metrics.set_gauges(active=float(lens.sum()))
+    return tok, lens + 1
+
+
+def host_metrics_hooks(metrics, toks, wall_ms):
+    # NOT traced scope: the real hook sites — the per-tick host array
+    # and a host wall clock are already host scalars, so the plane adds
+    # zero syncs
+    metrics.on_decode_tick(wall_ms, len(toks))
+    return [int(t) for t in toks]

@@ -344,3 +344,21 @@ def test_speculate_fixture_and_module_clean():
     assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
         [str(f) for f in findings])
     assert lint.lint_file(os.path.join(PKG, "serve", "speculate.py")) == []
+
+
+def test_metrics_fixture_and_metrics_module_clean():
+    """ISSUE 17 satellite: the metrics plane must never host-read a
+    device value — a lifecycle hook stamping TTFT from `int(tok[0])`
+    inside the jitted tick would add the per-token sync the plane exists
+    to observe, and "metrics on" would no longer be observationally
+    free. The fixture shows the forbidden shape (DLT001 fires three
+    times); serve/metrics.py lints zero-finding by file path — every
+    stamp rides host work the tick loop already does — and the engine's
+    instrumented tick loop stays clean too."""
+    findings = lint.lint_file(os.path.join(
+        FIXTURES, "serve", "dlt001_metrics_host_read.py"))
+    assert [f.rule for f in findings] == ["DLT001", "DLT001", "DLT001"], (
+        [str(f) for f in findings])
+    for rel in ("serve/metrics.py", "serve/engine.py",
+                "serve/replica_plane.py"):
+        assert lint.lint_file(os.path.join(PKG, rel)) == [], rel

@@ -229,8 +229,9 @@ def test_block_tables_refcount_fuzz_vs_reference():
 
     def check():
         live = {p for p, c in refs.items() if c > 0}
-        free = set(bt._free)
-        assert len(bt._free) == len(free), "duplicate page on free list"
+        flat = [p for grp in bt._free for p in grp]
+        free = set(flat)
+        assert len(flat) == len(free), "duplicate page on free list"
         assert not (live & free), "live page on the free list"
         assert live | free == set(range(bt.num_blocks)), "page leaked"
         for p in range(bt.num_blocks):
