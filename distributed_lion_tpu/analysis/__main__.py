@@ -22,6 +22,19 @@ donation applied, and no bf16-param f32 upcasts
 (:func:`analysis.trace_check.check_trainer`). ``--json-out`` writes the
 report the runbook's static stage captures for
 ``scripts/check_evidence.py static``.
+
+Serve plane (jaxpr contracts on the SERVING dispatches — same tier-2
+requirements)::
+
+    python -m distributed_lion_tpu.analysis serve-check [--json-out FILE]
+
+Builds a real ServingEngine for every cell of the serving config matrix
+(tp × ep × ep_batch × quant × speculate) and walks the jaxprs/MLIR of the
+actual registered dispatches (:mod:`analysis.serve_check`): collective
+inventory, zero host callbacks in any dispatch, page-pool donation,
+weight-upcast scan, and the compile-count budget after a mixed workload.
+Exit codes match the lint: 0 = clean, 1 = findings. The report feeds
+``scripts/check_evidence.py static_serve``.
 """
 
 from __future__ import annotations
@@ -106,13 +119,24 @@ def _tier2(wires: list[str], buckets: list[int],
     return 0 if ok else 1
 
 
+def _serve_check(json_out: str | None) -> int:
+    from distributed_lion_tpu.parallel.mesh import force_cpu_platform
+
+    force_cpu_platform()  # honor DLION_PLATFORM before first device use
+    from distributed_lion_tpu.analysis import serve_check
+
+    return serve_check.main(json_out)
+
+
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m distributed_lion_tpu.analysis",
         description="graft-check: JAX-aware static analysis "
                     "(tier 1 AST lint / tier 2 jaxpr contract)")
     ap.add_argument("paths", nargs="*",
-                    help="files/dirs to lint (default: the package)")
+                    help="files/dirs to lint (default: the package), or "
+                         "the literal 'serve-check' to run the serving-"
+                         "plane jaxpr contract")
     ap.add_argument("--tier2", action="store_true",
                     help="run the jaxpr contract check instead of the lint")
     ap.add_argument("--wires", default="",
@@ -123,6 +147,10 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--json-out", default=None,
                     help="write the --tier2 report to this JSON file")
     args = ap.parse_args(argv)
+    if args.paths and args.paths[0] == "serve-check":
+        if args.tier2 or args.paths[1:]:
+            ap.error("serve-check takes no paths and excludes --tier2")
+        return _serve_check(args.json_out)
     if not args.tier2:
         return _tier1(args.paths)
     wires = [w for w in args.wires.split(",") if w]

@@ -59,6 +59,28 @@ DLT009      bare ``print()`` in a ``train/`` or ``data/`` module outside
             control plane gets one consumable event stream instead of 27
             scattered prints (the ISSUE-7 migration this rule pins).
             Traced-scope prints stay DLT003's finding.
+DLT010      device-array construction (``jnp.*`` / ``jax.device_put``)
+            inside a host-side ``for``/``while`` loop in a ``serve/``
+            module: the serving engine's tick contract is "build numpy
+            inside per-slot loops, convert ONCE at the dispatch boundary"
+            — a ``jnp.asarray`` per loop iteration is a hidden H2D
+            transfer per slot per tick. Statement loops only: a
+            comprehension-built device allocation (``init_pages``) is the
+            one-shot construction idiom, and the per-request prefill
+            dispatch lives in its own helper method so the admission
+            loop's body stays numpy (the structural boundary this linter
+            documents for every rule: cross-function flows are tier 2's
+            job). Traced-scope ``jnp.*`` is ordinary jax code — the rule
+            fires at host level only.
+DLT011      direct wall-clock read (``time.time``/``time.monotonic``/
+            ``time.perf_counter`` and their ``_ns`` twins) in a
+            ``serve/`` module outside the injectable ``time_fn`` seam
+            (serve/metrics.ServeMetrics introduced it; the engine and
+            fleet carry it too): a hardwired clock makes deadline/SLO/
+            latency behavior untestable — tests would need real sleeps.
+            Referencing ``time.monotonic`` as a default (``time_fn=
+            time.monotonic``) is the seam itself and stays legal (the
+            rule matches CALLS). ``time.sleep`` is not a clock read.
 ==========  ================================================================
 
 Suppression syntax (both forms take a comma-separated rule list):
@@ -94,6 +116,15 @@ MESH_MODULE_SUFFIX = "parallel/mesh.py"
 # module itself is the one place a real print belongs.
 JOURNAL_DIR_SEGMENTS = ("train", "data")
 JOURNAL_MODULE_SUFFIX = "train/journal.py"
+# DLT010/DLT011 scope: the serving plane's host loop hygiene — modules
+# under a serve/ directory run the tick loops whose contracts ("one H2D
+# conversion set per dispatch", "injectable clocks") these rules pin.
+SERVE_DIR_SEGMENTS = ("serve",)
+# DLT011: clock-reading time.* calls (time.sleep is not a clock read;
+# referencing time.monotonic as a default parameter is the seam, not a
+# call, and never matches)
+CLOCK_CALLS = ("time.time", "time.monotonic", "time.perf_counter",
+               "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns")
 
 # function/decorator names that put their function argument under a jax
 # trace; terminal-name match so jax.jit / lax.scan / plain jit all hit
@@ -116,6 +147,8 @@ RULES = {
     "DLT007": "json.dump/dumps without allow_nan=False",
     "DLT008": "mutable default argument",
     "DLT009": "bare print in train//data/ outside the journal emitter",
+    "DLT010": "device-array construction inside a host-side serve/ loop",
+    "DLT011": "direct wall-clock read in serve/ outside the time_fn seam",
 }
 
 _DISABLE_LINE = re.compile(r"#\s*graft:\s*disable=([A-Z0-9,\s]+)")
@@ -237,8 +270,16 @@ class _Linter(ast.NodeVisitor):
             not norm.endswith(JOURNAL_MODULE_SUFFIX)
             and any(f"/{seg}/" in norm or norm.startswith(f"{seg}/")
                     for seg in JOURNAL_DIR_SEGMENTS))
+        # DLT010/DLT011 apply to modules living under a serve/ directory
+        self.in_serve_scope = any(
+            f"/{seg}/" in norm or norm.startswith(f"{seg}/")
+            for seg in SERVE_DIR_SEGMENTS)
         self._func_stack: list[ast.AST] = []
         self._traced_depth = 0
+        # statement-loop depth within the CURRENT function frame (DLT010);
+        # reset at function boundaries — a def's body is a fresh frame
+        # structurally, even when the def sits inside a loop
+        self._host_loop_depth = 0
         # pre-pass: names passed as function args to tracing HOFs anywhere in
         # the module mark those functions traced (lax.scan(body, ...),
         # jax.jit(step), shard_map(f, mesh=...)); lambdas in that position
@@ -283,13 +324,23 @@ class _Linter(ast.NodeVisitor):
         traced = self._function_is_traced(node)
         self._func_stack.append(node)
         self._traced_depth += traced
+        outer_loops, self._host_loop_depth = self._host_loop_depth, 0
         self.generic_visit(node)
+        self._host_loop_depth = outer_loops
         self._traced_depth -= traced
         self._func_stack.pop()
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
     visit_Lambda = _visit_function
+
+    def _visit_loop(self, node) -> None:
+        self._host_loop_depth += 1
+        self.generic_visit(node)
+        self._host_loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
 
     # ------------------------------------------------------------ rule bodies
     def _check_mutable_defaults(self, node) -> None:
@@ -327,6 +378,8 @@ class _Linter(ast.NodeVisitor):
                       "bare print() in a train//data/ module bypasses the "
                       "run journal; route it through train/journal.emit "
                       "(same stdout mirror, plus a journal event)")
+        if not self._traced_depth and self.in_serve_scope:
+            self._check_serve_host_call(node)
         self._check_prng_serialization(node)
         self._check_json_dump(node)
         if not self.in_mesh_module:
@@ -395,6 +448,28 @@ class _Linter(ast.NodeVisitor):
                       f"{dotted or name} injects a host callback into the "
                       "compiled step (the step contract is zero host "
                       "callbacks; see analysis.trace_check)")
+
+    def _check_serve_host_call(self, node: ast.Call) -> None:
+        """DLT010/DLT011 — serve/ host-loop hygiene (host level only:
+        traced-scope clocks are DLT002's finding, traced jnp.* is just
+        jax code)."""
+        dotted = _dotted(node.func) if _terminal_name(node.func) else ""
+        if dotted in CLOCK_CALLS:
+            self.emit("DLT011", node,
+                      f"{dotted}() hardwires the wall clock in serve/; "
+                      "route it through the injectable time_fn seam "
+                      "(ServeMetrics/ServingEngine/ServingFleet take "
+                      "time_fn=...) so deadline/SLO/latency behavior is "
+                      "testable without real sleeps")
+            return
+        if self._host_loop_depth and (
+                dotted.startswith(("jnp.", "jax.numpy."))
+                or dotted in ("jax.device_put", "device_put")):
+            self.emit("DLT010", node,
+                      f"{dotted}() inside a host-side serve/ loop builds a "
+                      "device array per iteration (a hidden H2D transfer "
+                      "per slot per tick); build numpy in the loop and "
+                      "convert ONCE at the dispatch boundary")
 
     def _check_prng_serialization(self, node: ast.Call) -> None:
         if _terminal_name(node.func) not in (

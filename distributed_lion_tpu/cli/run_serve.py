@@ -92,6 +92,14 @@ class ServeArguments:
     # of its pages. Outputs pinned identical to the unshared engine —
     # MoE checkpoints included (no-drop per-token inference routing means
     # shared pages cannot change any expert assignment).
+    serve_retrace_guard: str = "warn"  # off | warn | error — the serve
+    # twin of the trainer's --retrace_guard, at tick granularity: every
+    # dispatch's operand signature (shapes + dtypes) is checked against
+    # the compile budget (ONE decode/verify/cow program, one prefill per
+    # power-of-two page bucket) BEFORE tracing. 'warn' counts
+    # stats['serve_retraces'] and warns; 'error' raises before the extra
+    # lowering compiles; both are bit-identical to 'off' on the token
+    # streams (analysis/serve_check pins the budget statically).
     speculate: str = ""              # '<drafter>:<k>' — speculative decode
     # (serve/speculate.py): 'ngram:4' self-drafts from each request's own
     # history (zero extra device memory); 'draft:2' proposes with a small
@@ -187,6 +195,7 @@ def build_engine_factory(gen_args, serve_args: "ServeArguments"):
         ep_batch=serve_args.serve_ep_batch,
         ep_overlap=serve_args.serve_ep_overlap,
         prefix_cache=serve_args.prefix_cache,
+        retrace_guard=serve_args.serve_retrace_guard,
         speculate=serve_args.speculate,
         metrics=(serve_args.serve_metrics
                  or serve_args.slo_ttft_ms is not None

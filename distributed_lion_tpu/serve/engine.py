@@ -234,6 +234,17 @@ class ServeConfig:
     # Tick-domain request clocks (RequestTimes) run unconditionally —
     # they are integer bookkeeping and feed the response-record timing
     # columns even when the plane is off.
+    retrace_guard: str = "warn"  # off | warn | error — the serve twin of
+    # the trainer's --retrace_guard (ISSUE 19): every dispatch kind
+    # (decode tick, prefill, verify, cow) hashes its operand signature
+    # (rest-operand shapes/dtypes — params/pages are engine-owned stable
+    # buffers) and carries a compile budget: 1 program each for
+    # decode/verify/cow, one per power-of-two bucket for prefill. A
+    # signature past the budget is a recompile about to happen — counted
+    # as stats['serve_retraces'] + a warning, or a RuntimeError under
+    # 'error' BEFORE jax pays for the lowering. Purely observational:
+    # token streams are bit-identical to 'off' (the guard reads shapes,
+    # never values; pinned by tests/test_serve_check.py).
 
     def resolved_num_blocks(self) -> int:
         return self.num_blocks or self.max_seqs * self.max_blocks_per_seq
@@ -328,6 +339,58 @@ class _Slot:
     cache_len: int       # tokens whose k/v are in the pages
     last_tok: int        # newest sampled token (not yet in the cache)
     gen: List[int] = dataclasses.field(default_factory=list)
+
+
+def dispatch_signature(operands) -> tuple:
+    """The retrace guard's operand signature: (shape, dtype) per rest
+    operand — pure attribute reads (never values, never a device sync),
+    so observing a dispatch costs nanoseconds on the common tick. Python
+    scalars hash by type name (a scalar operand's jnp conversion always
+    lands the same weak dtype for the same Python type)."""
+    return tuple(
+        (tuple(getattr(a, "shape", ())),
+         str(getattr(a, "dtype", type(a).__name__)))
+        for a in operands)
+
+
+class _RetraceGuard:
+    """Tick-level recompile sentinel (``ServeConfig.retrace_guard`` —
+    the serving twin of train/loop's --retrace_guard, ISSUE 19). Each
+    dispatch kind carries a compile BUDGET (decode/verify/cow: one
+    program; prefill: one per power-of-two bucket — the engine's own
+    O(log max) compile claim). The first ``budget`` distinct operand
+    signatures are the legal specializations; any later NEW signature is
+    a recompile the design forbids — counted into
+    ``stats['serve_retraces']`` and warned once per signature, or raised
+    under ``error`` BEFORE jax pays for the lowering."""
+
+    def __init__(self, mode: str, budgets: Dict[str, int],
+                 stats: Dict[str, Any]):
+        self.mode = mode
+        self.budgets = budgets
+        self.stats = stats
+        self.seen: Dict[str, set] = {}
+
+    def observe(self, kind: str, operands) -> None:
+        sig = dispatch_signature(operands)
+        seen = self.seen.setdefault(kind, set())
+        if sig in seen:
+            return
+        budget = self.budgets.get(kind, 1)
+        if len(seen) < budget:
+            seen.add(sig)
+            return
+        msg = (f"serve retrace guard: dispatch {kind!r} saw a new operand "
+               f"signature past its compile budget ({budget}) — a "
+               f"recompile the serving design forbids; new signature: "
+               f"{sig}")
+        if self.mode == "error":
+            raise RuntimeError(msg)
+        seen.add(sig)
+        self.stats["serve_retraces"] = self.stats.get("serve_retraces", 0) + 1
+        import warnings
+
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 class ServeModel:
@@ -492,16 +555,26 @@ class ServingEngine:
     :class:`Completion`s."""
 
     def __init__(self, model: ServeModel, cfg: ServeConfig,
-                 draft_model: Optional[ServeModel] = None):
+                 draft_model: Optional[ServeModel] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.model = model
         self.cfg = cfg
+        # the injectable clock (graft-check DLT011): every wall-clock
+        # read in the engine goes through ``self._now`` so deadline /
+        # latency behavior is testable without real sleeps; the metrics
+        # plane (when armed) shares the same clock
+        self._now = time_fn
         params = model.params
         if cfg.quant not in ("none", "nf4", "int8"):
             raise ValueError(f"unknown quant mode {cfg.quant!r}")
+        if cfg.retrace_guard not in ("off", "warn", "error"):
+            raise ValueError(
+                f"unknown retrace_guard mode {cfg.retrace_guard!r} "
+                "(off | warn | error)")
         if cfg.quant != "none":
             from distributed_lion_tpu.ops.quant import quantize_tree
 
@@ -659,7 +732,18 @@ class ServingEngine:
         # (cli/run_serve wires --slo_* that way).
         self.times = RequestTimes()
         self.metrics: Optional[ServeMetrics] = (
-            ServeMetrics(self.times) if cfg.metrics else None)
+            ServeMetrics(self.times, time_fn=time_fn)
+            if cfg.metrics else None)
+        # dispatch registry (ISSUE 19): name -> the jitted callable plus
+        # the pre-jit body and jit options, so analysis/serve_check can
+        # walk the ACTUAL compiled programs (jaxprs + lowered MLIR) and
+        # compile_counts() can enumerate the live jit caches
+        self._dispatches: Dict[str, Dict[str, Any]] = {}
+        self._retrace_guard: Optional[_RetraceGuard] = None
+        if cfg.retrace_guard != "off":
+            self.stats["serve_retraces"] = 0
+            self._retrace_guard = _RetraceGuard(
+                cfg.retrace_guard, self.compile_budget(), self.stats)
 
         samp = (cfg.temperature, cfg.top_k, cfg.top_p)
         tp_axis, ep_axis = self._tp_axis, self._ep_axis
@@ -750,14 +834,16 @@ class ServingEngine:
             self._decode_tick = self._jit_paged(
                 decode_tick, n_rest=6,
                 rest_specs=(tab, bsp, bsp, bsp, bsp, bsp),
-                out_spec=(bsp, rep))
+                out_spec=(bsp, rep), name="decode")
             self._prefill = self._jit_paged(
                 prefill, n_rest=6,
                 rest_specs=(tab, rep, bsp, bsp, rep, rep),
-                out_spec=(bsp, rep))
+                out_spec=(bsp, rep), name="prefill")
         else:
-            self._decode_tick = self._jit_paged(decode_tick, n_rest=6)
-            self._prefill = self._jit_paged(prefill, n_rest=6)
+            self._decode_tick = self._jit_paged(decode_tick, n_rest=6,
+                                                name="decode")
+            self._prefill = self._jit_paged(prefill, n_rest=6,
+                                            name="prefill")
         self._cow = self._jit_cow(cow_copy)
 
         self._speculator = None
@@ -768,7 +854,59 @@ class ServingEngine:
                                                 draft_model)
 
     # ------------------------------------------------------- TP dispatch
-    def _jit_paged(self, fn, n_rest: int, rest_specs=None, out_spec=None):
+    def _register_dispatch(self, name: Optional[str], jitted, inner,
+                           donate, rest_specs, out_spec) -> None:
+        """Record a jitted serve dispatch for the observability hooks:
+        ``compile_counts()`` reads the live jit caches,
+        analysis/serve_check walks the jaxprs/MLIR of the same callables
+        the ticks run (``inner`` is the pre-jit body — the shard_map'd
+        program under a mesh — so the check can re-jit it with donation
+        forced on backends where the engine turns donation off)."""
+        if name is None:
+            return
+        self._dispatches[name] = {
+            "jitted": jitted, "inner": inner, "donate": tuple(donate),
+            "rest_specs": rest_specs, "out_spec": out_spec,
+        }
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Distinct compiled programs per registered dispatch, from jax's
+        own jit caches — the measurable side of "O(log max) prefill
+        compiles, ONE decode program". The compile-budget contract
+        (analysis/serve_check and the retrace guard) pins these against
+        :meth:`compile_budget` after a mixed workload."""
+        out: Dict[str, int] = {}
+        for name, d in self._dispatches.items():
+            size = getattr(d["jitted"], "_cache_size", None)
+            out[name] = int(size()) if callable(size) else -1
+        return out
+
+    def compile_budget(self) -> Dict[str, int]:
+        """Max legal distinct lowerings per dispatch kind: decode /
+        verify / cow are ONE fixed-shape program each; prefill gets one
+        per power-of-two page bucket (serve/kv_cache.bucket_tokens — the
+        O(log max) claim made countable). The draft-model mirror's own
+        prefill buckets identically."""
+        cap = self.cfg.block_size * self.cfg.max_blocks_per_seq
+        buckets = {bucket_tokens(n, self.cfg.block_size,
+                                 self.cfg.max_blocks_per_seq)
+                   for n in range(1, cap + 1)}
+        budget = {"decode": 1, "cow": 1, "prefill": len(buckets)}
+        if self.cfg.speculate:
+            budget["verify"] = 1
+            budget["draft_prefill"] = len(buckets)
+            budget["draft_step"] = 1
+        return budget
+
+    def _guard(self, kind: str, operands) -> None:
+        """Retrace-guard hook, called immediately before each dispatch
+        with its rest operands (params/pages are engine-owned stable
+        buffers and never change signature)."""
+        if self._retrace_guard is not None:
+            self._retrace_guard.observe(kind, operands)
+
+    def _jit_paged(self, fn, n_rest: int, rest_specs=None, out_spec=None,
+                   name: Optional[str] = None):
         """jit a dispatch ``fn(params, pages, *rest) -> (out, pages)``;
         under TP the body is shard_map'd over the serving mesh — params
         and pages sharded per their spec trees, every host-built operand
@@ -786,7 +924,9 @@ class ServingEngine:
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
         if self._mesh is None:
-            return jax.jit(fn, donate_argnums=donate)
+            jitted = jax.jit(fn, donate_argnums=donate)
+            self._register_dispatch(name, jitted, fn, donate, None, None)
+            return jitted
         from jax.sharding import PartitionSpec as P
 
         rep = P()
@@ -799,7 +939,10 @@ class ServingEngine:
             in_specs=(self._param_specs, self._pages_spec)
             + tuple(rest_specs),
             out_specs=(out_spec, self._pages_spec), check_vma=False)
-        return jax.jit(body, donate_argnums=donate)
+        jitted = jax.jit(body, donate_argnums=donate)
+        self._register_dispatch(name, jitted, body, donate,
+                                tuple(rest_specs), out_spec)
+        return jitted
 
     def _jit_cow(self, fn):
         """jit the CoW page-copy ``fn(pages, src, dst) -> pages`` (pages
@@ -811,7 +954,9 @@ class ServingEngine:
 
         donate = (0,) if jax.default_backend() != "cpu" else ()
         if self._mesh is None:
-            return jax.jit(fn, donate_argnums=donate)
+            jitted = jax.jit(fn, donate_argnums=donate)
+            self._register_dispatch("cow", jitted, fn, donate, None, None)
+            return jitted
         from jax.sharding import PartitionSpec as P
 
         rep = P()
@@ -820,7 +965,10 @@ class ServingEngine:
             fn, mesh=self._mesh,
             in_specs=(self._pages_spec, idx, idx),
             out_specs=self._pages_spec, check_vma=False)
-        return jax.jit(body, donate_argnums=donate)
+        jitted = jax.jit(body, donate_argnums=donate)
+        self._register_dispatch("cow", jitted, body, donate,
+                                (idx, idx), None)
+        return jitted
 
     def _absorb_moe_stats(self, st) -> None:
         """Fold a dispatch's MoE routing-load scalars into engine.stats —
@@ -841,7 +989,7 @@ class ServingEngine:
         request's wall-clock budget started at its ORIGINAL submission and
         must not reset when a survivor re-admits it."""
         if deadline_at is None and req.deadline_s is not None:
-            deadline_at = time.monotonic() + float(req.deadline_s)
+            deadline_at = self._now() + float(req.deadline_s)
         if deadline_at is not None:
             self._deadline_at[req.req_id] = float(deadline_at)
         self.times.submitted(req.req_id, self.stats["ticks"])
@@ -985,13 +1133,63 @@ class ServingEngine:
             for i, (s, d) in enumerate(pairs):
                 src[i], dst[i] = s, d
         with journal.active().span("serve/cow", copies=len(pairs)):
-            self.pages = self._cow(self.pages, jnp.asarray(src),
-                                   jnp.asarray(dst))
+            src_dev, dst_dev = jnp.asarray(src), jnp.asarray(dst)
+            self._guard("cow", (src_dev, dst_dev))
+            self.pages = self._cow(self.pages, src_dev, dst_dev)
 
     # -------------------------------------------------------------- ticks
-    def _admit(self, completions: List[Completion]) -> None:
+    def _dispatch_prefill(self, req: Request, slot: int, covered: int,
+                          suffix: List[int], padded: int) -> int:
+        """Ship ONE admitted request's prefill and return its sampled
+        first token. All device-array construction for the dispatch
+        happens here, at the dispatch boundary — the admission loop's
+        body stays numpy/table math (graft-check DLT010 pins that
+        shape), and the readback is ONE host sync per prefill."""
         import jax.numpy as jnp
 
+        toks = np.zeros((1, padded), np.int32)
+        toks[0, :len(suffix)] = suffix
+        bt = self.tables
+        g = bt.group_of(slot)
+        if self._ep_batch:
+            # only the OWNER group's shard gets the real table row
+            # (LOCAL ids) and the true length — the other shards see
+            # all-sentinel + length 0 (every lane invalid): their
+            # scatters drop, their lanes consume zero expert capacity,
+            # their sampled lane is never read (the token output is
+            # expert-sharded [ep])
+            tab = np.full((bt.groups, bt.max_blocks_per_seq),
+                          bt.blocks_per_group, np.int32)
+            row = bt.tables[slot]
+            tab[g] = np.where(row == bt.sentinel,
+                              bt.blocks_per_group,
+                              row - bt.group_base(g))
+            start_h = np.zeros((bt.groups,), np.int32)
+            start_h[g] = covered
+            len_h = np.zeros((bt.groups,), np.int32)
+            len_h[g] = len(suffix)
+            tab_dev = jnp.asarray(tab)
+            start_dev = jnp.asarray(start_h)
+            len_dev = jnp.asarray(len_h)
+        else:
+            tab_dev = jnp.asarray(bt.tables[slot:slot + 1])
+            start_dev = jnp.full((1,), covered, jnp.int32)
+            len_dev = jnp.int32(len(suffix))
+        # the sample index resumes at len(committed): the key for this
+        # draw is fold_in(key(seed), len(committed)) — the exact key the
+        # pre-migration engine would use next
+        rest = (tab_dev, jnp.asarray(toks), start_dev, len_dev,
+                jnp.uint32(req.seed), jnp.int32(len(req.committed)))
+        self._guard("prefill", rest)
+        (tok, st), self.pages = self._prefill(self.params, self.pages,
+                                              *rest)
+        # ONE host sync per prefill dispatch (the owner group's lane
+        # under ep_batch; the only lane otherwise)
+        first = int(np.asarray(tok).reshape(-1)[g if self._ep_batch else 0])
+        self._absorb_moe_stats(st)
+        return first
+
+    def _admit(self, completions: List[Completion]) -> None:
         budget = self.cfg.prefill_cap_tokens
         admitted = 0
         jrnl = journal.active()
@@ -1053,46 +1251,8 @@ class ServingEngine:
             with jrnl.span("serve/prefill", req_id=str(req.req_id),
                            prompt_len=L, padded=P, slot=slot,
                            shared=covered, resumed=len(req.committed)):
-                toks = np.zeros((1, P), np.int32)
-                toks[0, :len(suffix)] = suffix
-                bt = self.tables
-                g = bt.group_of(slot)
-                if self._ep_batch:
-                    # only the OWNER group's shard gets the real table
-                    # row (LOCAL ids) and the true length — the other
-                    # shards see all-sentinel + length 0 (every lane
-                    # invalid): their scatters drop, their lanes consume
-                    # zero expert capacity, their sampled lane is never
-                    # read (the token output is expert-sharded [ep])
-                    tab = np.full((bt.groups, bt.max_blocks_per_seq),
-                                  bt.blocks_per_group, np.int32)
-                    row = bt.tables[slot]
-                    tab[g] = np.where(row == bt.sentinel,
-                                      bt.blocks_per_group,
-                                      row - bt.group_base(g))
-                    start_h = np.zeros((bt.groups,), np.int32)
-                    start_h[g] = covered
-                    len_h = np.zeros((bt.groups,), np.int32)
-                    len_h[g] = len(suffix)
-                    tab_dev = jnp.asarray(tab)
-                    start_dev = jnp.asarray(start_h)
-                    len_dev = jnp.asarray(len_h)
-                else:
-                    tab_dev = jnp.asarray(bt.tables[slot:slot + 1])
-                    start_dev = jnp.full((1,), covered, jnp.int32)
-                    len_dev = jnp.int32(len(suffix))
-                # the sample index resumes at len(committed): the key for
-                # this draw is fold_in(key(seed), len(committed)) — the
-                # exact key the pre-migration engine would use next
-                (tok, st), self.pages = self._prefill(
-                    self.params, self.pages, tab_dev, jnp.asarray(toks),
-                    start_dev, len_dev,
-                    jnp.uint32(req.seed), jnp.int32(len(req.committed)))
-                # ONE host sync per prefill dispatch (the owner group's
-                # lane under ep_batch; the only lane otherwise)
-                first = int(np.asarray(tok).reshape(-1)[
-                    g if self._ep_batch else 0])
-                self._absorb_moe_stats(st)
+                first = self._dispatch_prefill(req, slot, covered,
+                                               suffix, P)
             budget -= P
             admitted += 1
             self.stats["prefill_dispatches"] += 1
@@ -1187,10 +1347,12 @@ class ServingEngine:
             seeds[i] = s.req.seed
             counts[i] = len(s.gen)  # index of the token being sampled
         with journal.active().span("serve/decode_tick", batch=len(active)):
+            rest = (self._device_tables(), jnp.asarray(lens),
+                    jnp.asarray(last), jnp.asarray(act),
+                    jnp.asarray(seeds), jnp.asarray(counts))
+            self._guard("decode", rest)
             (toks, st), self.pages = self._decode_tick(
-                self.params, self.pages, self._device_tables(),
-                jnp.asarray(lens), jnp.asarray(last), jnp.asarray(act),
-                jnp.asarray(seeds), jnp.asarray(counts))
+                self.params, self.pages, *rest)
             toks = np.asarray(toks)  # ONE host sync for the whole batch
             self._absorb_moe_stats(st)
         self.stats["decode_ticks"] += 1
@@ -1210,7 +1372,7 @@ class ServingEngine:
         another dispatch. Host-side clock reads only."""
         if not self._deadline_at:
             return
-        now = time.monotonic()
+        now = self._now()
         jrnl = journal.active()
         keep: deque = deque()
         while self.pending:
@@ -1251,7 +1413,7 @@ class ServingEngine:
             # over however many tokens it committed (1/slot plain, up to
             # k+1/slot speculative) — host clock reads only, the
             # dispatch itself is untouched
-            t0 = time.monotonic()
+            t0 = self._now()
             tok0 = self.stats["decode_tokens"]
         if self._speculator is not None:
             self._speculator.decode_tick(completions)
@@ -1261,7 +1423,7 @@ class ServingEngine:
             made = self.stats["decode_tokens"] - tok0
             if made > 0:
                 self.metrics.on_decode_tick(
-                    (time.monotonic() - t0) * 1e3 / made, made)
+                    (self._now() - t0) * 1e3 / made, made)
             self.metrics.set_gauges(**self._gauge_snapshot())
             if self.metrics.maybe_drain(self.stats["ticks"]) is not None:
                 # the SAME counters the bench banks, at the same cadence

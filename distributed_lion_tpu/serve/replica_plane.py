@@ -126,7 +126,8 @@ class ServingFleet:
                  replicas: int = 2, max_retries: int = 2,
                  backoff_ticks: int = 1, slow_factor: float = 4.0,
                  slow_min_ticks: int = 4, rejoin_probe_ticks: int = 2,
-                 record_latency: bool = False):
+                 record_latency: bool = False,
+                 time_fn: Callable[[], float] = time.monotonic):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
         if max_retries < 0 or backoff_ticks < 1:
@@ -161,6 +162,12 @@ class ServingFleet:
         # and retry-budget failures never touch an engine, and their
         # queue wait must not vanish from the response records
         self.times = RequestTimes()
+        # the injectable clock (graft-check DLT011): deadline stamps AND
+        # the per-replica tick-latency samples read it — a test can feed
+        # a fake clock and drive timeouts/straggler detection without
+        # real sleeps (monotonic fractional seconds; the latency math
+        # only ever subtracts, so any monotonic source is exact)
+        self._now = time_fn
         self.metrics_drain_every = 64
         self.stats = {"ticks": 0, "migrations": 0, "failed": 0,
                       "timeouts": 0, "replica_crashes": 0,
@@ -190,7 +197,7 @@ class ServingFleet:
     def submit(self, req: Request) -> None:
         """Queue a request; the wall-clock deadline (if any) stamps NOW —
         migrations inherit the stamp, they never reset it."""
-        deadline_at = (time.monotonic() + float(req.deadline_s)
+        deadline_at = (self._now() + float(req.deadline_s)
                        if req.deadline_s is not None else None)
         self.times.submitted(req.req_id, self.tick_no)
         self.queue.append(_QueueItem(req=req, not_before=self.tick_no,
@@ -336,7 +343,7 @@ class ServingFleet:
         return target
 
     def _route(self, tick: int, completions: List[Completion]) -> None:
-        now = time.monotonic()
+        now = self._now()
         later: deque = deque()
         while self.queue:
             item = self.queue.popleft()
@@ -425,7 +432,7 @@ class ServingFleet:
         for i, rep in enumerate(self.replicas):
             if rep.engine is None or not rep.engine.has_work():
                 continue
-            t0 = time.perf_counter()
+            t0 = self._now()
             if rep.slow_ms:
                 time.sleep(rep.slow_ms / 1e3)   # the injected straggler
             for c in rep.engine.step():
@@ -447,7 +454,7 @@ class ServingFleet:
                                 tick=tick, replica=i,
                                 committed=len(c.tokens))
                 completions.append(c)
-            ms = (time.perf_counter() - t0) * 1e3
+            ms = (self._now() - t0) * 1e3
             rep.tick_ms.append(ms)
             if self.tick_latency_log is not None:
                 self.tick_latency_log[i].add(ms)

@@ -282,6 +282,12 @@ class DraftModelDrafter:
 
         self._prefill = jax.jit(prefill, donate_argnums=donate)
         self._draft = jax.jit(draft, donate_argnums=donate)
+        # pre-jit bodies + donation, kept for the engine's dispatch
+        # registry (build_speculator registers the mirror's dispatches so
+        # analysis/serve_check and compile_counts() see EVERY serve
+        # dispatch, the draft mirror's included)
+        self._prefill_fn, self._draft_fn = prefill, draft
+        self._donate = donate
 
     def _bucket(self, n: int) -> int:
         # the engine's exact bucketing rule — the mirror must pad like
@@ -435,9 +441,10 @@ class Speculator:
             self._verify = engine._jit_paged(
                 verify, n_rest=6,
                 rest_specs=(P(EXPERT_AXIS, None), bsp, bsp, bsp, bsp, bsp),
-                out_spec=(bsp, rep))
+                out_spec=(bsp, rep), name="verify")
         else:
-            self._verify = engine._jit_paged(verify, n_rest=6)
+            self._verify = engine._jit_paged(verify, n_rest=6,
+                                             name="verify")
 
     # lifecycle relays from the engine
     def on_admit(self, slot: int, tokens: List[int],
@@ -521,11 +528,12 @@ class Speculator:
 
         with jrnl.span("serve/verify", batch=len(active),
                        proposed=int(sum(desired[i] for i in active))):
+            rest = (eng._device_tables(), jnp.asarray(lens),
+                    jnp.asarray(window), jnp.asarray(vcounts),
+                    jnp.asarray(seeds), jnp.asarray(gcounts))
+            eng._guard("verify", rest)
             (draws, st), eng.pages = self._verify(
-                eng.params, eng.pages, eng._device_tables(),
-                jnp.asarray(lens), jnp.asarray(window),
-                jnp.asarray(vcounts), jnp.asarray(seeds),
-                jnp.asarray(gcounts))
+                eng.params, eng.pages, *rest)
             draws = np.asarray(draws)  # ONE host sync for the whole batch
             eng._absorb_moe_stats(st)
 
@@ -608,4 +616,10 @@ def build_speculator(engine, spec: str,
                 f"draft model vocab {dv} != target vocab {tv}; the drafted "
                 "token ids would be meaningless to the target")
         drafter = DraftModelDrafter(draft_model, k, engine.cfg)
+        engine._register_dispatch("draft_prefill", drafter._prefill,
+                                  drafter._prefill_fn, drafter._donate,
+                                  None, None)
+        engine._register_dispatch("draft_step", drafter._draft,
+                                  drafter._draft_fn, drafter._donate,
+                                  None, None)
     return Speculator(engine, drafter, k)

@@ -441,6 +441,23 @@ def vote_guard_ok(base: str = "vote_guard") -> bool:
 # pass like every other evidence artifact.
 STATIC_TIER2_REPORT = os.path.join(OUT, "static_tier2.json")
 
+# serve-plane graft-check gate (ISSUE 19): the committed
+# runs/static/serve_check.json (written by `python -m
+# distributed_lion_tpu.analysis serve-check --json-out`, re-captured by
+# the runbook's stage 0b) passes validate_metrics' strict schema — every
+# matrix cell present and ok, inventories re-derived equal, zero host
+# callbacks, donation present, compile counts within budget.
+SERVE_CHECK_REPORT = os.path.join(REPO, "runs", "static",
+                                  "serve_check.json")
+
+
+def static_serve_ok(path: str | None = None) -> bool:
+    path = path or SERVE_CHECK_REPORT
+    if not os.path.exists(path):
+        return False
+    vm = _validate_metrics_module()
+    return not vm.validate_json_doc(path)
+
 
 def static_ok() -> bool:
     try:
@@ -991,6 +1008,7 @@ STAGES = [
     ("telemetry", telemetry_ok),
     ("resilience", resilience_ok),
     ("static", static_ok),
+    ("static_serve", static_serve_ok),
     ("vote_guard", vote_guard_ok),
     ("autotune", autotune_ok),
     ("journal", journal_ok),
@@ -1060,6 +1078,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return resilience_ok(arg or "resilience")
     if what == "static":
         return static_ok()
+    if what == "static_serve":
+        return static_serve_ok(arg)
     if what == "vote_guard":
         return vote_guard_ok(arg or "vote_guard")
     if what == "autotune":

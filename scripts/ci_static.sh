@@ -24,6 +24,20 @@ fi
 # graft-check tier 1 over the package (pure stdlib, loaded by file path)
 python distributed_lion_tpu/analysis/lint.py distributed_lion_tpu || rc=1
 
+# serve-plane graft-check (ISSUE 19): like tier 2, the traced matrix runs
+# in the runbook (`python -m distributed_lion_tpu.analysis serve-check
+# --json-out runs/static/serve_check.json`, stage 0b) — here the BANKED
+# artifact is held to the strict schema (stdlib validate_metrics: every
+# matrix cell present and ok, inventories re-derived equal, zero host
+# callbacks, donation present, compile counts within budget)
+if [ -f runs/static/serve_check.json ]; then
+  python scripts/validate_metrics.py runs/static/serve_check.json || rc=1
+else
+  echo "ci_static: runs/static/serve_check.json not captured yet — run" \
+       "python -m distributed_lion_tpu.analysis serve-check --json-out it"
+  rc=1
+fi
+
 if command -v shellcheck >/dev/null 2>&1; then
   shellcheck scripts/*.sh || rc=1
 else

@@ -53,6 +53,24 @@ else
   rc=$?; echo "$(stamp) graft-check tier2 rc=$rc" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 0b. serve-plane graft-check (ISSUE 19, ~1 min, no chip time):
+# build the real ServingEngine for every serving-matrix cell (tp x ep x
+# ep_batch x quant x speculate) and walk the jaxprs/MLIR of the actual
+# registered dispatches — collective inventory vs the config-derived
+# expectation, zero host callbacks in any dispatch (every prefill
+# bucket included), page-pool donation, weight-upcast scan, compile
+# counts within the power-of-two bucket budget. The committed
+# runs/static/serve_check.json is the capture artifact check_evidence's
+# `static_serve` stage (and ci_static.sh) validates.
+if python scripts/check_evidence.py static_serve; then
+  echo "$(stamp) static_serve gate already green — skip" | tee -a "$OUT/log.txt"
+else
+  mkdir -p runs/static
+  timeout -k 30 900 python -m distributed_lion_tpu.analysis serve-check \
+      --json-out runs/static/serve_check.json >> "$OUT/static.log" 2>&1
+  rc=$?; echo "$(stamp) graft-check serve rc=$rc" | tee -a "$OUT/log.txt"
+fi
+
 # Pick the best promotable sweep row across sweep*.jsonl and re-bench
 # bench.py under it via env knobs so last_tpu_measurement.json reflects
 # the best measured config. $1 names the run-at-most-once marker: without

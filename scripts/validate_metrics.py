@@ -814,6 +814,119 @@ def _tuning_cache_errors(path: str, doc) -> list[str]:
     return [f"{path}: {e}" for e in mod.validate_cache_doc(doc)]
 
 
+# the serve-plane graft-check matrix (analysis/serve_check.MATRIX): the
+# banked artifact must carry every cell — a missing cell means a config
+# axis silently dropped out of the contract. Kept as a literal so this
+# validator stays importable on boxes without jax
+# (tests/test_serve_check.py pins it against the live MATRIX).
+_SERVE_CHECK_FORMAT = "dlt-serve-check-v1"
+_SERVE_CHECK_CELLS = (
+    "dense_tp0_bf16", "dense_tp0_nf4", "dense_tp1_bf16", "dense_tp2_bf16",
+    "dense_tp2_nf4", "dense_tp0_ngram", "moe_ep1_bf16", "moe_ep2_bf16",
+    "moe_ep2_batch_bf16", "moe_ep2_batch_tp2_bf16", "moe_ep2_nf4",
+    "moe_ep2_ngram",
+)
+
+
+def _serve_check_errors(path: str, doc: dict) -> list[str]:
+    """Strict schema of the serve-plane graft-check artifact
+    (``python -m distributed_lion_tpu.analysis serve-check --json-out``;
+    gated by check_evidence's ``static_serve`` stage). The deep fields
+    are RE-DERIVED, not trusted: a forged ``ok: true`` over a mismatched
+    inventory, a present host callback, lost donation, or an over-budget
+    compile count is rejected from the document alone."""
+    errors = []
+    if doc.get("format") != _SERVE_CHECK_FORMAT:
+        errors.append(f"{path}: format must be {_SERVE_CHECK_FORMAT!r}")
+    if doc.get("ok") is not True:
+        errors.append(f"{path}: top-level ok must be true")
+    if not isinstance(doc.get("world"), int) or doc.get("world", 0) < 4:
+        errors.append(f"{path}: world must be an int >= 4 (full matrix)")
+    for k in ("backend", "jax"):
+        if not isinstance(doc.get(k), str):
+            errors.append(f"{path}: {k!r} must be a string")
+    cells = doc.get("cells")
+    if not isinstance(cells, list) or not cells:
+        errors.append(f"{path}: 'cells' must be a non-empty list")
+        cells = []
+    names = [c.get("cell") for c in cells if isinstance(c, dict)]
+    for want in _SERVE_CHECK_CELLS:
+        if want not in names:
+            errors.append(f"{path}: matrix cell {want!r} missing")
+    for cell in cells:
+        if not isinstance(cell, dict):
+            errors.append(f"{path}: cell entry is not an object")
+            continue
+        cname = cell.get("cell", "?")
+        if cell.get("ok") is not True:
+            errors.append(f"{path}: cells[{cname}].ok must be true")
+        disp = cell.get("dispatches")
+        if not isinstance(disp, dict) or not disp:
+            errors.append(f"{path}: cells[{cname}].dispatches must be a "
+                          "non-empty object")
+            continue
+        need = {"decode", "cow"}
+        if cell.get("speculate"):
+            need.add("verify")
+        if not any(d.startswith("prefill:") for d in disp):
+            errors.append(f"{path}: cells[{cname}] has no prefill bucket "
+                          "dispatch")
+        for d in sorted(need - set(disp)):
+            errors.append(f"{path}: cells[{cname}] missing dispatch "
+                          f"{d!r}")
+        for dname, rep in disp.items():
+            if not isinstance(rep, dict):
+                errors.append(f"{path}: cells[{cname}].{dname} is not an "
+                              "object")
+                continue
+            where = f"cells[{cname}].{dname}"
+            if rep.get("ok") is not True:
+                errors.append(f"{path}: {where}.ok must be true")
+            obs, exp = rep.get("observed"), rep.get("expected")
+            if not isinstance(obs, list) or not isinstance(exp, list):
+                errors.append(f"{path}: {where} observed/expected must be "
+                              "lists")
+            elif obs != exp:  # re-derived, not trusted from ok flags
+                errors.append(f"{path}: {where} collective inventory "
+                              f"mismatch: observed {obs} != expected "
+                              f"{exp}")
+            if rep.get("host_callbacks") != []:
+                errors.append(f"{path}: {where} has host callbacks "
+                              f"{rep.get('host_callbacks')}")
+            don = rep.get("donation")
+            if not isinstance(don, dict) or (
+                    don.get("aliased_outputs", 0)
+                    + don.get("buffer_donors", 0)) <= 0:
+                errors.append(f"{path}: {where} page-pool donation absent "
+                              f"({don})")
+            if rep.get("weight_upcasts") or rep.get("param_upcasts"):
+                errors.append(f"{path}: {where} carries weight upcasts")
+    compiles = doc.get("compile")
+    if not isinstance(compiles, list) or not compiles:
+        errors.append(f"{path}: 'compile' must be a non-empty list")
+        compiles = []
+    for comp in compiles:
+        if not isinstance(comp, dict):
+            errors.append(f"{path}: compile entry is not an object")
+            continue
+        cname = comp.get("cell", "?")
+        counts, budget = comp.get("counts"), comp.get("budget")
+        if not isinstance(counts, dict) or not isinstance(budget, dict):
+            errors.append(f"{path}: compile[{cname}] counts/budget must "
+                          "be objects")
+            continue
+        if counts.get("prefill", 0) <= 0:
+            errors.append(f"{path}: compile[{cname}] measured no prefill "
+                          "compiles — workload did not run")
+        for k, v in counts.items():  # re-derived over-budget check
+            # v == -1 is the "cache size unreadable" sentinel — rejected:
+            # an unmeasurable count cannot evidence the budget
+            if not isinstance(v, int) or v < 0 or v > budget.get(k, 0):
+                errors.append(f"{path}: compile[{cname}] {k}={v} exceeds "
+                              f"budget {budget.get(k, 0)}")
+    return errors
+
+
 def validate_json_doc(path: str) -> list[str]:
     """Strict single-document JSON artifact check (crash bundles,
     checkpoint manifests, and any other ``*.json`` the repo writes):
@@ -837,6 +950,8 @@ def validate_json_doc(path: str) -> list[str]:
         return _dcn_overlap_errors(path, doc)
     if name == "serving.json":
         return _serving_errors(path, doc)
+    if name == "serve_check.json" or doc.get("format") == _SERVE_CHECK_FORMAT:
+        return _serve_check_errors(path, doc)
     if name == "elasticity.json":
         return _elasticity_errors(path, doc)
     if name == "tuning_cache.json" or doc.get("format") == _TUNE_CACHE_FORMAT:

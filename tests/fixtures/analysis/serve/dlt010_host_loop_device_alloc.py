@@ -1,0 +1,39 @@
+"""DLT010 fixture: device-array construction inside a host-side serve/
+loop. Every iteration pays a fresh host->device transfer (and, for a
+shape that varies with the request, a fresh lowering) — the engine idiom
+is numpy/table math in the loop body with ONE jnp conversion at the
+dispatch boundary (engine._dispatch_prefill). Comprehensions stay legal:
+they are the one-shot construction idiom (kv_cache.init_pages)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def admission_loop(pending):
+    out = []
+    for req in pending:  # host-side statement loop
+        toks = jnp.asarray(req.tokens)      # DLT010: per-request transfer
+        pad = jnp.zeros((4,), jnp.int32)    # DLT010: per-iteration alloc
+        out.append((toks, pad))
+    return out
+
+
+def drain(queue):
+    while queue:
+        item = queue.pop()
+        yield jax.device_put(item)          # DLT010: device_put in a loop
+
+
+def legal_shapes(reqs):
+    # one-shot construction via comprehension (the init_pages idiom) and
+    # numpy accumulation with ONE conversion at the dispatch boundary
+    pages = [jnp.zeros((2, 2)) for _ in range(4)]
+    batch = np.stack([np.asarray(r.tokens) for r in reqs])
+    return pages, jnp.asarray(batch)
+
+
+def justified(pending):
+    for req in pending:
+        # a load-bearing per-request transfer can opt out, visibly:
+        yield jnp.asarray(req.tokens)  # graft: disable=DLT010

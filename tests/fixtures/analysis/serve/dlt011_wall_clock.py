@@ -1,0 +1,31 @@
+"""DLT011 fixture: direct wall-clock reads in serve/ outside the
+``time_fn`` seam. The serve plane injects time (``ServeMetrics`` /
+``ServingEngine`` / ``ServingFleet`` take ``time_fn=time.monotonic``) so
+deadline and latency math is testable without sleeping; a raw
+``time.time()`` in tick code bypasses the seam. The default-parameter
+REFERENCE stays legal — the rule matches CALLS — and ``time.sleep`` is
+pacing, not a clock read."""
+
+import time
+
+
+def deadline_at(req):
+    return time.monotonic() + req.deadline_s        # DLT011
+
+
+def tick_ms():
+    t0 = time.time()                                # DLT011
+    return (time.perf_counter() - t0) * 1e3         # DLT011
+
+
+class Plane:
+    def __init__(self, time_fn=time.monotonic):  # legal: the seam itself
+        self._now = time_fn
+
+    def pace(self):
+        time.sleep(0.01)  # legal: not a clock read
+        return self._now()
+
+    def display_only(self):
+        # a human-facing wall timestamp can opt out, visibly:
+        return time.time()  # graft: disable=DLT011

@@ -38,6 +38,11 @@ RULE_FIXTURES = {
     # path-scoped rule (bare print under a train//data/ directory) applies
     # to it the same way it applies to distributed_lion_tpu/train/
     "DLT009": (os.path.join("train", "dlt009_bare_print.py"), 2),
+    # DLT010/DLT011 are serve/-scoped the same way (host-loop hygiene for
+    # the serving plane, ISSUE 19)
+    "DLT010": (os.path.join("serve", "dlt010_host_loop_device_alloc.py"),
+               3),
+    "DLT011": (os.path.join("serve", "dlt011_wall_clock.py"), 3),
 }
 
 
