@@ -81,6 +81,19 @@ DLT011      direct wall-clock read (``time.time``/``time.monotonic``/
             Referencing ``time.monotonic`` as a default (``time_fn=
             time.monotonic``) is the seam itself and stays legal (the
             rule matches CALLS). ``time.sleep`` is not a clock read.
+DLT012      blocking socket/pipe read (``.recv``/``.recv_into``/
+            ``.recvfrom``/``.accept``/``.connect`` method calls, or
+            ``os.read``) in a ``serve/`` module with no deadline seam in
+            the enclosing function: an unbounded block in the serving
+            plane's host loop wedges EVERY request behind one dead peer
+            (the process-isolated fleet's heartbeat verdicts depend on
+            reads that return). The seam is structural, same tier as
+            DLT004's shim check: the enclosing function must mention a
+            timeout/deadline mechanism — ``settimeout``/``setblocking``,
+            a ``select``/``poll`` wait, a ``deadline``/``timeout``
+            variable, or the ``BlockingIOError`` non-blocking idiom.
+            Host level only; fires on method-shaped calls (a bare
+            ``read()`` name is not a pipe read).
 ==========  ================================================================
 
 Suppression syntax (both forms take a comma-separated rule list):
@@ -125,6 +138,14 @@ SERVE_DIR_SEGMENTS = ("serve",)
 # call, and never matches)
 CLOCK_CALLS = ("time.time", "time.monotonic", "time.perf_counter",
                "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns")
+# DLT012: socket/pipe primitives that block unboundedly by default...
+BLOCKING_IO_ATTRS = ("recv", "recv_into", "recvfrom", "accept", "connect")
+# ...unless the enclosing function visibly bounds them: an explicit
+# socket timeout, a select/poll wait, a deadline/timeout variable it
+# computes against, or the non-blocking BlockingIOError idiom (substring
+# match over the function's identifiers, the DLT004 shim-check tier)
+BLOCKING_IO_SEAMS = ("settimeout", "setblocking", "select", "poll",
+                     "deadline", "timeout", "BlockingIOError")
 
 # function/decorator names that put their function argument under a jax
 # trace; terminal-name match so jax.jit / lax.scan / plain jit all hit
@@ -149,6 +170,7 @@ RULES = {
     "DLT009": "bare print in train//data/ outside the journal emitter",
     "DLT010": "device-array construction inside a host-side serve/ loop",
     "DLT011": "direct wall-clock read in serve/ outside the time_fn seam",
+    "DLT012": "blocking socket/pipe read in serve/ without a deadline seam",
 }
 
 _DISABLE_LINE = re.compile(r"#\s*graft:\s*disable=([A-Z0-9,\s]+)")
@@ -470,6 +492,27 @@ class _Linter(ast.NodeVisitor):
                       "device array per iteration (a hidden H2D transfer "
                       "per slot per tick); build numpy in the loop and "
                       "convert ONCE at the dispatch boundary")
+            return
+        # DLT012 — blocking socket/pipe read with no deadline seam in the
+        # enclosing function (method-shaped calls only: a bare read()
+        # name is not a pipe read; os.read is the one dotted form)
+        blocking = (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in BLOCKING_IO_ATTRS) \
+            or dotted == "os.read"
+        if blocking:
+            scope = self._func_stack[-1] if self._func_stack else None
+            if scope is None or not _mentions_name(scope,
+                                                   BLOCKING_IO_SEAMS):
+                what = (node.func.attr if isinstance(node.func,
+                                                     ast.Attribute)
+                        else dotted)
+                self.emit("DLT012", node,
+                          f"{what}() can block forever in the serving "
+                          "host loop; bound it in this function — "
+                          "settimeout/setblocking, a select/poll wait "
+                          "with a deadline, or the BlockingIOError "
+                          "non-blocking idiom — so one dead peer cannot "
+                          "wedge every request behind it")
 
     def _check_prng_serialization(self, node: ast.Call) -> None:
         if _terminal_name(node.func) not in (

@@ -110,6 +110,42 @@ class ServeArguments:
     # --speculate draft:<k> (same loaders as --model_path)
     draft_model_name: Optional[str] = None   # draft architecture (default:
     # the target's model_name — self-drafting smoke mode)
+    listen: str = ""                 # live socket mode (serve/net.py):
+    # '<port>' or '<host>:<port>' ('0' = ephemeral, address printed as a
+    # JSON line on stdout). Newline-delimited JSON requests in (the SAME
+    # strict serve/api schema as --requests), per-token streaming frames
+    # out at host tick boundaries, honest backpressure reject frames
+    # when the admission queue or page pool is tight. Mutually exclusive
+    # with --requests — one transport per run.
+    listen_wall_s: float = 0.0       # stop the socket server after this
+    # many wall seconds (0 = run until interrupted); the bounded mode
+    # the soak bench and the runbook stage use
+    replica_procs: bool = False      # process-isolated fleet
+    # (serve/fleet_proc.py): each replica is its own
+    # ``serve.replica_worker`` subprocess speaking the length-prefixed
+    # pipe protocol — replica failure becomes a real OS event (the
+    # replica_kill fault SIGKILLs the child mid-decode; migration stays
+    # token-identical from the fleet's shadow). The parent loads the
+    # checkpoint once (tokenizer + validation); each child loads its own
+    # copy — real isolation costs real memory. Implies the fleet path
+    # even at --replicas 1.
+    heartbeat_timeout_s: float = 60.0  # per-tick reply deadline for a
+    # process replica; a miss journals replica_heartbeat_missed and the
+    # tick stays outstanding (a late reply is consumed next round)
+    heartbeat_max_misses: int = 3    # consecutive misses before the
+    # replica is declared dead (replica_declared_dead), SIGKILLed, and
+    # its requests migrate from the recovery shadow
+    fleet_state_dir: Optional[str] = None  # fleet-restart persistence
+    # (serve/fleet_state.py): recovery shadow + prefix chains persist
+    # here (atomic tmp+rename, sha256 manifest) on the
+    # --fleet_persist_every cadence and at drain. Implies the fleet path.
+    fleet_persist_every: int = 0     # persistence cadence in fleet ticks
+    # (0 = only at drain/exit)
+    resume_fleet: bool = False       # restore the newest valid persisted
+    # state from --fleet_state_dir before serving: in-flight requests
+    # re-submit (re-prefill from committed — token-identical by
+    # construction) and persisted shared-prefix chains re-prefill once
+    # as priming requests so the page pool warm-starts
     replicas: int = 1                # elastic serving fleet width
     # (serve/replica_plane, ISSUE 14): N independent engines (weights
     # shared, page pools per-replica) behind one admission queue with
@@ -233,11 +269,35 @@ def build_engine(gen_args, serve_args: "ServeArguments"):
 def build_fleet(gen_args, serve_args: "ServeArguments"):
     """(tokenizer, fleet) for ``--replicas N`` — N engines over ONE
     loaded checkpoint behind the replica plane's admission queue
-    (serve/replica_plane.ServingFleet)."""
+    (serve/replica_plane.ServingFleet). With ``--replica_procs`` each
+    replica is instead a ``serve.replica_worker`` subprocess built from
+    the SAME argument surface (the child re-runs this CLI's build), so
+    replica death is a real OS event."""
     from distributed_lion_tpu.serve.replica_plane import ServingFleet
 
-    tok, factory = build_engine_factory(gen_args, serve_args)
-    return tok, ServingFleet(factory, replicas=serve_args.replicas)
+    if serve_args.replica_procs:
+        from distributed_lion_tpu.cli.run_generate import build
+        from distributed_lion_tpu.serve.fleet_proc import (
+            process_replica_factory)
+
+        # the parent builds once for the tokenizer (and to fail fast on
+        # a bad checkpoint BEFORE spawning N children that would each
+        # fail slower); children load their own weights — process
+        # isolation is not free, it is the point
+        tok, _, _, _, _ = build(gen_args)
+        builder = {"kind": "cli",
+                   "gen": dataclasses.asdict(gen_args),
+                   "serve": dataclasses.asdict(serve_args)}
+        factory = process_replica_factory(
+            builder,
+            heartbeat_timeout_s=serve_args.heartbeat_timeout_s)
+    else:
+        tok, factory = build_engine_factory(gen_args, serve_args)
+    return tok, ServingFleet(
+        factory, replicas=serve_args.replicas,
+        heartbeat_max_misses=serve_args.heartbeat_max_misses,
+        state_dir=serve_args.fleet_state_dir,
+        persist_every=serve_args.fleet_persist_every)
 
 
 def main(argv=None):
@@ -259,6 +319,20 @@ def main(argv=None):
         raise ValueError(
             "--inject_serve needs --replicas >= 2: a one-replica fleet "
             "has no survivor to migrate in-flight requests to")
+    if args.listen and args.requests:
+        raise ValueError(
+            "--listen and --requests are two transports over the same "
+            "core — pick one per run (workload_gen --stream drives the "
+            "socket side with the same request files)")
+    if args.resume_fleet and not args.fleet_state_dir:
+        raise ValueError(
+            "--resume_fleet restores from --fleet_state_dir; set it to "
+            "the directory the previous run persisted into")
+    # the fleet path is implied by any fleet-plane knob: a 1-replica
+    # process fleet or a persistence-armed single replica still needs
+    # the fleet's shadow/heartbeat/persist machinery
+    use_fleet = (args.replicas > 1 or args.replica_procs
+                 or args.fleet_state_dir is not None)
     jrnl = None
     if args.journal_dir:
         jrnl = journal_mod.Journal(args.journal_dir)
@@ -269,11 +343,39 @@ def main(argv=None):
 
             resilience.inject_fault(
                 "serve", resilience.parse_serve_specs(args.inject_serve))
-        if args.replicas > 1:
+        if use_fleet:
             tok, engine = build_fleet(gen_args, args)
         else:
             tok, engine = build_engine(gen_args, args)
-        if args.requests:
+        if args.resume_fleet:
+            import time as _time
+
+            from distributed_lion_tpu.serve import fleet_state
+
+            state = fleet_state.load_fleet_state(args.fleet_state_dir,
+                                                 now=_time.monotonic())
+            info = fleet_state.resume_into(engine, state)
+            print(json.dumps({"resumed": info["restored"],
+                              "chains_primed": info["chains_primed"],
+                              "from_tick": info["tick"]},
+                             allow_nan=False), flush=True)
+        if args.listen:
+            from distributed_lion_tpu.serve.net import ServeServer
+
+            spec = args.listen
+            host, _, port = spec.rpartition(":")
+            server = ServeServer(engine, host=host or "127.0.0.1",
+                                 port=int(port), tokenizer=tok)
+            print(json.dumps({"listening": list(server.addr)},
+                             allow_nan=False), flush=True)
+            try:
+                server.run(max_wall_s=args.listen_wall_s or None)
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.close()
+            records = []
+        elif args.requests:
             records = api.serve_request_file(engine, args.requests,
                                              args.out or "/dev/stdout", tok)
         else:
@@ -292,12 +394,18 @@ def main(argv=None):
             for k, v in engine.stats.items()})
         # final metrics drain: the end-of-run snapshot lands in the
         # journal even when the run was shorter than one drain cadence
-        if args.replicas > 1:
+        if use_fleet:
             snap = engine.metrics_snapshot()
             if snap is not None:
                 journal_mod.active().event("serve_fleet_metrics", **{
                     f"{sec}_{k}": v for sec, d in snap.items()
                     if isinstance(d, dict) for k, v in d.items()})
+            if args.fleet_state_dir:
+                # the at-drain save: whatever is still in flight (a
+                # --listen server interrupted mid-decode included)
+                # survives into the next --resume_fleet
+                engine.save_state()
+            engine.close()
         elif engine.metrics is not None:
             engine.metrics.drain(engine.stats["ticks"])
         return records

@@ -36,8 +36,10 @@ queue-side deaths stamped by the fleet that never reached an engine —
 ``ttft_ms`` when the request was served with the metrics plane armed
 (ServeConfig.metrics / --serve_metrics).
 
-A socket mode can ride the same :func:`handle_requests` core later; the
-offline mode is what CI and the decode bench gate on.
+The live socket mode (serve/net.py, ``run_serve --listen``) rides the
+same strict per-request validation through :func:`parse_request_obj` —
+one schema, two transports; the offline mode is what CI and the decode
+bench gate on.
 """
 
 from __future__ import annotations
@@ -46,6 +48,54 @@ import json
 from typing import Any, Dict, List, Optional, Tuple
 
 from distributed_lion_tpu.serve.engine import Completion, Request
+
+
+def parse_request_obj(d: dict, where: str, tokenizer=None,
+                      default_id=None) -> Tuple[Request, int]:
+    """One request object (a parsed JSONL line or a live socket frame) →
+    ``(Request, arrival_tick)`` under the strict serve/api schema. The
+    ONE validation site for both transports — a field the offline mode
+    refuses must refuse identically over the wire (``where`` names the
+    source for the error message: ``"reqs.jsonl:7"`` or
+    ``"client 127.0.0.1:52710"``)."""
+    rid = d.get("id", default_id)
+    if rid is None:
+        raise ValueError(f"{where}: request needs an 'id'")
+    if "tokens" in d:
+        toks = [int(t) for t in d["tokens"]]
+    elif "prompt" in d and tokenizer is not None:
+        toks = tokenizer.encode(d["prompt"], add_bos=False) or [0]
+    else:
+        raise ValueError(
+            f"{where}: request needs 'tokens' or 'prompt' "
+            "(with a tokenizer)")
+    group = d.get("prefix_group")
+    if group is not None and (
+            not isinstance(group, str) or not group):
+        # strict: a mistyped tag must fail loudly, not silently
+        # ride as accounting noise (same discipline as every
+        # other artifact field — scripts/validate_metrics.py)
+        raise ValueError(
+            f"{where}: 'prefix_group' must be a non-empty "
+            f"string when present, got {group!r}")
+    deadline = d.get("deadline_s")
+    if deadline is not None and (
+            isinstance(deadline, bool)
+            or not isinstance(deadline, (int, float))
+            or not deadline > 0 or deadline != deadline
+            or deadline == float("inf")):
+        # strict: a malformed deadline must refuse, not silently
+        # serve without one (a request that LOOKS bounded but
+        # isn't is the worst failure mode a deadline can have)
+        raise ValueError(
+            f"{where}: 'deadline_s' must be a positive finite "
+            f"number when present, got {deadline!r}")
+    req = Request(
+        req_id=rid, tokens=list(toks),
+        max_new_tokens=d.get("max_new_tokens"),
+        seed=int(d.get("seed", 0)), prefix_group=group,
+        deadline_s=(float(deadline) if deadline is not None else None))
+    return req, int(d.get("arrival_tick", 0))
 
 
 def load_request_file(path: str, tokenizer=None
@@ -61,43 +111,10 @@ def load_request_file(path: str, tokenizer=None
             if not line:
                 continue
             d = json.loads(line)
-            rid = d.get("id", f"req{i}")
-            if "tokens" in d:
-                toks = [int(t) for t in d["tokens"]]
-            elif "prompt" in d and tokenizer is not None:
-                toks = tokenizer.encode(d["prompt"], add_bos=False) or [0]
-            else:
-                raise ValueError(
-                    f"{path}:{i}: request needs 'tokens' or 'prompt' "
-                    "(with a tokenizer)")
-            group = d.get("prefix_group")
-            if group is not None and (
-                    not isinstance(group, str) or not group):
-                # strict: a mistyped tag must fail loudly, not silently
-                # ride as accounting noise (same discipline as every
-                # other artifact field — scripts/validate_metrics.py)
-                raise ValueError(
-                    f"{path}:{i}: 'prefix_group' must be a non-empty "
-                    f"string when present, got {group!r}")
-            deadline = d.get("deadline_s")
-            if deadline is not None and (
-                    isinstance(deadline, bool)
-                    or not isinstance(deadline, (int, float))
-                    or not deadline > 0 or deadline != deadline
-                    or deadline == float("inf")):
-                # strict: a malformed deadline must refuse, not silently
-                # serve without one (a request that LOOKS bounded but
-                # isn't is the worst failure mode a deadline can have)
-                raise ValueError(
-                    f"{path}:{i}: 'deadline_s' must be a positive finite "
-                    f"number when present, got {deadline!r}")
-            requests.append(Request(
-                req_id=rid, tokens=list(toks),
-                max_new_tokens=d.get("max_new_tokens"),
-                seed=int(d.get("seed", 0)), prefix_group=group,
-                deadline_s=(float(deadline) if deadline is not None
-                            else None)))
-            arrivals[rid] = int(d.get("arrival_tick", 0))
+            req, at = parse_request_obj(d, f"{path}:{i}", tokenizer,
+                                        default_id=f"req{i}")
+            requests.append(req)
+            arrivals[req.req_id] = at
     return requests, arrivals
 
 

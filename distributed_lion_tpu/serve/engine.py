@@ -1032,6 +1032,21 @@ class ServingEngine:
                 self._deadline_at.get(req.req_id)))
         return recs
 
+    def export_prefix_chains(self) -> List[List[int]]:
+        """The prefix cache's maximal cached token chains (all pool
+        groups merged, deduped) — what fleet-restart persistence banks so
+        a NEW engine can warm-start its page pool by re-prefilling each
+        shared chain once instead of cold prefilling it per request.
+        Empty without ``prefix_cache`` (nothing shared, nothing to save).
+        Host-side dict walks only — no device sync."""
+        if not self._prefix_caches:
+            return []
+        seen = set()
+        for cache in self._prefix_caches:
+            for chain in cache.chains():
+                seen.add(tuple(chain))
+        return [list(k) for k in sorted(seen, key=lambda k: (len(k), k))]
+
     def _bucket(self, n: int) -> int:
         return bucket_tokens(n, self.cfg.block_size,
                              self.cfg.max_blocks_per_seq)

@@ -1,0 +1,207 @@
+"""Fleet-restart persistence: the serving plane's crash-safe state file.
+
+ROADMAP item 2(c), the last layer of the process-isolated serving PR:
+the :class:`~distributed_lion_tpu.serve.replica_plane.ServingFleet`'s
+recovery shadow (every in-flight request's prompt + committed + seed +
+remaining deadline) and the union of its replicas' PrefixCache chains
+(the maximal shared-prefix token runs) persist to a state directory on a
+cadence and at drain, so a FULL fleet stop — deploy, host reboot,
+``kill -9`` of the parent itself — is recoverable:
+
+- in-flight requests resume token-identically by construction
+  (``run_serve --resume_fleet`` re-submits each record; the engine
+  re-prefills prompt + committed and resumes the pinned per-request PRNG
+  stream at ``len(committed)`` — the PR 14 migration path, pointed at a
+  file instead of a live shadow);
+- the page pool warm-starts: each persisted chain re-prefills ONCE as a
+  1-token priming request before the restored requests submit, so their
+  shared system prompts prefix-hit instead of cold prefilling per
+  request (prefill tokens saved is measured and asserted by the bench).
+
+Integrity rides the PR 3 checkpoint idioms exactly: every state file is
+written tmp+rename (a torn write can never shadow a good file), digested
+into ``manifest.json`` (itself tmp+rename), and verified sha256 + size
+at load — a corrupt or truncated file is journaled
+(``fleet_state_corrupt``) and SKIPPED loudly, falling back to the
+previous generation, never silently dropping requests.
+
+Wall-clock deadlines persist as REMAINING seconds (``deadline_remaining_s``
+— the fleet_proc wire codec, reused verbatim): absolute monotonic stamps
+do not survive a process, let alone a reboot. A deadline that expired
+while the fleet was down restores already-expired and completes with the
+honest ``timeout`` status on the first routing pass.
+
+Stdlib-only, host-side (no jax); every clock value is passed IN by the
+caller (``now=``) — this module never reads a clock (DLT011's seam
+discipline, one level stricter: no seam needed when there is no read).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any, Dict, List, Optional
+
+from distributed_lion_tpu.serve.engine import RecoveryRecord, Request
+from distributed_lion_tpu.serve.fleet_proc import (
+    record_from_wire,
+    record_to_wire,
+)
+from distributed_lion_tpu.train import journal
+from distributed_lion_tpu.train.resilience import MANIFEST, sha256_file
+
+STATE_FORMAT = 1
+STATE_PREFIX = "fleet-"
+
+
+def _atomic_write(path: pathlib.Path, data: bytes) -> None:
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_bytes(data)
+    os.replace(tmp, path)
+
+
+def _infer_group(chain: List[int],
+                 records: List[RecoveryRecord]) -> Optional[str]:
+    """A chain's routing tag: the ``prefix_group`` of any in-flight
+    request whose prompt extends the chain. Persisted so the restart's
+    priming request lands on the SAME replica the restored group will
+    route to (affinity is what makes the warm pages reachable)."""
+    n = len(chain)
+    for rec in records:
+        if rec.prefix_group is not None and len(rec.tokens) >= n \
+                and [int(t) for t in rec.tokens[:n]] == chain:
+            return rec.prefix_group
+    return None
+
+
+def save_fleet_state(state_dir: str, records: List[RecoveryRecord],
+                     chains: List[List[int]], tick: int, now: float,
+                     keep: int = 2) -> str:
+    """One persistence generation: ``fleet-<tick>.json`` (tmp+rename) +
+    a refreshed sha256 manifest, pruning to the newest ``keep``
+    generations. Returns the state file path. ``now`` is the caller's
+    monotonic clock — deadlines convert to remaining seconds against
+    it."""
+    sdir = pathlib.Path(state_dir)
+    sdir.mkdir(parents=True, exist_ok=True)
+    name = f"{STATE_PREFIX}{int(tick):08d}.json"
+    payload = {
+        "format": STATE_FORMAT, "tick": int(tick),
+        "records": [record_to_wire(r, now) for r in records],
+        "chains": [{"tokens": [int(t) for t in c],
+                    "group": _infer_group([int(t) for t in c], records)}
+                   for c in chains],
+    }
+    raw = json.dumps(payload, sort_keys=True, allow_nan=False).encode()
+    _atomic_write(sdir / name, raw)
+    # prune BEFORE the manifest refresh so the manifest never lists a
+    # file the prune just deleted
+    states = sorted(p.name for p in sdir.glob(f"{STATE_PREFIX}*.json"))
+    for old in states[:-keep] if keep > 0 else []:
+        try:
+            (sdir / old).unlink()
+        except OSError:
+            pass
+        states = [s for s in states if s != old]
+    files = {s: {"sha256": sha256_file(sdir / s),
+                 "bytes": (sdir / s).stat().st_size}
+             for s in states}
+    man = json.dumps({"format": STATE_FORMAT, "files": files},
+                     sort_keys=True, allow_nan=False).encode()
+    _atomic_write(sdir / MANIFEST, man)
+    journal.active().event("fleet_state_saved", tick=int(tick),
+                           records=len(records), chains=len(chains),
+                           path=str(sdir / name))
+    return str(sdir / name)
+
+
+def load_fleet_state(state_dir: str, now: float) -> Dict[str, Any]:
+    """Newest VALID persisted generation: verify size + sha256 against
+    the manifest, parse, and re-stamp deadlines against ``now``. A
+    failing generation journals ``fleet_state_corrupt`` and falls back
+    to the previous one — requests are never silently dropped; when no
+    generation survives, raise (the caller asked to resume and there is
+    nothing honest to resume from)."""
+    sdir = pathlib.Path(state_dir)
+    man_path = sdir / MANIFEST
+    if not man_path.is_file():
+        raise FileNotFoundError(
+            f"--resume_fleet: no {MANIFEST} in {state_dir} (was the "
+            "fleet started with --fleet_state_dir?)")
+    try:
+        man = json.loads(man_path.read_text())
+        files = man["files"]
+    except (ValueError, KeyError) as e:
+        raise ValueError(
+            f"--resume_fleet: corrupt manifest {man_path}: {e}") from e
+    for name in sorted(files, reverse=True):   # newest generation first
+        path = sdir / name
+        why = None
+        try:
+            meta = files[name]
+            if not path.is_file():
+                why = "missing"
+            elif path.stat().st_size != int(meta["bytes"]):
+                why = (f"size {path.stat().st_size} != manifest "
+                       f"{meta['bytes']} (torn write)")
+            elif sha256_file(path) != meta["sha256"]:
+                why = "sha256 mismatch (corrupt)"
+        except (OSError, KeyError, ValueError, TypeError) as e:
+            why = f"unreadable: {e}"
+        if why is None:
+            try:
+                payload = json.loads(path.read_text())
+                if payload.get("format") != STATE_FORMAT:
+                    raise ValueError(
+                        f"format {payload.get('format')!r} != "
+                        f"{STATE_FORMAT}")
+                state = {
+                    "tick": int(payload["tick"]),
+                    "records": [record_from_wire(d, now)
+                                for d in payload["records"]],
+                    "chains": [{"tokens": [int(t) for t in c["tokens"]],
+                                "group": c.get("group")}
+                               for c in payload["chains"]],
+                    "path": str(path),
+                }
+            except (ValueError, KeyError, TypeError) as e:
+                why = f"invalid payload: {e}"
+            else:
+                journal.active().event(
+                    "fleet_state_restored", path=str(path),
+                    tick=state["tick"], records=len(state["records"]),
+                    chains=len(state["chains"]))
+                return state
+        journal.active().event("fleet_state_corrupt", path=str(path),
+                               reason=why)
+    raise ValueError(
+        f"--resume_fleet: no valid fleet state in {state_dir} (every "
+        "generation failed manifest verification — see "
+        "fleet_state_corrupt journal events)")
+
+
+def resume_into(target, state: Dict[str, Any]) -> Dict[str, int]:
+    """Restore a loaded state into a fresh engine/fleet ``target``:
+    warm-start the page pool by running each persisted chain as a
+    1-token priming request (re-prefills the shared prefix ONCE and —
+    with ``prefix_cache`` on — banks its pages; the priming request's
+    ``prefix_group`` pins the fleet's group→replica home so restored
+    requests land where the warm pages live), then re-submit every
+    in-flight record with its surviving deadline. The caller drives the
+    target afterwards (``run``/``step``) — restoration queues work, it
+    does not serve it."""
+    primers = []
+    for i, ch in enumerate(state["chains"]):
+        toks = list(ch["tokens"])
+        if not toks:
+            continue
+        primers.append(Request(req_id=f"__warm{i}", tokens=toks,
+                               max_new_tokens=1, seed=0,
+                               prefix_group=ch.get("group")))
+    if primers:
+        target.run(primers, {})
+    for rec in state["records"]:
+        target.submit(rec.to_request(), deadline_at=rec.deadline_at)
+    return {"restored": len(state["records"]),
+            "chains_primed": len(primers), "tick": state["tick"]}

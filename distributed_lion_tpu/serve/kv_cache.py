@@ -442,6 +442,21 @@ class PrefixCache:
         return added
 
     # ------------------------------------------------------------- reclaim
+    def chains(self) -> list:
+        """The MAXIMAL cached token prefixes, as token lists — the
+        restart-persistence export (serve/fleet_state): a key is maximal
+        when no other key extends it, so re-prefilling just these chains
+        on a fresh fleet re-banks every cached page (every shorter prefix
+        registers along the way). O(n²) over entry keys — the cache holds
+        tens of chains, not thousands, and this runs on the persistence
+        cadence, never per tick."""
+        keys = list(self._entries)
+        return sorted(
+            (list(k) for k in keys
+             if not any(len(o) > len(k) and o[:len(k)] == k
+                        for o in keys)),
+            key=lambda c: (len(c), c))
+
     def reclaim(self, n_pages: int) -> int:
         """Drop least-recently-used cached pages until ``n_pages`` are
         physically free (or the cache is empty). Evicting a page also

@@ -1,0 +1,497 @@
+"""Streaming socket front end for the serving stack (stdlib-only).
+
+The production face of ROADMAP item 2(a): a single-threaded,
+selectors-based accept loop over the serve/api core — the SAME engine /
+fleet tick loop CI drives offline, now fed by live connections:
+
+- **Requests in**: newline-delimited JSON, the exact offline schema
+  (:func:`serve.api.parse_request_obj` is the one validation site for
+  both transports — a field the file mode refuses, the wire refuses
+  identically). ``prefix_group`` rides through to the fleet's affinity
+  routing unchanged.
+- **Frames out**: newline-delimited strict JSON (``allow_nan=False``),
+  one of ``accepted`` / ``tokens`` / ``done`` / ``reject`` / ``error``.
+  Token frames are emitted at the HOST tick boundary by diffing the
+  engine's per-tick ``export_records()`` committed lists — host bytes
+  the recovery shadow already pays for, so streaming adds ZERO
+  per-token device syncs (the same no-new-sync discipline as the
+  metrics plane).
+- **Backpressure is honest**: when the admission queue or the page pool
+  is tight, a new request gets an explicit ``reject`` frame carrying
+  ``retry_after_s`` instead of unbounded server-side buffering. The
+  reference client (:class:`ServeClient`) retries with exponential
+  backoff on top of the server's hint; :func:`drive_open_loop` is the
+  open-loop driver ``scripts/workload_gen.py --stream`` and the bench's
+  socket-soak leg share.
+
+Timeout discipline (graft-check DLT012): every potentially-blocking
+socket/pipe operation here runs behind a ``selectors`` poll with an
+explicit timeout or a ``settimeout`` deadline — a serve-plane host loop
+must never be able to hang forever on a peer that went away.
+
+Layering: stdlib only at module scope (no jax, no numpy) — the server
+drives engines through the same duck surface the fleet uses
+(``submit`` / ``step`` / ``has_work`` / ``export_records``), so crash
+tooling and the workload generator import this module on boxes with no
+accelerator stack.
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from distributed_lion_tpu.serve import api as serve_api
+from distributed_lion_tpu.train import journal
+
+
+def encode_request(d: dict) -> bytes:
+    """Canonical wire bytes for one request object: sorted keys, compact
+    separators, strict JSON, one trailing newline. Byte-identical across
+    reruns for the same dict — the determinism `workload_gen --stream`
+    pins (the request STREAM is a pure function of the generator seed)."""
+    return (json.dumps(d, sort_keys=True, separators=(",", ":"),
+                       allow_nan=False) + "\n").encode("utf-8")
+
+
+def encode_frame(d: dict) -> bytes:
+    return (json.dumps(d, allow_nan=False) + "\n").encode("utf-8")
+
+
+class _Conn:
+    """Per-connection state: receive buffer, send buffer, owned request
+    ids, and the per-request committed-token counts already streamed."""
+
+    __slots__ = ("sock", "peer", "rbuf", "wbuf", "reqs", "sent", "seq")
+
+    def __init__(self, sock: socket.socket, peer: str):
+        self.sock = sock
+        self.peer = peer
+        self.rbuf = bytearray()
+        self.wbuf = bytearray()
+        self.reqs: set = set()
+        self.sent: Dict[Any, int] = {}   # req_id -> committed tokens sent
+        self.seq = 0                     # lines parsed (error locations)
+
+
+class ServeServer:
+    """Single-threaded streaming server over one engine or fleet.
+
+    ``target`` is anything with the engine tick surface: ``submit(req)``,
+    ``step() -> completions``, ``has_work()``, ``export_records()`` —
+    a :class:`~distributed_lion_tpu.serve.engine.ServingEngine` or a
+    :class:`~distributed_lion_tpu.serve.replica_plane.ServingFleet`
+    (process-isolated or not) both qualify. The loop interleaves socket
+    polling with engine ticks: poll (zero timeout while the engine has
+    work, ``idle_poll_s`` otherwise), admit complete request lines,
+    tick, stream the tick's new tokens, flush.
+
+    Backpressure knobs: ``max_queue_depth`` bounds the admission queue
+    (engine ``pending`` / fleet ``queue``); ``min_free_blocks`` keeps a
+    page-pool floor (single-engine targets only — a fleet's pools are
+    per-replica and its admission queue is the pressure signal). A
+    request arriving over either limit is rejected with an explicit
+    ``retry_after_s`` frame, never buffered unboundedly.
+    """
+
+    def __init__(self, target, host: str = "127.0.0.1", port: int = 0,
+                 tokenizer=None, max_queue_depth: int = 32,
+                 min_free_blocks: int = 0, retry_after_s: float = 0.05,
+                 idle_poll_s: float = 0.005,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.target = target
+        self.tokenizer = tokenizer
+        self.max_queue_depth = int(max_queue_depth)
+        self.min_free_blocks = int(min_free_blocks)
+        self.retry_after_s = float(retry_after_s)
+        self.idle_poll_s = float(idle_poll_s)
+        self._now = time_fn
+        self.stop = False
+        self.stats = {"accepted": 0, "rejected": 0, "completed": 0,
+                      "bad_lines": 0, "conns": 0, "client_gone": 0,
+                      "ticks": 0}
+        self._conns: Dict[int, _Conn] = {}       # fd -> conn
+        self._owner: Dict[Any, _Conn] = {}       # req_id -> conn
+        self.sel = selectors.DefaultSelector()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(64)
+        self._lsock.setblocking(False)
+        self.sel.register(self._lsock, selectors.EVENT_READ, None)
+        self.addr = self._lsock.getsockname()
+        journal.active().event("serve_listening", host=self.addr[0],
+                               port=int(self.addr[1]))
+
+    # -------------------------------------------------------------- pressure
+    def _queue_depth(self) -> int:
+        q = getattr(self.target, "queue", None)       # fleet admission queue
+        if q is None:
+            q = getattr(self.target, "pending", ())   # engine pending deque
+        return len(q)
+
+    def _tight(self) -> bool:
+        if self._queue_depth() >= self.max_queue_depth:
+            return True
+        tables = getattr(self.target, "tables", None)
+        if tables is not None and self.min_free_blocks > 0:
+            return tables.free_blocks < self.min_free_blocks
+        return False
+
+    # ------------------------------------------------------------------- I/O
+    def _accept(self) -> None:
+        while True:
+            try:
+                sock, peer = self._lsock.accept()
+            except BlockingIOError:
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock, f"{peer[0]}:{peer[1]}")
+            self._conns[sock.fileno()] = conn
+            self.sel.register(sock, selectors.EVENT_READ, conn)
+            self.stats["conns"] += 1
+
+    def _drop(self, conn: _Conn, *, gone: bool = False) -> None:
+        """Close one connection. In-flight requests KEEP running — their
+        tokens are simply no longer streamed anywhere (the journal gets
+        a loud ``client_gone`` so dropped streams are visible)."""
+        if gone and conn.reqs:
+            self.stats["client_gone"] += 1
+            journal.active().event("client_gone", peer=conn.peer,
+                                   inflight=len(conn.reqs))
+        for rid in conn.reqs:
+            self._owner.pop(rid, None)
+        conn.reqs.clear()
+        try:
+            self.sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        self._conns.pop(conn.sock.fileno(), None)
+        conn.sock.close()
+
+    def _send(self, conn: _Conn, frame: dict) -> None:
+        conn.wbuf += encode_frame(frame)
+
+    def _flush(self, conn: _Conn) -> None:
+        while conn.wbuf:
+            try:
+                n = conn.sock.send(conn.wbuf)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._drop(conn, gone=True)
+                return
+            if n <= 0:
+                return
+            del conn.wbuf[:n]
+
+    def _handle_line(self, conn: _Conn, line: bytes) -> None:
+        conn.seq += 1
+        where = f"client {conn.peer}:{conn.seq}"
+        try:
+            d = json.loads(line)
+            if not isinstance(d, dict):
+                raise ValueError(f"{where}: request must be a JSON object")
+        except ValueError as e:
+            self.stats["bad_lines"] += 1
+            self._send(conn, {"event": "error", "error": str(e)})
+            return
+        if self._tight():
+            # honest backpressure: an explicit machine-readable reject
+            # the client can back off on — never unbounded buffering
+            self.stats["rejected"] += 1
+            self._send(conn, {"id": d.get("id"), "event": "reject",
+                              "retry_after_s": self.retry_after_s})
+            return
+        try:
+            req, _ = serve_api.parse_request_obj(d, where, self.tokenizer)
+        except (ValueError, TypeError) as e:
+            self.stats["bad_lines"] += 1
+            self._send(conn, {"id": d.get("id"), "event": "error",
+                              "error": str(e)})
+            return
+        if req.req_id in self._owner:
+            self._send(conn, {"id": req.req_id, "event": "error",
+                              "error": f"{where}: duplicate in-flight "
+                                       f"request id {req.req_id!r}"})
+            return
+        self.target.submit(req)
+        conn.reqs.add(req.req_id)
+        conn.sent[req.req_id] = 0
+        self._owner[req.req_id] = conn
+        self.stats["accepted"] += 1
+        self._send(conn, {"id": req.req_id, "event": "accepted"})
+
+    def poll_io(self, timeout: float) -> None:
+        """One poll pass with an explicit timeout (the DLT012 seam):
+        accept ready connections, read ready sockets, dispatch complete
+        request lines, flush pending output."""
+        for key, _ in self.sel.select(timeout):
+            if key.data is None:
+                self._accept()
+                continue
+            conn: _Conn = key.data
+            try:
+                chunk = conn.sock.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                self._drop(conn, gone=True)
+                continue
+            if not chunk:
+                self._drop(conn, gone=bool(conn.reqs))
+                continue
+            conn.rbuf += chunk
+            while True:
+                nl = conn.rbuf.find(b"\n")
+                if nl < 0:
+                    break
+                line = bytes(conn.rbuf[:nl]).strip()
+                del conn.rbuf[:nl + 1]
+                if line:
+                    self._handle_line(conn, line)
+        for conn in list(self._conns.values()):
+            self._flush(conn)
+
+    # ------------------------------------------------------------- streaming
+    def _stream_progress(self) -> None:
+        """Diff the recovery-shadow committed lists against what each
+        connection has already been sent — pure host list slicing on
+        records the tick loop exports anyway (zero new device syncs)."""
+        for rec in self.target.export_records():
+            conn = self._owner.get(rec.req_id)
+            if conn is None:
+                continue
+            n = len(rec.committed)
+            prev = conn.sent.get(rec.req_id, 0)
+            if n > prev:
+                self._send(conn, {"id": rec.req_id, "event": "tokens",
+                                  "tokens": [int(t) for t in
+                                             rec.committed[prev:]],
+                                  "n": n})
+                conn.sent[rec.req_id] = n
+
+    def _finish(self, completions) -> None:
+        for c in completions:
+            self.stats["completed"] += 1
+            conn = self._owner.pop(c.req_id, None)
+            if conn is None:
+                continue
+            prev = conn.sent.pop(c.req_id, 0)
+            conn.reqs.discard(c.req_id)
+            if len(c.tokens) > prev:
+                self._send(conn, {"id": c.req_id, "event": "tokens",
+                                  "tokens": [int(t) for t in
+                                             c.tokens[prev:]],
+                                  "n": len(c.tokens)})
+            rec = serve_api.completion_record(c, self.tokenizer)
+            rec["event"] = "done"
+            self._send(conn, rec)
+
+    # ------------------------------------------------------------ the driver
+    def serve_tick(self) -> int:
+        """One interleaved unit: poll sockets, tick the engine if it has
+        work, stream the tick's progress. Returns completions count."""
+        self.poll_io(0.0 if self.target.has_work() else self.idle_poll_s)
+        if not self.target.has_work():
+            return 0
+        completions = self.target.step()
+        self.stats["ticks"] += 1
+        self._stream_progress()
+        self._finish(completions)
+        for conn in list(self._conns.values()):
+            self._flush(conn)
+        return len(completions)
+
+    def run(self, stop_when: Optional[Callable[[], bool]] = None,
+            max_wall_s: Optional[float] = None) -> None:
+        """Serve until ``self.stop`` is set, ``stop_when()`` returns
+        True, or ``max_wall_s`` elapses (a hard deadline so a test or a
+        soak can never hang the host loop forever)."""
+        t_end = (self._now() + float(max_wall_s)
+                 if max_wall_s is not None else None)
+        while not self.stop:
+            self.serve_tick()
+            if stop_when is not None and stop_when():
+                return
+            if t_end is not None and self._now() >= t_end:
+                return
+
+    def close(self) -> None:
+        for conn in list(self._conns.values()):
+            self._drop(conn)
+        try:
+            self.sel.unregister(self._lsock)
+        except (KeyError, ValueError):
+            pass
+        self._lsock.close()
+        self.sel.close()
+
+
+# --------------------------------------------------------------------- client
+class ServeClient:
+    """Small reference client: one request per call, streaming frames
+    collected into the final response record, explicit-reject retry with
+    exponential backoff on top of the server's ``retry_after_s`` hint.
+    Every socket op runs under ``settimeout(timeout_s)`` — the client
+    honors the same no-indefinite-block discipline as the server."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 max_retries: int = 8, backoff_base_s: float = 0.02,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        self.addr = (host, int(port))
+        self.timeout_s = float(timeout_s)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self._sleep = sleep_fn
+        self.retries = 0
+        self.rejects = 0
+
+    @staticmethod
+    def _read_frames(sock: socket.socket):
+        """Yield frames until a terminal one arrives. Reads ride the
+        socket's ``settimeout`` deadline — a dead server raises
+        ``socket.timeout`` instead of hanging the caller forever."""
+        f = sock.makefile("rb")
+        try:
+            while True:
+                line = f.readline()          # bounded by sock's settimeout
+                if not line:
+                    raise ConnectionError("server closed the connection")
+                frame = json.loads(line)
+                yield frame
+                if frame.get("event") in ("done", "reject", "error"):
+                    return
+        finally:
+            f.close()
+
+    def request(self, req: dict, on_tokens=None) -> dict:
+        """Send one request dict (serve/api schema); returns the final
+        response record. ``on_tokens(list)`` observes each streaming
+        frame's delta. Raises RuntimeError after the retry budget."""
+        payload = encode_request(req)
+        last = None
+        for attempt in range(self.max_retries + 1):
+            sock = socket.create_connection(self.addr,
+                                            timeout=self.timeout_s)
+            sock.settimeout(self.timeout_s)
+            try:
+                sock.sendall(payload)
+                tokens: List[int] = []
+                for frame in self._read_frames(sock):
+                    ev = frame.get("event")
+                    if ev == "tokens":
+                        tokens.extend(int(t) for t in frame["tokens"])
+                        if on_tokens is not None:
+                            on_tokens(frame["tokens"])
+                    elif ev == "done":
+                        return frame
+                    elif ev == "reject":
+                        self.rejects += 1
+                        last = frame
+                        break
+                    elif ev == "error":
+                        raise RuntimeError(
+                            f"server refused request: {frame.get('error')}")
+            finally:
+                sock.close()
+            # rejected: back off (server hint, then exponential) and retry
+            self.retries += 1
+            hint = float(last.get("retry_after_s", 0.0)) if last else 0.0
+            self._sleep(max(hint, self.backoff_base_s * (2 ** attempt)))
+        raise RuntimeError(
+            f"request {req.get('id')!r} rejected {self.rejects} times — "
+            f"retry budget ({self.max_retries}) exhausted")
+
+
+def drive_open_loop(host: str, port: int, records: List[dict],
+                    tick_s: float = 0.0, timeout_s: float = 60.0,
+                    max_wall_s: float = 600.0,
+                    retry_backoff_s: float = 0.02,
+                    time_fn: Callable[[], float] = time.monotonic
+                    ) -> Dict[str, Any]:
+    """Open-loop socket driver over ONE multiplexed connection: each
+    request record is sent at ``arrival_tick * tick_s`` after start
+    (open loop: the schedule never waits for responses), frames are
+    demultiplexed by id, rejects re-arm with backoff. Returns
+    ``{"responses": {id: record}, "rejects": n, "retries": n,
+    "wall_s": s}``. The FIRST-attempt payload byte sequence is a pure
+    function of ``records`` (:func:`encode_request`), which is what
+    ``workload_gen --stream`` pins as byte-identical across reruns."""
+    payloads = {r.get("id"): encode_request(r) for r in records}
+    sends = deque(
+        (float(r.get("arrival_tick", 0)) * tick_s, payloads[r.get("id")],
+         r.get("id"), 0) for r in records)
+    attempts: Dict[Any, int] = {}
+    want = {r.get("id") for r in records}
+    responses: Dict[Any, dict] = {}
+    rejects = retries = 0
+    sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+    sock.setblocking(False)
+    sel = selectors.DefaultSelector()
+    sel.register(sock, selectors.EVENT_READ, None)
+    rbuf = bytearray()
+    t0 = time_fn()
+    deadline = t0 + float(max_wall_s)
+    try:
+        while len(responses) < len(want):
+            now = time_fn()
+            if now >= deadline:
+                raise TimeoutError(
+                    f"open-loop drive incomplete after {max_wall_s}s: "
+                    f"{len(responses)}/{len(want)} responses")
+            # paced sends whose time has come (open loop: send-time is
+            # schedule-driven, never response-driven). Retries re-enter
+            # the deque out of order, so scan rather than assume sorted.
+            keep: deque = deque()
+            while sends:
+                due, payload, rid, attempt = sends.popleft()
+                if due > now - t0:
+                    keep.append((due, payload, rid, attempt))
+                    continue
+                try:
+                    sock.sendall(payload)
+                except BlockingIOError:
+                    keep.append((due, payload, rid, attempt))
+            sends = keep
+            next_due = min((d for d, _, _, _ in sends),
+                           default=now - t0 + 0.05) - (now - t0)
+            for _key, _ev in sel.select(max(min(next_due, 0.05), 0.0)):
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("server closed mid-drive")
+                rbuf += chunk
+            while True:
+                nl = rbuf.find(b"\n")
+                if nl < 0:
+                    break
+                frame = json.loads(bytes(rbuf[:nl]))
+                del rbuf[:nl + 1]
+                ev, rid = frame.get("event"), frame.get("id")
+                if ev == "done":
+                    responses[rid] = frame
+                elif ev == "reject":
+                    rejects += 1
+                    retries += 1
+                    # re-arm with the server's hint + exponential backoff
+                    att = attempts[rid] = attempts.get(rid, 0) + 1
+                    if rid not in payloads or att > 10:
+                        raise RuntimeError(
+                            f"request {rid!r} cannot be retried "
+                            f"(attempt {att})")
+                    delay = max(float(frame.get("retry_after_s", 0.0)),
+                                retry_backoff_s * (2 ** att))
+                    sends.append((time_fn() - t0 + delay, payloads[rid],
+                                  rid, att))
+                elif ev == "error":
+                    raise RuntimeError(
+                        f"server refused {rid!r}: {frame.get('error')}")
+    finally:
+        sel.close()
+        sock.close()
+    return {"responses": responses, "rejects": int(rejects),
+            "retries": int(retries), "wall_s": float(time_fn() - t0)}

@@ -42,6 +42,12 @@ the same ``resilience.consume_due`` helper the membership schedule uses):
   probation: new work prefers healthy replicas until the probe window
   elapses (the rejoiner still admits when it is the only survivor — a
   probation that strands the queue would be worse than none).
+- ``replica_kill:<r>:<tick>`` — process replicas only
+  (serve/fleet_proc): a control frame arms a REAL ``SIGKILL`` in r's
+  child, delivered AFTER the engine steps (tokens truly sampled, the
+  reply never sent — the hardest cut). The fleet sees pipe EOF
+  (``ReplicaGone``) and runs the same crash path: declared dead, shadow
+  migration, zero accepted-token loss.
 
 Routing honors the serve/api ``prefix_group`` affinity tag: requests of
 one group land on one replica (so its prefix cache actually accumulates
@@ -57,7 +63,16 @@ renders them as the replica timeline beside the PR-10 membership
 timeline): ``replica_left`` / ``replica_rejoined`` / ``replica_draining``
 / ``replica_slow`` (cause, tick, resident counts, alive/world) and
 ``request_migrated`` / ``request_failed`` (req_id, from/to replica,
-committed count, attempt, cause, tick).
+committed count, attempt, cause, tick). Process replicas add the
+heartbeat trail: tick replies ARE the heartbeats, so a reply slower
+than the worker's ``heartbeat_timeout_s`` journals
+``replica_heartbeat_missed`` (replica, misses, max_misses, tick) — the
+outstanding tick stays armed — and ``heartbeat_max_misses`` consecutive
+strikes journal ``replica_declared_dead`` (cause ``heartbeat_lost``,
+misses) before the ordinary ``replica_left``; pipe EOF or a corrupt
+frame declares immediately with cause ``process_died``. Fleet-restart
+persistence (``state_dir``/``persist_every``, serve/fleet_state) adds
+``fleet_state_saved`` / ``fleet_state_restored`` / ``fleet_state_corrupt``.
 
 Layering: host-side list/dict math only — engines do all device work;
 this module must stay free of jax imports at module scope (the fleet is
@@ -77,6 +92,7 @@ from distributed_lion_tpu.serve.engine import (
     Request,
     ServingEngine,
 )
+from distributed_lion_tpu.serve.fleet_proc import HeartbeatMiss, ReplicaGone
 from distributed_lion_tpu.serve.metrics import (
     RequestTimes, ServeMetrics, TickLatencyWindow)
 from distributed_lion_tpu.train import journal, resilience
@@ -95,6 +111,9 @@ class _Replica:
     assigned: set = dataclasses.field(default_factory=set)
     tick_ms: deque = dataclasses.field(
         default_factory=lambda: deque(maxlen=16))
+    hb_misses: int = 0               # consecutive missed heartbeats
+    #                                  (process replicas only; reset on
+    #                                  every on-time tick reply)
 
 
 @dataclasses.dataclass
@@ -127,6 +146,9 @@ class ServingFleet:
                  backoff_ticks: int = 1, slow_factor: float = 4.0,
                  slow_min_ticks: int = 4, rejoin_probe_ticks: int = 2,
                  record_latency: bool = False,
+                 heartbeat_max_misses: int = 3,
+                 state_dir: Optional[str] = None,
+                 persist_every: int = 0,
                  time_fn: Callable[[], float] = time.monotonic):
         if replicas < 1:
             raise ValueError(f"need >= 1 replica, got {replicas}")
@@ -169,10 +191,23 @@ class ServingFleet:
         # only ever subtracts, so any monotonic source is exact)
         self._now = time_fn
         self.metrics_drain_every = 64
+        # process-replica liveness policy: a replica (fleet_proc.
+        # ProcessReplica) whose tick reply misses its heartbeat deadline
+        # this many CONSECUTIVE times is declared dead, SIGKILLed, and
+        # its requests migrate from the shadow (in-process engines never
+        # miss — their step() is a plain call)
+        self.heartbeat_max_misses = int(heartbeat_max_misses)
+        # fleet-restart persistence (serve/fleet_state): every
+        # ``persist_every`` ticks the recovery shadow + prefix chains
+        # land in ``state_dir`` under a sha256 manifest; 0 = only at
+        # explicit save_state() calls (e.g. drain)
+        self.state_dir = state_dir
+        self.persist_every = int(persist_every)
         self.stats = {"ticks": 0, "migrations": 0, "failed": 0,
                       "timeouts": 0, "replica_crashes": 0,
                       "replica_drains": 0, "replica_rejoins": 0,
-                      "slow_detected": 0}
+                      "slow_detected": 0, "heartbeat_misses": 0,
+                      "replicas_declared_dead": 0, "state_saves": 0}
 
     # ------------------------------------------------------------- state
     def alive(self) -> int:
@@ -194,11 +229,15 @@ class ServingFleet:
             for r in self.replicas)
 
     # ------------------------------------------------------------ intake
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request,
+               deadline_at: Optional[float] = None) -> None:
         """Queue a request; the wall-clock deadline (if any) stamps NOW —
-        migrations inherit the stamp, they never reset it."""
-        deadline_at = (self._now() + float(req.deadline_s)
-                       if req.deadline_s is not None else None)
+        migrations inherit the stamp, they never reset it. An explicit
+        ``deadline_at`` overrides the fresh stamp (the engine's own
+        submit contract — the fleet-restart path re-stamps persisted
+        REMAINING budgets against the new process's clock)."""
+        if deadline_at is None and req.deadline_s is not None:
+            deadline_at = self._now() + float(req.deadline_s)
         self.times.submitted(req.req_id, self.tick_no)
         self.queue.append(_QueueItem(req=req, not_before=self.tick_no,
                                      deadline_at=deadline_at))
@@ -246,7 +285,13 @@ class ServingFleet:
         if rep.engine is None:
             return  # already gone; a second signal is not a transition
         residents = sorted(rep.assigned, key=str)
+        engine = rep.engine
         rep.engine = None          # the engine (and its device state) dies
+        closer = getattr(engine, "close", None)
+        if closer is not None:
+            # a process replica leaves a real OS process behind — SIGKILL
+            # it so a "crashed" child can never keep decoding as a zombie
+            closer(kill=True)
         rep.state = "departed"
         rep.slow = False
         rep.tick_ms.clear()
@@ -258,6 +303,17 @@ class ServingFleet:
             self._orphan(rid, r, tick, cause, completions,
                          count_attempt=True)
         rep.assigned = set()
+
+    def _declare_dead(self, r: int, tick: int, cause: str,
+                      completions: List[Completion]) -> None:
+        """The heartbeat verdict: journal ``replica_declared_dead``, then
+        take the ordinary crash path — handle close (SIGKILL the child if
+        it still breathes) + shadow migration. One journal event pair per
+        incident: N ``replica_heartbeat_missed`` strikes, one verdict."""
+        self.stats["replicas_declared_dead"] += 1
+        self._event("replica_declared_dead", replica=r, tick=tick,
+                    cause=cause, misses=self.replicas[r].hb_misses)
+        self._crash(r, tick, cause, completions)
 
     def _drain(self, r: int, tick: int,
                completions: List[Completion]) -> None:
@@ -292,6 +348,7 @@ class ServingFleet:
         rep.state = "rejoining"
         rep.slow = False
         rep.slow_ms = 0
+        rep.hb_misses = 0
         rep.rejoined_at = tick
         rep.tick_ms.clear()
         self.stats["replica_rejoins"] += 1
@@ -309,6 +366,16 @@ class ServingFleet:
             r = int(r)
             if kind == "replica_crash":
                 self._crash(r, tick, "injected_crash", completions)
+            elif kind == "replica_kill":
+                # a REAL process death: arm SIGKILL inside the child's
+                # next tick (mid-decode — work happens, the reply never
+                # arrives); on an in-process engine, degrade to the
+                # simulated crash the old path provided
+                arm = getattr(self.replicas[r].engine, "arm_kill", None)
+                if arm is not None:
+                    arm()
+                else:
+                    self._crash(r, tick, "injected_kill", completions)
             elif kind == "replica_drain":
                 self._drain(r, tick, completions)
             elif kind == "slow_tick":
@@ -435,7 +502,28 @@ class ServingFleet:
             t0 = self._now()
             if rep.slow_ms:
                 time.sleep(rep.slow_ms / 1e3)   # the injected straggler
-            for c in rep.engine.step():
+            try:
+                stepped = rep.engine.step()
+            except HeartbeatMiss:
+                # the tick reply is late, not necessarily dead: the tick
+                # stays outstanding in the handle (a late reply is
+                # consumed next round), the fleet counts the strike
+                rep.hb_misses += 1
+                self.stats["heartbeat_misses"] += 1
+                self._event("replica_heartbeat_missed", replica=i,
+                            tick=tick, misses=rep.hb_misses,
+                            max_misses=self.heartbeat_max_misses)
+                if rep.hb_misses >= self.heartbeat_max_misses:
+                    self._declare_dead(i, tick, "heartbeat_lost",
+                                       completions)
+                continue
+            except ReplicaGone:
+                # EOF / corrupt stream: the process is unrecoverable —
+                # no strike budget, straight to dead
+                self._declare_dead(i, tick, "process_died", completions)
+                continue
+            rep.hb_misses = 0
+            for c in stepped:
                 rid = c.req_id
                 rep.assigned.discard(rid)
                 self._records.pop(rid, None)
@@ -480,8 +568,73 @@ class ServingFleet:
             # run_analyze --serve read the numbers the bench banks
             self._event("fleet_stats", tick=tick,
                         queue_depth=len(self.queue), **self.stats)
+        if self.state_dir and self.persist_every \
+                and self.stats["ticks"] % self.persist_every == 0:
+            self.save_state()
         self.tick_no += 1
         return completions
+
+    # ---------------------------------------------------- restart surface
+    def export_records(self) -> List[RecoveryRecord]:
+        """Every unfinished request the fleet knows about: the recovery
+        shadow (routed requests, refreshed each tick) plus queue items
+        not yet routed — the same surface ``ServingEngine.export_records``
+        gives, so the socket server streams through either target and the
+        persistence plane snapshots the WHOLE in-flight set."""
+        recs = dict(self._records)
+        for item in self.queue:
+            if item.req.req_id not in recs:
+                recs[item.req.req_id] = RecoveryRecord.from_request(
+                    item.req, item.req.committed, item.req.max_new_tokens,
+                    item.deadline_at)
+        return list(recs.values())
+
+    def export_chains(self) -> List[List[int]]:
+        """The union of every live replica's prefix-cache chains (maximal
+        cached token prefixes), deduped — what fleet-restart persistence
+        banks so a new fleet warm-starts its page pools instead of cold
+        prefilling the shared system prompts."""
+        seen = set()
+        for rep in self.replicas:
+            if rep.engine is None:
+                continue
+            export = getattr(rep.engine, "export_prefix_chains", None) \
+                or getattr(rep.engine, "export_chains", None)
+            if export is None:
+                continue
+            for chain in export():
+                if chain:
+                    seen.add(tuple(int(t) for t in chain))
+        return [list(k) for k in sorted(seen, key=lambda k: (len(k), k))]
+
+    def save_state(self) -> Optional[str]:
+        """Persist the recovery shadow + prefix chains to ``state_dir``
+        (atomic tmp+rename under a sha256 manifest — serve/fleet_state).
+        Returns the written state file path, or None when persistence is
+        not configured. Called on the ``persist_every`` cadence and by
+        the drain path; safe to call at any tick boundary."""
+        if not self.state_dir:
+            return None
+        from distributed_lion_tpu.serve import fleet_state
+
+        path = fleet_state.save_fleet_state(
+            self.state_dir, self.export_records(), self.export_chains(),
+            tick=self.tick_no, now=self._now())
+        self.stats["state_saves"] += 1
+        return path
+
+    def close(self) -> None:
+        """Tear down every live replica handle (process replicas get a
+        clean exit request, then the SIGKILL backstop). In-process
+        engines have nothing to release — getattr-guarded, same as the
+        crash path."""
+        for rep in self.replicas:
+            engine, rep.engine = rep.engine, None
+            if engine is not None:
+                closer = getattr(engine, "close", None)
+                if closer is not None:
+                    closer(kill=False)
+            rep.state = "departed"
 
     def metrics_snapshot(self) -> Optional[Dict[str, Any]]:
         """Fleet-level metrics aggregate: fold every LIVE replica's

@@ -181,8 +181,8 @@ def parse_membership_specs(specs: str) -> list:
             for s in specs.split(",") if s.strip()]
 
 
-SERVE_FAULT_KINDS = ("replica_crash", "replica_drain", "slow_tick",
-                     "replica_rejoin")
+SERVE_FAULT_KINDS = ("replica_crash", "replica_kill", "replica_drain",
+                     "slow_tick", "replica_rejoin")
 
 
 def parse_serve_fault(spec: str) -> tuple[str, int, int, int]:
@@ -193,6 +193,11 @@ def parse_serve_fault(spec: str) -> tuple[str, int, int, int]:
 
     - ``replica_crash:<r>:<tick>`` — replica r dies at that fleet tick
       (engine discarded; residents migrate from the recovery shadow)
+    - ``replica_kill:<r>:<tick>`` — the PROCESS-death twin: on a
+      process-isolated replica (serve/fleet_proc) a real SIGKILL is
+      armed inside the child's next tick (mid-decode — the decode
+      dispatch runs, the reply never arrives); on an in-process engine
+      it degrades to the simulated crash above
     - ``replica_drain:<r>[:<tick>]`` — r stops admitting at tick (default
       0), finishes its residents, then departs
     - ``slow_tick:<r>:<ms>`` — every tick of replica r pays <ms> extra
@@ -209,8 +214,8 @@ def parse_serve_fault(spec: str) -> tuple[str, int, int, int]:
         raise ValueError(
             f"bad serve fault spec {spec!r}: expected '<kind>:<replica>"
             f"[:<tick|ms>]' with kind in {SERVE_FAULT_KINDS}")
-    if parts[0] in ("replica_crash", "slow_tick", "replica_rejoin") \
-            and len(parts) != 3:
+    if parts[0] in ("replica_crash", "replica_kill", "slow_tick",
+                    "replica_rejoin") and len(parts) != 3:
         raise ValueError(
             f"bad serve fault spec {spec!r}: {parts[0]} requires an "
             f"explicit third field ('{parts[0]}:<replica>:"

@@ -56,6 +56,16 @@ ROADMAP item 4):
   ep>=2 and ep×tp token-identical. Judged by check_evidence's
   ``moe_serving`` stage (runbook stage 5m). The ep>=2 rows/markers need
   enough devices — on CPU run under ``DLION_PLATFORM=cpu8``.
+- **fleet_resilience section** (ISSUE 20) — the process-isolated fleet's
+  fault matrix over real OS processes and a live socket: the
+  SIGKILL-at-tick rows (a replica CHILD PROCESS killed mid-decode under
+  ``serve/net.drive_open_loop`` traffic — zero accepted-token loss,
+  token-identical migrated responses, greedy and sampled), the
+  full-stop restart leg (``serve/fleet_state`` shadow + chain index →
+  fresh fleet, token-identical with prefill tokens saved by the
+  warm-started pool), and the seeded workload soak through the socket
+  front with its ``stream_sha256`` byte-determinism pin. Judged by
+  check_evidence's ``fleet_resilience`` stage (runbook stage 5o).
 - **slo section** (ISSUE 17) — the seeded scripts/workload_gen.py soak
   through the serve/metrics.py plane: TTFT and per-token decode latency
   p50/p95/p99 read from the LogHistogram sketches, goodput (in-SLO
@@ -955,6 +965,237 @@ def bench_serve_resilience(model_name: str, family: str, quant: str,
             "drain": drain, "slow": slow, "rejoin": rejoin}
 
 
+def bench_fleet_resilience(block_size: int) -> dict:
+    """The ISSUE 20 evidence: the process-isolated serving fleet's fault
+    matrix, measured over real OS processes and a live socket.
+
+    - **kill matrix** — a replica CHILD PROCESS is SIGKILLed for real at
+      tick 1 / 3 / 6 (plus a sampled cut at tick 3) while
+      ``serve/net.drive_open_loop`` streams the workload over a live
+      socket connection; every response must come back token-identical
+      to the never-killed single-engine run with zero accepted tokens
+      lost, the cut registering as a process death (EOF on the pipe →
+      ``replicas_declared_dead``), not a polite in-process exception.
+    - **restart leg** — a fleet with a ``state_dir`` is stopped
+      mid-decode (the persisted recovery shadow + prefix-chain index are
+      all that survive) and a FRESH fleet resumes from disk:
+      token-identical completions, with the warm-started page pool
+      saving real prefill work (``shared_tokens`` > 0).
+    - **socket soak** — a seeded workload_gen stream (imported by file
+      path like the slo section) driven open-loop at a process fleet
+      behind the socket front; banked with goodput and the
+      byte-determinism ``stream_sha256`` pin (the digest every rerun of
+      the same generator seed must reproduce).
+
+    A CPU-produced artifact is first-class here for the same reason as
+    the elasticity stage: process spawn, SIGKILL, pipe-EOF detection and
+    the persistence manifest are host-plane mechanics on every backend.
+    The section pins the tiny gpt2 model regardless of ``--model`` — the
+    ``gpt2_tiny`` worker builder reconstructs those weights from the
+    init seed alone, so parent baseline and child engines provably share
+    weights with no checkpoint file in the loop."""
+    import importlib.util
+    import shutil
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from distributed_lion_tpu.serve import fleet_proc, fleet_state, net
+    from distributed_lion_tpu.serve.engine import (
+        Request,
+        ServeConfig,
+        ServingEngine,
+    )
+    from distributed_lion_tpu.serve.replica_plane import ServingFleet
+    from distributed_lion_tpu.train import resilience
+
+    model, _, cfg = _serve_model("tiny", "gpt2")
+    gen = 10
+    n_req = 8
+    # worst prompt across the legs: 6-token shared prefix + 10-token
+    # tail (kill matrix), or prefix_len+prompt_max = 22 (soak)
+    serve_kw = dict(max_seqs=4, block_size=block_size,
+                    max_blocks_per_seq=-(-(22 + 12 + 2) // block_size),
+                    prefix_cache=True)
+    builder = {"kind": "gpt2_tiny", "init_seed": 0, "serve": serve_kw}
+
+    rng = np.random.default_rng(17)
+    shared = [int(t) for t in rng.integers(1, cfg.vocab_size, 6)]
+    wire = []
+    for i in range(n_req):
+        tail = [int(t) for t in rng.integers(1, cfg.vocab_size, 3 + i)]
+        d = {"id": f"k{i}", "max_new_tokens": gen, "seed": i}
+        if i % 2 == 0:
+            d.update(tokens=shared + tail, prefix_group="sys")
+        else:
+            d["tokens"] = tail
+        wire.append(d)
+
+    def as_reqs():
+        return [Request(req_id=d["id"], tokens=list(d["tokens"]),
+                        max_new_tokens=d["max_new_tokens"], seed=d["seed"],
+                        prefix_group=d.get("prefix_group"))
+                for d in wire]
+
+    def offline(**samp):
+        eng = ServingEngine(model, ServeConfig(**{**serve_kw, **samp}))
+        return eng.run(as_reqs())
+
+    def kill_run(kill_tick, **samp):
+        resilience.inject_fault("serve", resilience.parse_serve_specs(
+            f"replica_kill:0:{kill_tick}"))
+        fleet = ServingFleet(
+            fleet_proc.process_replica_factory(
+                {**builder, "serve": {**serve_kw, **samp}}),
+            replicas=2)
+        reps = [rep.engine for rep in fleet.replicas]
+        pids = [r.pid for r in reps]
+        srv = net.ServeServer(fleet, port=0)
+        th = threading.Thread(target=srv.run,
+                              kwargs={"max_wall_s": 300.0}, daemon=True)
+        th.start()
+        try:
+            out = net.drive_open_loop(*srv.addr, records=wire,
+                                      tick_s=0.0, max_wall_s=240.0)
+        finally:
+            srv.stop = True
+            th.join(timeout=30)
+            srv.close()
+            fleet.close()
+            resilience.inject_fault("serve", [])
+        reaped = all(r.proc.poll() is not None for r in reps)
+        isolated = (len(set(pids)) == 2 and os.getpid() not in pids
+                    and all(p > 0 for p in pids) and reaped)
+        return fleet, out, isolated
+
+    # ---- SIGKILL matrix under live socket traffic
+    kill_matrix = []
+    for kill_tick, sampling in ((1, "greedy"), (3, "greedy"),
+                                (6, "greedy"), (3, "stochastic")):
+        samp = (dict(temperature=0.0) if sampling == "greedy"
+                else dict(temperature=0.9, top_k=40))
+        base = offline(**samp)
+        fleet, out, isolated = kill_run(kill_tick, **samp)
+        lost = sum(max(len(base[d["id"]].tokens)
+                       - len(out["responses"][d["id"]]["tokens"]), 0)
+                   for d in wire if d["id"] in out["responses"])
+        row = {
+            "kill_tick": kill_tick,
+            "sampling": sampling,
+            "migrated": int(fleet.stats["migrations"]),
+            "declared_dead": int(fleet.stats["replicas_declared_dead"]),
+            "tokens_lost": int(lost),
+            "completed": int(len(out["responses"])),
+            "identical": bool(
+                len(out["responses"]) == n_req
+                and all(out["responses"][d["id"]]["tokens"]
+                        == base[d["id"]].tokens for d in wire)),
+            "process_isolated": bool(isolated),
+        }
+        kill_matrix.append(row)
+        print(json.dumps({"fleet_resilience": "kill", **row},
+                         allow_nan=False), flush=True)
+
+    # ---- full-stop restart from the persisted shadow + chain index
+    base = offline()
+    sdir = tempfile.mkdtemp(prefix="bench_fleet_state_")
+    try:
+        def factory():
+            return ServingEngine(model, ServeConfig(**serve_kw))
+
+        fleet_a = ServingFleet(factory, replicas=2, state_dir=sdir)
+        done = {}
+        for r in as_reqs():
+            fleet_a.submit(r)
+        for _ in range(4):              # mid-decode, nothing finished
+            for c in fleet_a.step():
+                done[c.req_id] = c
+        fleet_a.save_state()
+        inflight = len(fleet_a.export_records())
+        # fleet_a is now abandoned — a kill -9 of the parent process
+        fleet_b = ServingFleet(factory, replicas=2)
+        state = fleet_state.load_fleet_state(sdir, now=time.monotonic())
+        res = fleet_state.resume_into(fleet_b, state)
+        while fleet_b.has_work():
+            for c in fleet_b.step():
+                done[c.req_id] = c
+        saved = sum(rep.engine.stats["shared_tokens"]
+                    for rep in fleet_b.replicas
+                    if rep.engine is not None)
+    finally:
+        shutil.rmtree(sdir, ignore_errors=True)
+    restart = {
+        "inflight_at_stop": int(inflight),
+        "restored": int(res["restored"]),
+        "chains_primed": int(res["chains_primed"]),
+        "resumed_from_tick": int(state["tick"]),
+        "prefill_tokens_saved": int(saved),
+        "identical": bool(all(
+            done[d["id"]].tokens == base[d["id"]].tokens
+            and done[d["id"]].reason == base[d["id"]].reason
+            for d in wire)),
+    }
+    print(json.dumps({"fleet_resilience": "restart", **restart},
+                     allow_nan=False), flush=True)
+
+    # ---- seeded workload soak through the socket front
+    spec = importlib.util.spec_from_file_location(
+        "workload_gen_fleet", os.path.join(REPO, "scripts",
+                                           "workload_gen.py"))
+    wg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wg)
+    records = wg.generate(requests=24, seed=3, vocab=cfg.vocab_size,
+                          prompt_max=16, out_max=12, prefix_len=6,
+                          deadline_frac=0.0)
+    fleet = ServingFleet(fleet_proc.process_replica_factory(builder),
+                         replicas=2)
+    srv = net.ServeServer(fleet, port=0)
+    th = threading.Thread(target=srv.run, kwargs={"max_wall_s": 300.0},
+                          daemon=True)
+    th.start()
+    try:
+        summary = wg.stream(records, "%s:%d" % srv.addr, tick_s=0.0,
+                            max_wall_s=300.0)
+    finally:
+        srv.stop = True
+        th.join(timeout=30)
+        srv.close()
+        fleet.close()
+    soak = {
+        "requests": int(len(records)),
+        "completed": int(summary["completed"]),
+        "rejects": int(summary["rejects"]),
+        "retries": int(summary["retries"]),
+        "wall_s": float(summary["wall_s"]),
+        "tokens_out": int(summary["tokens_out"]),
+        "goodput_tokens_per_s": round(
+            summary["tokens_out"] / max(summary["wall_s"], 1e-9), 3),
+        "stream_sha256": str(summary["stream_sha256"]),
+    }
+    print(json.dumps({"fleet_resilience": "soak", **soak},
+                     allow_nan=False), flush=True)
+
+    markers = {
+        "sigkill_identity": all(r["identical"] for r in kill_matrix),
+        "sigkill_zero_token_loss": all(r["tokens_lost"] == 0
+                                       for r in kill_matrix),
+        "process_isolated": all(r["process_isolated"]
+                                and r["declared_dead"] == 1
+                                for r in kill_matrix),
+        "restart_identity": restart["identical"],
+        "restart_prefill_saved": restart["prefill_tokens_saved"] > 0,
+        "socket_soak_served": soak["completed"] == soak["requests"] > 0,
+    }
+    markers = {k: bool(v) for k, v in markers.items()}
+    return {"markers": markers,
+            "meta": {"model": "tiny", "replicas": 2,
+                     "builder": "gpt2_tiny"},
+            "kill_matrix": kill_matrix, "restart": restart,
+            "socket_soak": soak}
+
+
 def bench_slo(model_name: str, family: str, quant: str, block_size: int,
               requests: int = 48, seed: int = 0,
               slo_ttft_ms: float = 30_000.0, slo_tok_ms: float = 5_000.0,
@@ -1169,6 +1410,7 @@ def main() -> int:
         [int(t) for t in args.tps.split(",") if t], args.prefix_requests)
     serve_resilience = bench_serve_resilience(
         model_name, args.family, args.quant, args.block_size)
+    fleet_resilience = bench_fleet_resilience(args.block_size)
     # MoE is a gpt2 architecture; a llama bench still measures the MoE
     # matrix against the default gpt2 model at this scale
     moe_base = (model_name if args.family == "gpt2"
@@ -1200,6 +1442,7 @@ def main() -> int:
         "speculative": spec,
         "tp_serving": tp_serving,
         "serve_resilience": serve_resilience,
+        "fleet_resilience": fleet_resilience,
         "moe_serving": moe_serving,
         "slo": slo,
     }
@@ -1217,6 +1460,8 @@ def main() -> int:
                          for k, v in tp_serving["markers"].items()},
                       **{f"sr_{k}": v
                          for k, v in serve_resilience["markers"].items()},
+                      **{f"fr_{k}": v
+                         for k, v in fleet_resilience["markers"].items()},
                       **{f"moe_{k}": v
                          for k, v in moe_serving["markers"].items()},
                       **{f"slo_{k}": v
@@ -1229,6 +1474,7 @@ def main() -> int:
     return 0 if (all(bits.values()) and all(spec["markers"].values())
                  and all(tp_serving["markers"].values())
                  and all(serve_resilience["markers"].values())
+                 and all(fleet_resilience["markers"].values())
                  and all(moe_serving["markers"].values())
                  and all(slo["markers"].values())) else 1
 

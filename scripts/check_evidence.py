@@ -18,6 +18,7 @@ condition), so the two can never disagree about what "captured" means.
     python scripts/check_evidence.py speculative    # draft/verify/commit
     python scripts/check_evidence.py tp_serving     # TP decode + prefix share
     python scripts/check_evidence.py serve_resilience  # replica fault matrix
+    python scripts/check_evidence.py fleet_resilience  # SIGKILLed processes
     python scripts/check_evidence.py moe_serving    # MoE paged decode + ep
     python scripts/check_evidence.py elasticity     # live worker leave/join
     python scripts/check_evidence.py all
@@ -880,6 +881,69 @@ def serve_resilience_ok(path: str = SERVE_ARTIFACT) -> bool:
     return True
 
 
+# the process-isolated fleet stage (ISSUE 20): the fleet_resilience
+# section of the same serving artifact — (a) the whole document passes
+# the strict serving.json schema, (b) all six markers recomputed true at
+# capture time (SIGKILL identity + zero token loss, real-process
+# isolation, restart identity + prefill-tokens-saved, socket soak
+# served), (c) the kill matrix covers >= FLEET_RES_MIN_KILL_TICKS
+# distinct cut points and includes a sampled cut, every row with
+# tokens_lost == 0, identical, the dead process actually declared and at
+# least one real migration somewhere in the matrix, (d) the restart leg
+# restored in-flight work (the stop really interrupted a fleet) with
+# prefill_tokens_saved > 0 (the persisted chains did real work), and
+# (e) the soak completed every request and pinned its byte stream. A
+# CPU-produced artifact is first-class here for the same reason as the
+# elasticity stage: process spawn, SIGKILL, pipe-EOF detection and the
+# persistence manifest are host-plane mechanics on every backend.
+FLEET_RES_MIN_KILL_TICKS = 3
+
+
+def fleet_resilience_ok(path: str = SERVE_ARTIFACT) -> bool:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    try:
+        vm = _validate_metrics_module()
+        if vm.validate_json_doc(path):
+            return False  # schema violations
+    except Exception:
+        return False
+    sec = doc.get("fleet_resilience")
+    if not isinstance(sec, dict):
+        return False
+    marks = sec.get("markers", {})
+    for k in ("sigkill_identity", "sigkill_zero_token_loss",
+              "process_isolated", "restart_identity",
+              "restart_prefill_saved", "socket_soak_served"):
+        if marks.get(k) is not True:
+            return False
+    rows = sec.get("kill_matrix", [])
+    if len({r.get("kill_tick") for r in rows}) < FLEET_RES_MIN_KILL_TICKS:
+        return False  # 'SIGKILL at any tick' needs more than one cut
+    if not any(r.get("sampling") == "stochastic" for r in rows):
+        return False  # greedy-only identity is the easy half
+    for r in rows:
+        if (r.get("tokens_lost") != 0 or r.get("identical") is not True
+                or r.get("declared_dead") != 1
+                or r.get("process_isolated") is not True):
+            return False
+    if not any(r.get("migrated", 0) > 0 for r in rows):
+        return False  # a matrix where nothing migrated proved nothing
+    restart = sec.get("restart", {})
+    if not (restart.get("inflight_at_stop", 0) > 0
+            and restart.get("restored", 0) > 0
+            and restart.get("prefill_tokens_saved", 0) > 0):
+        return False
+    soak = sec.get("socket_soak", {})
+    if not (soak.get("requests", 0) > 0
+            and soak.get("completed") == soak.get("requests")):
+        return False
+    return True
+
+
 # the live-elasticity stage (ISSUE 10): scripts/bench_elasticity.py's
 # artifact under runs/elasticity — (a) passes the strict elasticity.json
 # schema (validate_metrics, loaded by FILE PATH so this script stays
@@ -1017,6 +1081,7 @@ STAGES = [
     ("speculative", speculative_ok),
     ("tp_serving", tp_serving_ok),
     ("serve_resilience", serve_resilience_ok),
+    ("fleet_resilience", fleet_resilience_ok),
     ("moe_serving", moe_serving_ok),
     ("elasticity", elasticity_ok),
     ("slo", slo_ok),
@@ -1096,6 +1161,8 @@ def check(what: str, arg: str | None = None) -> bool:
         return tp_serving_ok(arg or SERVE_ARTIFACT)
     if what == "serve_resilience":
         return serve_resilience_ok(arg or SERVE_ARTIFACT)
+    if what == "fleet_resilience":
+        return fleet_resilience_ok(arg or SERVE_ARTIFACT)
     if what == "moe_serving":
         return moe_serving_ok(arg or SERVE_ARTIFACT)
     if what == "elasticity":

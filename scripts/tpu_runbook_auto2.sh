@@ -666,6 +666,38 @@ else
     || echo "$(stamp) slo section FAILED (SLO or token-loss regression, or schema)" | tee -a "$OUT/log.txt"
 fi
 
+# ---- 5o. process-isolated fleet (ISSUE 20, ~4 min): the
+# fleet_resilience section of the SAME runs/serving/serving.json — the
+# SIGKILL-at-tick matrix over REAL replica child processes under live
+# socket traffic (serve/net.drive_open_loop; tick 1/3/6 greedy plus a
+# sampled cut — zero accepted-token loss, token-identical migrations,
+# each cut an actual declared process death), the full-stop restart leg
+# (serve/fleet_state shadow + chain index → a fresh fleet resumes
+# token-identically with prefill tokens saved by the warm-started page
+# pool), and the seeded workload soak through the socket front with its
+# stream_sha256 byte-determinism pin. The section always runs on the
+# tiny gpt2 model (the worker builder reconstructs weights from the init
+# seed — process spawn/SIGKILL/pipe-EOF/persistence are host-plane
+# mechanics on every backend), so a CPU artifact is first-class and this
+# stage only re-runs the bench when the banked artifact predates
+# ISSUE 20 or a marker/row failed. check_evidence's 'fleet_resilience'
+# stage judges it (strict schema, all six markers, >= 3 distinct kill
+# ticks incl. a stochastic one, per-row zero loss + declared_dead, a
+# restart that interrupted real work, a fully-served soak).
+if python scripts/check_evidence.py fleet_resilience; then
+  echo "$(stamp) fleet_resilience section already captured — skip" | tee -a "$OUT/log.txt"
+else
+  timeout -k 60 1800 python scripts/bench_serve.py --out runs/serving \
+      >> "$OUT/serving.log" 2>&1
+  rc=$?
+  python scripts/validate_metrics.py runs/serving/serving.json \
+      >> "$OUT/serving.log" 2>&1 || rc=$?
+  echo "$(stamp) fleet_resilience rc=$rc" | tee -a "$OUT/log.txt"
+  python scripts/check_evidence.py fleet_resilience \
+    && echo "$(stamp) fleet_resilience section captured" | tee -a "$OUT/log.txt" \
+    || echo "$(stamp) fleet_resilience section FAILED validation" | tee -a "$OUT/log.txt"
+fi
+
 # ---- 6. parity legs (mid-leg checkpoint/resume: a tunnel drop costs at
 # most 250 steps; re-fires continue from the checkpoint)
 for mode in local vote lazy; do

@@ -47,13 +47,15 @@ scripts/bench_dcn.py's ablation/frontier/parity document; the frontier
 rows are strict-validated per row), the serving-bench artifact
 (``serving.json`` — scripts/bench_serve.py's decode/prefill-share/
 bit-identity/speculative-frontier/tp_serving/serve_resilience/
-moe_serving document, per-row validated the same way incl.
-accept_rate ∈ [0,1] on every frontier row, the TP-degree +
+fleet_resilience/moe_serving document, per-row validated the same way
+incl. accept_rate ∈ [0,1] on every frontier row, the TP-degree +
 shared-prefix rows of the ISSUE 13 section, the
 crash-matrix/slow/drain/rejoin rows of the ISSUE 14 replica-plane
-section, capacity_utilization/dropped_rate ∈ [0,1] on every
-dense-vs-MoE-vs-MoE+ep matrix row of the ISSUE 15 section, and the
-ISSUE 17 ``slo`` section — ordered p50 <= p95 <= p99 non-negative
+section, the SIGKILL-kill-matrix/restart/socket-soak rows of the
+ISSUE 20 process-isolated fleet section (incl. the 64-hex
+``stream_sha256`` byte-determinism pin), capacity_utilization/
+dropped_rate ∈ [0,1] on every dense-vs-MoE-vs-MoE+ep matrix row of the
+ISSUE 15 section, and the ISSUE 17 ``slo`` section — ordered p50 <= p95 <= p99 non-negative
 latency quantiles, finite goodput, required status counts), and the
 live-elasticity artifact (``elasticity.json`` —
 scripts/bench_elasticity.py's survive/bit-identity/timeline/parity
@@ -368,7 +370,7 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
     errors = []
     for key in ("meta", "decode", "prefill_share", "bit_identity",
                 "speculative", "tp_serving", "serve_resilience",
-                "moe_serving", "slo"):
+                "fleet_resilience", "moe_serving", "slo"):
         if key not in doc:
             errors.append(f"{path}: missing required key {key!r}")
     meta = doc.get("meta")
@@ -560,6 +562,81 @@ def _serving_errors(path: str, doc: dict) -> list[str]:
                 if not isinstance(sec.get(k), bool):
                     errors.append(f"{path}: serve_resilience.{section}.{k} "
                                   "must be a bool")
+    fr = doc.get("fleet_resilience")
+    if fr is not None and not isinstance(fr, dict):
+        errors.append(f"{path}: 'fleet_resilience' must be an object")
+    elif isinstance(fr, dict):
+        marks = fr.get("markers")
+        if not isinstance(marks, dict):
+            errors.append(f"{path}: fleet_resilience.markers must be an "
+                          "object")
+        else:
+            for k in ("sigkill_identity", "sigkill_zero_token_loss",
+                      "process_isolated", "restart_identity",
+                      "restart_prefill_saved", "socket_soak_served"):
+                if not isinstance(marks.get(k), bool):
+                    errors.append(
+                        f"{path}: fleet_resilience.markers.{k} must be a "
+                        "bool")
+        rows = fr.get("kill_matrix")
+        if not isinstance(rows, list) or not rows:
+            errors.append(f"{path}: fleet_resilience.kill_matrix must be "
+                          "a non-empty list")
+            rows = []
+        for i, row in enumerate(rows):
+            where = f"{path}: fleet_resilience.kill_matrix[{i}]"
+            if not isinstance(row, dict):
+                errors.append(f"{where} is not an object")
+                continue
+            for k in ("kill_tick", "migrated", "declared_dead",
+                      "tokens_lost", "completed"):
+                if not (isinstance(row.get(k), int)
+                        and not isinstance(row.get(k), bool)
+                        and row[k] >= 0):
+                    errors.append(f"{where}.{k} must be a non-negative int")
+            if row.get("sampling") not in ("greedy", "stochastic"):
+                errors.append(f"{where}.sampling must be "
+                              "'greedy'|'stochastic'")
+            for k in ("identical", "process_isolated"):
+                if not isinstance(row.get(k), bool):
+                    errors.append(f"{where}.{k} must be a bool")
+        restart = fr.get("restart")
+        if not isinstance(restart, dict):
+            errors.append(f"{path}: fleet_resilience.restart must be an "
+                          "object")
+        else:
+            for k in ("inflight_at_stop", "restored", "chains_primed",
+                      "resumed_from_tick", "prefill_tokens_saved"):
+                if not (isinstance(restart.get(k), int)
+                        and not isinstance(restart.get(k), bool)
+                        and restart[k] >= 0):
+                    errors.append(f"{path}: fleet_resilience.restart.{k} "
+                                  "must be a non-negative int")
+            if not isinstance(restart.get("identical"), bool):
+                errors.append(f"{path}: fleet_resilience.restart."
+                              "identical must be a bool")
+        soak = fr.get("socket_soak")
+        if not isinstance(soak, dict):
+            errors.append(f"{path}: fleet_resilience.socket_soak must be "
+                          "an object")
+        else:
+            for k in ("requests", "completed", "rejects", "retries",
+                      "tokens_out"):
+                if not (isinstance(soak.get(k), int)
+                        and not isinstance(soak.get(k), bool)
+                        and soak[k] >= 0):
+                    errors.append(f"{path}: fleet_resilience.socket_soak."
+                                  f"{k} must be a non-negative int")
+            for k in ("wall_s", "goodput_tokens_per_s"):
+                if not _finite_number(soak.get(k)):
+                    errors.append(f"{path}: fleet_resilience.socket_soak."
+                                  f"{k} is not finite")
+            sha = soak.get("stream_sha256")
+            if not (isinstance(sha, str)
+                    and re.fullmatch(r"[0-9a-f]{64}", sha)):
+                errors.append(f"{path}: fleet_resilience.socket_soak."
+                              "stream_sha256 must be a 64-hex-char "
+                              "sha256 digest")
     moe = doc.get("moe_serving")
     if moe is not None and not isinstance(moe, dict):
         errors.append(f"{path}: 'moe_serving' must be an object")

@@ -33,16 +33,34 @@ The output validates under scripts/validate_metrics.py (the request
 JSONL schema) and drives ``serve/api.serve_request_file``, a
 ServingEngine/ServingFleet ``run()``, or scripts/bench_serve.py's slo
 soak (which imports :func:`generate` by file path).
+
+``--stream HOST:PORT`` (ISSUE 20) points the SAME generated workload at
+a live ``run_serve --listen`` socket server instead of a file: requests
+go out open-loop on the ``arrival_tick * --tick_s`` schedule over one
+multiplexed connection (``serve/net.drive_open_loop``), rejects re-arm
+with the server's ``retry_after_s`` hint plus exponential backoff, and
+the summary includes ``stream_sha256`` — the digest of the first-attempt
+wire bytes (``serve/net.encode_request`` canonical JSON), byte-identical
+across reruns of the same seed so a soak's input is provably the same
+stream, not merely the same distribution.
+
+    python scripts/workload_gen.py --requests 50 --seed 0 \
+        --stream 127.0.0.1:8151 --tick_s 0.01
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
 
 import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def _lognormal_int(rng, median: float, sigma: float, lo: int,
@@ -119,6 +137,39 @@ def write_jsonl(records: list, path: str) -> None:
     os.replace(tmp, path)
 
 
+def stream_sha256(records: list) -> str:
+    """Digest of the first-attempt wire byte stream: what every rerun of
+    the same generator seed must reproduce exactly. Pure function of the
+    records (net.encode_request is canonical JSON — sorted keys, compact
+    separators), so it can be pinned without a server."""
+    from distributed_lion_tpu.serve import net
+    h = hashlib.sha256()
+    for rec in records:
+        h.update(net.encode_request(rec))
+    return h.hexdigest()
+
+
+def stream(records: list, target: str, tick_s: float = 0.0,
+           max_wall_s: float = 600.0) -> dict:
+    """Drive ``records`` open-loop at a live socket server and return
+    the drive summary + ``stream_sha256``. Raises if any request ends
+    without a ``done`` frame (drive_open_loop runs to completion or
+    times out honestly)."""
+    from distributed_lion_tpu.serve import net
+    host, _, port = target.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"--stream wants HOST:PORT, got {target!r}")
+    digest = stream_sha256(records)
+    out = net.drive_open_loop(host, int(port), records, tick_s=tick_s,
+                              max_wall_s=max_wall_s)
+    toks = sum(len(r["tokens"]) for r in
+               out["responses"].values())
+    return {"completed": len(out["responses"]),
+            "rejects": out["rejects"], "retries": out["retries"],
+            "wall_s": round(out["wall_s"], 3),
+            "tokens_out": int(toks), "stream_sha256": digest}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -143,6 +194,15 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline_s", type=float, default=5.0)
     ap.add_argument("--out", default=os.path.join(
         "runs", "serving", "requests.jsonl"))
+    ap.add_argument("--stream", default="",
+                    help="HOST:PORT of a run_serve --listen server: "
+                         "drive the workload open-loop over a socket "
+                         "instead of writing --out")
+    ap.add_argument("--tick_s", type=float, default=0.0,
+                    help="--stream pacing: seconds per arrival tick "
+                         "(0 = send as fast as the schedule allows)")
+    ap.add_argument("--stream_wall_s", type=float, default=600.0,
+                    help="--stream hard wall before the drive aborts")
     args = ap.parse_args(argv)
     records = generate(
         requests=args.requests, seed=args.seed, rate=args.rate,
@@ -153,6 +213,11 @@ def main(argv=None) -> int:
         out_max=args.out_max, prefix_groups=args.prefix_groups,
         prefix_frac=args.prefix_frac, prefix_len=args.prefix_len,
         deadline_frac=args.deadline_frac, deadline_s=args.deadline_s)
+    if args.stream:
+        summary = stream(records, args.stream, tick_s=args.tick_s,
+                         max_wall_s=args.stream_wall_s)
+        print(json.dumps(summary, sort_keys=True))
+        return 0
     write_jsonl(records, args.out)
     last = records[-1]["arrival_tick"]
     tagged = sum(1 for r in records if "prefix_group" in r)

@@ -1,0 +1,36 @@
+"""Fixture: DLT012 — blocking socket/pipe reads without a deadline seam
+in a serve/ module. The three naive calls below block forever on a dead
+peer; the bounded/non-blocking variants show the legal seams (an
+explicit socket timeout, the BlockingIOError non-blocking idiom), and
+the last shows the suppression syntax."""
+
+import os
+
+
+def naive_server(sock):
+    conn, peer = sock.accept()          # DLT012: unbounded accept
+    data = conn.recv(4096)              # DLT012: unbounded recv
+    return peer, data
+
+
+def naive_pipe_reader(fd):
+    return os.read(fd, 65536)           # DLT012: unbounded pipe read
+
+
+def bounded_server(sock, wait_s=5.0):
+    sock.settimeout(wait_s)             # the seam: a bounded socket
+    conn, _ = sock.accept()
+    return conn.recv(4096)
+
+
+def nonblocking_accept(sock):
+    try:
+        return sock.accept()            # the other seam: non-blocking
+    except BlockingIOError:
+        return None
+
+
+def justified(sock):
+    # a deliberate block (e.g. a child worker whose ONLY job is waiting
+    # on its parent) documents itself and suppresses the rule
+    return sock.recv(1)  # graft: disable=DLT012

@@ -43,6 +43,10 @@ RULE_FIXTURES = {
     "DLT010": (os.path.join("serve", "dlt010_host_loop_device_alloc.py"),
                3),
     "DLT011": (os.path.join("serve", "dlt011_wall_clock.py"), 3),
+    # DLT012 (ISSUE 20): blocking socket/pipe reads need a deadline seam
+    # in serve/ — the process-isolated fleet's heartbeat verdicts depend
+    # on reads that return
+    "DLT012": (os.path.join("serve", "dlt012_blocking_socket.py"), 3),
 }
 
 
@@ -349,6 +353,25 @@ def test_speculate_fixture_and_module_clean():
     assert [f.rule for f in findings] == ["DLT001", "DLT001"], (
         [str(f) for f in findings])
     assert lint.lint_file(os.path.join(PKG, "serve", "speculate.py")) == []
+
+
+def test_blocking_io_fixture_and_net_modules_clean():
+    """ISSUE 20 satellite: the serving plane's socket/pipe transports
+    must never block unboundedly — a dead peer behind an unbounded
+    recv/accept wedges every request in the host loop, and the
+    process-isolated fleet's heartbeat verdicts depend on reads that
+    return. The fixture shows the forbidden shapes (DLT012 fires 3×:
+    accept, recv, os.read — and shows the two legal seams plus the
+    suppression); every code path in the new socket front and the pipe
+    transport lints zero-finding by file path."""
+    findings = lint.lint_file(
+        os.path.join(FIXTURES, "serve", "dlt012_blocking_socket.py"))
+    assert [f.rule for f in findings] == ["DLT012"] * 3, (
+        [str(f) for f in findings])
+    for rel in ("serve/net.py", "serve/fleet_proc.py",
+                "serve/replica_worker.py", "serve/fleet_state.py",
+                "serve/replica_plane.py"):
+        assert lint.lint_file(os.path.join(PKG, rel)) == [], rel
 
 
 def test_metrics_fixture_and_metrics_module_clean():

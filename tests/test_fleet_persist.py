@@ -1,0 +1,344 @@
+"""Fleet-restart persistence (ISSUE 20 layer c): the recovery shadow +
+prefix chains survive a FULL fleet stop under a sha256 manifest.
+Restore is token-identical with prefill tokens saved by the warm-started
+page pool; torn/truncated generations are detected by the manifest and
+skipped loudly; the ``--resume_fleet`` CLI path rides the same plane —
+plus the banked ``fleet_resilience`` evidence section and its
+check_evidence stage."""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+from distributed_lion_tpu.serve import fleet_state
+from distributed_lion_tpu.serve.engine import (
+    RecoveryRecord,
+    Request,
+    ServeConfig,
+    ServeModel,
+    ServingEngine,
+)
+from distributed_lion_tpu.serve.replica_plane import ServingFleet
+from distributed_lion_tpu.train import journal as journal_mod
+from distributed_lion_tpu.train.resilience import MANIFEST, sha256_file
+
+_CFG = GPT2Config.tiny()
+_PARAMS = gpt2_init(jax.random.key(0), _CFG)
+_MODEL = ServeModel.for_gpt2(_PARAMS, _CFG)
+
+
+def _factory(**kw):
+    base = dict(max_seqs=4, block_size=4, max_blocks_per_seq=8,
+                prefix_cache=True, num_blocks=64)
+    base.update(kw)
+
+    def factory():
+        return ServingEngine(_MODEL, ServeConfig(**base))
+
+    return factory
+
+
+def _reqs(n=4, max_new=12):
+    rng = np.random.default_rng(31)
+    shared = [int(t) for t in rng.integers(1, _CFG.vocab_size, 8)]
+    out = []
+    for i in range(n):
+        tail = [int(t) for t in rng.integers(1, _CFG.vocab_size, 2 + i)]
+        out.append(Request(req_id=f"s{i}", tokens=shared + tail,
+                           max_new_tokens=max_new, seed=i,
+                           prefix_group="sys"))
+    return out
+
+
+def _clone(reqs):
+    return [Request(r.req_id, list(r.tokens), r.max_new_tokens, r.seed,
+                    prefix_group=r.prefix_group) for r in reqs]
+
+
+@pytest.fixture
+def jrnl(tmp_path):
+    j = journal_mod.Journal(str(tmp_path / "jrnl"))
+    journal_mod.install(j)
+    yield j
+    journal_mod.uninstall(j)
+    j.close()
+
+
+def _drain(fleet, done):
+    ticks = 0
+    while fleet.has_work():
+        for c in fleet.step():
+            done[c.req_id] = c
+        ticks += 1
+        assert ticks < 400
+    return done
+
+
+# ----------------------------------------------------- the restart identity
+@pytest.mark.parametrize("sampling", ["greedy", "stochastic"])
+def test_full_stop_resume_token_identical_with_prefill_saved(
+        tmp_path, sampling):
+    """THE acceptance pin: stop a fleet mid-decode (the saved state is
+    all that survives), resume a FRESH fleet from disk — every in-flight
+    request finishes token-identically, and the persisted chains prime
+    the new page pool so the restored requests' shared prefixes HIT
+    instead of cold prefilling (prefill tokens saved > 0)."""
+    samp = (dict(temperature=0.0) if sampling == "greedy"
+            else dict(temperature=0.8, top_k=20))
+    reqs = _reqs()
+    base = _factory(**samp)().run(_clone(reqs))
+    sdir = str(tmp_path / "state")
+
+    fleet_a = ServingFleet(_factory(**samp), replicas=2, state_dir=sdir)
+    done = {}
+    for r in _clone(reqs):
+        fleet_a.submit(r)
+    for _ in range(4):                  # mid-decode, nothing finished
+        for c in fleet_a.step():
+            done[c.req_id] = c
+    fleet_a.save_state()
+    inflight = {r.req_id for r in fleet_a.export_records()}
+    assert inflight                     # the stop really cut work short
+    # fleet_a is now abandoned — a kill -9 of the parent process
+
+    fleet_b = ServingFleet(_factory(**samp), replicas=2)
+    state = fleet_state.load_fleet_state(sdir, now=0.0)
+    out = fleet_state.resume_into(fleet_b, state)
+    assert out["restored"] == len(inflight)
+    assert out["chains_primed"] >= 1
+    _drain(fleet_b, done)
+    for r in reqs:
+        assert done[r.req_id].tokens == base[r.req_id].tokens, \
+            (sampling, r.req_id)
+        assert done[r.req_id].reason == base[r.req_id].reason
+    saved = sum(rep.engine.stats["shared_tokens"]
+                for rep in fleet_b.replicas if rep.engine is not None)
+    assert saved > 0                    # the warm start did real work
+
+
+def test_resumed_deadline_travels_as_remaining_seconds(tmp_path):
+    """A deadline persists as remaining wall seconds and re-stamps on
+    the restorer's clock — and one that lapsed while the fleet was down
+    restores already-expired, completing as an honest timeout."""
+    import time
+
+    sdir = str(tmp_path / "state")
+    recs = [RecoveryRecord("live", [1, 2, 3], [7], seed=0, budget=6,
+                           deadline_at=1000.0 + 30.0),
+            RecoveryRecord("lapsed", [4, 5], [], seed=1, budget=6,
+                           deadline_at=1000.0 - 2.0)]   # died while down
+    fleet_state.save_fleet_state(sdir, recs, chains=[], tick=3,
+                                 now=1000.0)
+    state = fleet_state.load_fleet_state(sdir, now=50.0)
+    by_id = {r.req_id: r for r in state["records"]}
+    assert by_id["live"].deadline_at == pytest.approx(80.0)
+    assert by_id["live"].committed == [7]
+    assert by_id["lapsed"].deadline_at == pytest.approx(48.0)
+    # now against the engine's REAL clock: the lapsed one restores
+    # already-expired, the live one has 30s of runway
+    eng = _factory()()
+    fleet_state.resume_into(
+        eng, fleet_state.load_fleet_state(sdir, now=time.monotonic()))
+    done = {}
+    while eng.has_work():
+        for c in eng.step():
+            done[c.req_id] = c
+    assert done["lapsed"].reason == "timeout"
+    assert done["live"].reason != "timeout"
+
+
+# ------------------------------------------------------ manifest integrity
+def test_torn_state_file_skipped_loudly_with_fallback(tmp_path, jrnl):
+    sdir = tmp_path / "state"
+    recs = [RecoveryRecord("a", [1, 2], [9, 9], seed=0, budget=8)]
+    fleet_state.save_fleet_state(str(sdir), recs, [[1, 2]], tick=4,
+                                 now=0.0)
+    fleet_state.save_fleet_state(
+        str(sdir), recs + [RecoveryRecord("b", [3], [], seed=1, budget=8)],
+        [[1, 2]], tick=8, now=0.0)
+    newest = sdir / "fleet-00000008.json"
+    torn = newest.read_bytes()[:20]
+    newest.write_bytes(torn)            # a torn write after the manifest
+    state = fleet_state.load_fleet_state(str(sdir), now=0.0)
+    assert state["tick"] == 4           # fell back a generation
+    assert [r.req_id for r in state["records"]] == ["a"]
+    events = [r for r in jrnl.tail() if r.get("kind") == "event"]
+    corrupt = [r for r in events if r["name"] == "fleet_state_corrupt"]
+    assert len(corrupt) == 1 and "torn" in corrupt[0]["reason"]
+    assert corrupt[0]["path"].endswith("fleet-00000008.json")
+    restored = [r for r in events if r["name"] == "fleet_state_restored"]
+    assert restored and restored[0]["tick"] == 4
+
+    # flip a byte (size intact): the sha256 catches what size cannot
+    older = sdir / "fleet-00000004.json"
+    raw = bytearray(older.read_bytes())
+    raw[5] ^= 0xFF
+    older.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="no valid fleet state"):
+        fleet_state.load_fleet_state(str(sdir), now=0.0)
+    with pytest.raises(FileNotFoundError, match="manifest"):
+        fleet_state.load_fleet_state(str(tmp_path / "nowhere"), now=0.0)
+
+
+def test_persist_cadence_prunes_and_manifest_verifies(tmp_path, jrnl):
+    sdir = tmp_path / "state"
+    fleet = ServingFleet(_factory(), replicas=2, state_dir=str(sdir),
+                         persist_every=3)
+    done = _drain_with(fleet, _clone(_reqs(max_new=16)))
+    assert len(done) == 4
+    assert fleet.stats["state_saves"] >= 2
+    states = sorted(p.name for p in sdir.glob("fleet-*.json"))
+    assert 1 <= len(states) <= 2        # pruned to the newest two
+    man = json.loads((sdir / MANIFEST).read_text())
+    assert sorted(man["files"]) == states
+    for name, meta in man["files"].items():
+        p = sdir / name
+        assert p.stat().st_size == meta["bytes"]
+        assert sha256_file(p) == meta["sha256"]
+    assert not list(sdir.glob("*.tmp"))  # atomic writes left no debris
+    saves = [r for r in jrnl.tail() if r.get("name") == "fleet_state_saved"]
+    assert len(saves) == fleet.stats["state_saves"]
+
+
+def _drain_with(fleet, todo):
+    done = {}
+    for r in todo:
+        fleet.submit(r)
+    ticks = 0
+    while fleet.has_work():
+        for c in fleet.step():
+            done[c.req_id] = c
+        ticks += 1
+        assert ticks < 400
+    return done
+
+
+# ----------------------------------------------------------------- the CLI
+def test_run_serve_cli_saves_at_drain_and_resumes(tmp_path, capsys):
+    """``--fleet_state_dir`` banks state at drain (chains included);
+    ``--resume_fleet`` restores it, primes the pool, and a follow-up
+    request sharing the persisted prefix serves token-identically to a
+    cold run — the warm start changes cost, never outputs."""
+    from distributed_lion_tpu.cli.run_serve import main
+
+    sdir = tmp_path / "state"
+    shared = [11, 12, 13, 14, 15, 16, 17, 18]
+    first = tmp_path / "first.jsonl"
+    first.write_text("".join(
+        json.dumps({"id": f"a{i}", "tokens": shared + [30 + i],
+                    "max_new_tokens": 4, "seed": i,
+                    "prefix_group": "sys"}) + "\n" for i in range(2)))
+    nxt = tmp_path / "next.jsonl"
+    nxt.write_text(json.dumps(
+        {"id": "b0", "tokens": shared + [60, 61], "max_new_tokens": 5,
+         "seed": 7, "prefix_group": "sys"}) + "\n")
+    base = ["--model_family", "gpt2", "--model_name", "tiny",
+            "--temperature", "0", "--max_seqs", "2", "--block_size", "4",
+            "--prefix_cache", "--fleet_state_dir", str(sdir)]
+    out = tmp_path / "r1.jsonl"
+    main(base + ["--requests", str(first), "--out", str(out)])
+    assert (sdir / MANIFEST).is_file()  # the drain save happened
+    capsys.readouterr()
+    warm = main(base + ["--resume_fleet", "--requests", str(nxt),
+                        "--out", str(tmp_path / "r2.jsonl")])
+    resumed = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert resumed["resumed"] == 0      # the first run drained fully...
+    assert resumed["chains_primed"] >= 1   # ...but its chains warm-start
+    cold = main(["--model_family", "gpt2", "--model_name", "tiny",
+                 "--temperature", "0", "--max_seqs", "2",
+                 "--block_size", "4", "--prefix_cache",
+                 "--requests", str(nxt),
+                 "--out", str(tmp_path / "r3.jsonl")])
+    assert [r["tokens"] for r in warm] == [r["tokens"] for r in cold]
+    with pytest.raises(ValueError, match="resume_fleet"):
+        main(base[:-2] + ["--resume_fleet", "--requests", str(nxt),
+                          "--out", str(tmp_path / "r4.jsonl")])
+
+
+# ----------------------------------------------- banked evidence + stage
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_ce():
+    spec = importlib.util.spec_from_file_location(
+        "ce_fp", os.path.join(REPO, "scripts", "check_evidence.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    return ce
+
+
+def test_banked_artifact_passes_fleet_resilience_stage():
+    """The committed CPU artifact satisfies the ISSUE 20 stage: strict
+    schema, all six markers, >= 3 distinct SIGKILL cut points (plus a
+    sampled cut) with zero loss on real declared-dead processes, a
+    restart leg that restored in-flight work with prefill tokens saved,
+    and a fully-served socket soak pinned by its wire-byte digest — the
+    gate runbook stage 5o re-judges after the on-chip recapture."""
+    ce = _load_ce()
+    assert ce.fleet_resilience_ok()
+    with open(ce.SERVE_ARTIFACT) as f:
+        doc = json.load(f)
+    sec = doc["fleet_resilience"]
+    assert len({r["kill_tick"] for r in sec["kill_matrix"]}) >= 3
+    assert any(r["sampling"] == "stochastic" for r in sec["kill_matrix"])
+    assert all(r["tokens_lost"] == 0 and r["declared_dead"] == 1
+               for r in sec["kill_matrix"])
+    assert sec["restart"]["prefill_tokens_saved"] > 0
+    assert sec["socket_soak"]["completed"] == sec["socket_soak"]["requests"]
+    assert len(sec["socket_soak"]["stream_sha256"]) == 64
+
+
+def test_fleet_resilience_stage_rejects_bad_artifacts(tmp_path):
+    ce = _load_ce()
+    with open(ce.SERVE_ARTIFACT) as f:
+        good = json.load(f)
+    p = tmp_path / "serving.json"
+
+    def reject(mutate):
+        doc = json.loads(json.dumps(good))
+        mutate(doc)
+        p.write_text(json.dumps(doc))
+        assert not ce.fleet_resilience_ok(str(p))
+
+    # artifact predates ISSUE 20 entirely (also a schema violation now)
+    reject(lambda d: d.pop("fleet_resilience"))
+    # each marker flips the stage
+    for k in ("sigkill_identity", "sigkill_zero_token_loss",
+              "process_isolated", "restart_identity",
+              "restart_prefill_saved", "socket_soak_served"):
+        reject(lambda d, k=k: d["fleet_resilience"]["markers"].update(
+            {k: False}))
+    # a kill row that lost tokens / diverged / never declared the death
+    reject(lambda d: d["fleet_resilience"]["kill_matrix"][0].update(
+        tokens_lost=2))
+    reject(lambda d: d["fleet_resilience"]["kill_matrix"][1].update(
+        identical=False))
+    reject(lambda d: d["fleet_resilience"]["kill_matrix"][0].update(
+        declared_dead=0))
+    reject(lambda d: [r.update(migrated=0)
+                      for r in d["fleet_resilience"]["kill_matrix"]])
+    # too few cut points / greedy-only identity
+    reject(lambda d: d["fleet_resilience"].update(
+        kill_matrix=d["fleet_resilience"]["kill_matrix"][:1]))
+    reject(lambda d: [r.update(sampling="greedy")
+                      for r in d["fleet_resilience"]["kill_matrix"]])
+    # the restart leg must have interrupted real work and saved prefill
+    reject(lambda d: d["fleet_resilience"]["restart"].update(
+        inflight_at_stop=0))
+    reject(lambda d: d["fleet_resilience"]["restart"].update(
+        prefill_tokens_saved=0))
+    # a soak that dropped a request
+    reject(lambda d: d["fleet_resilience"]["socket_soak"].update(
+        completed=d["fleet_resilience"]["socket_soak"]["requests"] - 1))
+    # strict schema: a malformed byte-determinism pin
+    reject(lambda d: d["fleet_resilience"]["socket_soak"].update(
+        stream_sha256="nope"))
+    # the untouched artifact still passes from the tmp copy
+    p.write_text(json.dumps(good))
+    assert ce.fleet_resilience_ok(str(p))

@@ -1,0 +1,252 @@
+"""Streaming socket front end (ISSUE 20 layer a): wire schema parity
+with the file mode, per-token streaming at the host tick boundary,
+honest backpressure (pool-tight reject frame + client backoff), queued
+deadline expiry over the wire, and the open-loop driver + byte-identical
+request stream ``workload_gen --stream`` pins."""
+
+import contextlib
+import importlib.util
+import json
+import os
+import socket
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_lion_tpu.models.gpt2 import GPT2Config, gpt2_init
+from distributed_lion_tpu.serve import net
+from distributed_lion_tpu.serve.engine import (
+    Request,
+    ServeConfig,
+    ServeModel,
+    ServingEngine,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CFG = GPT2Config.tiny()
+_PARAMS = gpt2_init(jax.random.key(0), _CFG)
+_MODEL = ServeModel.for_gpt2(_PARAMS, _CFG)
+
+
+def _engine(**kw):
+    base = dict(max_seqs=4, block_size=4, max_blocks_per_seq=8)
+    base.update(kw)
+    return ServingEngine(_MODEL, ServeConfig(**base))
+
+
+def _reqs(n=3, max_new=8):
+    rng = np.random.default_rng(11)
+    return [{"id": f"n{i}", "tokens": [int(t) for t in rng.integers(
+                 1, _CFG.vocab_size, 3 + 2 * i)],
+             "max_new_tokens": max_new, "seed": i} for i in range(n)]
+
+
+def _as_request(d):
+    return Request(req_id=d["id"], tokens=list(d["tokens"]),
+                   max_new_tokens=d["max_new_tokens"],
+                   seed=d.get("seed", 0),
+                   prefix_group=d.get("prefix_group"))
+
+
+@contextlib.contextmanager
+def _serving(target, **kw):
+    """A live server on an ephemeral port, ticking in a daemon thread —
+    the single-threaded production loop; the test plays the client."""
+    srv = net.ServeServer(target, port=0, **kw)
+    th = threading.Thread(target=srv.run, kwargs={"max_wall_s": 120.0},
+                          daemon=True)
+    th.start()
+    try:
+        yield srv
+    finally:
+        srv.stop = True
+        th.join(timeout=15)
+        srv.close()
+        assert not th.is_alive()
+
+
+# ------------------------------------------------------------ determinism
+def test_encode_request_is_canonical_and_rerun_stable():
+    a = net.encode_request({"id": "x", "tokens": [3, 1], "seed": 0})
+    b = net.encode_request({"seed": 0, "tokens": [3, 1], "id": "x"})
+    assert a == b and a.endswith(b"\n")          # key order cannot leak
+    with pytest.raises(ValueError):
+        net.encode_request({"id": "x", "bad": float("nan")})
+
+
+def test_workload_stream_digest_is_a_pure_function_of_the_seed():
+    """The ``--stream`` determinism pin: same generator seed, same wire
+    BYTES (not merely the same distribution) — and a different seed is a
+    different stream."""
+    spec = importlib.util.spec_from_file_location(
+        "wg_net", os.path.join(REPO, "scripts", "workload_gen.py"))
+    wg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wg)
+    kw = dict(requests=16, vocab=64, out_max=8)
+    a = wg.stream_sha256(wg.generate(seed=5, **kw))
+    b = wg.stream_sha256(wg.generate(seed=5, **kw))
+    c = wg.stream_sha256(wg.generate(seed=6, **kw))
+    assert a == b and a != c and len(a) == 64
+
+
+# -------------------------------------------------------------- streaming
+def test_streamed_tokens_match_done_frame_and_offline_run():
+    reqs = _reqs()
+    offline = _engine().run([_as_request(d) for d in reqs])
+    with _serving(_engine()) as srv:
+        client = net.ServeClient(*srv.addr)
+        for d in reqs:
+            streamed = []
+            done = client.request(dict(d), on_tokens=streamed.extend)
+            assert done["event"] == "done" and done["id"] == d["id"]
+            # the per-tick frames concatenate to EXACTLY the final
+            # output — no token duplicated, none withheld until the end
+            assert streamed == done["tokens"]
+            assert done["tokens"] == offline[d["id"]].tokens
+            assert done["reason"] == offline[d["id"]].reason
+            assert done["n_generated"] == len(done["tokens"])
+            assert done["queue_ticks"] >= 0   # lifecycle clocks ride along
+        assert srv.stats["accepted"] == len(reqs)
+        assert srv.stats["completed"] == len(reqs)
+        assert srv.stats["rejected"] == 0 and srv.stats["bad_lines"] == 0
+
+
+def test_wire_refuses_what_the_file_mode_refuses():
+    """One validation site (serve/api.parse_request_obj): garbage JSON,
+    schema violations, and duplicate in-flight ids come back as explicit
+    ``error`` frames — the connection survives and a good request on the
+    same socket still serves."""
+    with _serving(_engine()) as srv:
+        sock = socket.create_connection(srv.addr, timeout=30)
+        sock.settimeout(30.0)
+        f = sock.makefile("rwb")
+        try:
+            def ask(line):
+                f.write(line if isinstance(line, bytes)
+                        else net.encode_request(line))
+                f.flush()
+                return json.loads(f.readline())
+
+            assert "error" in ask(b"not json\n")
+            assert "must be a JSON object" in ask(b"[1, 2]\n")["error"]
+            bad = ask({"id": "x", "tokens": [1], "deadline_s": 0})
+            assert "deadline_s" in bad["error"]
+            # a good request on the SAME connection still serves fully
+            good = {"id": "ok", "tokens": [5, 6, 7], "max_new_tokens": 16}
+            assert ask(good)["event"] == "accepted"
+            # a duplicate id while 'ok' is in flight is refused loudly
+            # (its error frame interleaves with 'ok's token stream)
+            f.write(net.encode_request(good))
+            f.flush()
+            dup = done = None
+            while dup is None or done is None:
+                frame = json.loads(f.readline())
+                if frame.get("event") == "error":
+                    dup = frame
+                elif frame.get("event") == "done":
+                    done = frame
+            assert "duplicate" in dup["error"]
+            assert done["id"] == "ok" and done["n_generated"] == 16
+        finally:
+            f.close()
+            sock.close()
+        assert srv.stats["bad_lines"] == 3
+
+
+def test_pool_tight_reject_and_client_backoff_then_succeed():
+    """Honest backpressure: while a resident request holds the page pool
+    under the ``min_free_blocks`` floor, a newcomer gets an explicit
+    ``reject`` frame with ``retry_after_s`` — and the reference client's
+    backoff retries land it once the pool frees. Nothing is buffered
+    server-side, nothing is silently dropped."""
+    eng = _engine(max_seqs=2, num_blocks=8)
+    with _serving(eng, min_free_blocks=6, retry_after_s=0.02) as srv:
+        hog = {"id": "hog", "tokens": [9] * 8, "max_new_tokens": 24,
+               "seed": 0}
+        sock = socket.create_connection(srv.addr, timeout=30)
+        sock.settimeout(30.0)
+        f = sock.makefile("rb")
+        try:
+            sock.sendall(net.encode_request(hog))
+            # wait for the hog to be DECODING (first tokens frame) so its
+            # pages are allocated and the pool really is tight
+            while True:
+                frame = json.loads(f.readline())
+                if frame.get("event") == "tokens":
+                    break
+            client = net.ServeClient(*srv.addr, max_retries=40,
+                                     backoff_base_s=0.01)
+            done = client.request({"id": "late", "tokens": [1, 2, 3],
+                                   "max_new_tokens": 4, "seed": 1})
+            assert done["event"] == "done" and done["n_generated"] == 4
+            assert client.rejects >= 1       # it WAS pushed back first
+            assert client.retries >= 1
+            while frame.get("event") != "done":   # drain the hog too
+                frame = json.loads(f.readline())
+        finally:
+            f.close()
+            sock.close()
+        assert srv.stats["rejected"] >= 1
+        assert srv.stats["completed"] == 2
+
+
+def test_queued_deadline_expires_behind_slow_peer_with_queue_ticks():
+    """A request whose ``deadline_s`` lapses while it waits behind a
+    long-running resident completes over the wire with the honest
+    ``timeout`` status, zero generated tokens (it never reached prefill)
+    and a populated ``queue_ticks`` — the clock that proves WHERE the
+    deadline died."""
+    with _serving(_engine(max_seqs=1)) as srv:
+        slow = socket.create_connection(srv.addr, timeout=60)
+        slow.settimeout(60.0)
+        f = slow.makefile("rb")
+        try:
+            slow.sendall(net.encode_request(
+                {"id": "resident", "tokens": [4] * 6,
+                 "max_new_tokens": 64, "seed": 0}))
+            while True:      # resident admitted: holds the only slot
+                if json.loads(f.readline()).get("event") == "tokens":
+                    break
+            # a deadline far below one resident's decode run: it MUST
+            # lapse while 'dead' still waits for the only slot
+            client = net.ServeClient(*srv.addr)
+            done = client.request({"id": "dead", "tokens": [1, 2],
+                                   "max_new_tokens": 8, "seed": 1,
+                                   "deadline_s": 0.002})
+            assert done["reason"] == "timeout"
+            assert done["n_generated"] == 0 and done["tokens"] == []
+            assert done["queue_ticks"] >= 1
+            frame = {}
+            while frame.get("event") != "done":
+                frame = json.loads(f.readline())
+            assert frame["n_generated"] > 0   # the resident was unharmed
+        finally:
+            f.close()
+            slow.close()
+
+
+# ------------------------------------------------------- open-loop driver
+def test_drive_open_loop_completes_a_generated_workload():
+    """The soak path end to end: workload_gen records → one multiplexed
+    connection → every request answered, responses token-identical to
+    the offline run of the same records."""
+    spec = importlib.util.spec_from_file_location(
+        "wg_net2", os.path.join(REPO, "scripts", "workload_gen.py"))
+    wg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(wg)
+    records = wg.generate(requests=8, seed=2, vocab=_CFG.vocab_size,
+                          prompt_max=12, out_max=8, prefix_len=4,
+                          deadline_frac=0.0)
+    offline = _engine(prefix_cache=True).run(
+        [_as_request(dict(r, id=r["id"])) for r in records])
+    with _serving(_engine(prefix_cache=True)) as srv:
+        out = net.drive_open_loop(*srv.addr, records=records,
+                                  tick_s=0.0, max_wall_s=90.0)
+    assert set(out["responses"]) == {r["id"] for r in records}
+    for r in records:
+        assert out["responses"][r["id"]]["tokens"] == \
+            offline[r["id"]].tokens, r["id"]
+    assert out["rejects"] == 0

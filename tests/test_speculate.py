@@ -204,7 +204,11 @@ class _ScriptedDrafter:
 
 
 def _alloc_state(bt):
-    return (bt.tables.copy(), bt.owned.copy(), list(bt._free))
+    # bt._free became a list of PER-GROUP lists in the batch-sharded-ep
+    # PR; list(bt._free) is now a SHALLOW copy whose inner lists keep
+    # mutating as the run continues — every snapshot silently showed the
+    # plain run's FINAL free list. Copy the inner lists too.
+    return (bt.tables.copy(), bt.owned.copy(), [list(f) for f in bt._free])
 
 
 def test_partial_accept_rollback_matches_token_by_token():
